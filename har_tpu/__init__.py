@@ -1,0 +1,29 @@
+"""har_tpu — TPU-native human-activity-recognition framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+Lohitanvita/Activity-Recognition-Using-Apache-Spark (a PySpark/MLlib batch
+pipeline, see reference Main/main.py): columnar ingestion with spark-csv
+schema-inference semantics, a composable feature pipeline
+(StringIndexer/OneHotEncoder/VectorAssembler), classical models (multinomial
+logistic regression, histogram decision trees, random forests), neural models
+(MLP / 1D-CNN / BiLSTM in Flax), k-fold cross-validation with grid search,
+one-pass jitted metrics, SPMD data parallelism over a `jax.sharding.Mesh`,
+orbax checkpointing, and report/CSV artifact writers matching the reference's
+output formats.
+
+Nothing here is a translation of the Spark driver/executor architecture:
+compute is a single SPMD program — host-side columnar prep, then jitted XLA
+computations sharded over the device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from har_tpu.config import DataConfig, ModelConfig, TrainConfig, MeshConfig
+
+__all__ = [
+    "DataConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "MeshConfig",
+    "__version__",
+]

@@ -1,0 +1,19 @@
+from har_tpu.data.schema import ColumnType, Schema, infer_schema
+from har_tpu.data.table import Table
+from har_tpu.data.csv_loader import read_csv
+from har_tpu.data.split import random_split
+from har_tpu.data.wisdm import load_wisdm, WISDM_NUMERIC_COLUMNS, WISDM_CATEGORICAL_COLUMNS
+from har_tpu.data.synthetic import synthetic_wisdm
+
+__all__ = [
+    "ColumnType",
+    "Schema",
+    "infer_schema",
+    "Table",
+    "read_csv",
+    "random_split",
+    "load_wisdm",
+    "synthetic_wisdm",
+    "WISDM_NUMERIC_COLUMNS",
+    "WISDM_CATEGORICAL_COLUMNS",
+]

@@ -1,0 +1,20 @@
+from har_tpu.features.pipeline import Pipeline, PipelineModel, Estimator, Transformer
+from har_tpu.features.string_indexer import StringIndexer, StringIndexerModel
+from har_tpu.features.one_hot import OneHotEncoder, OneHotEncoderModel
+from har_tpu.features.assembler import VectorAssembler
+from har_tpu.features.wisdm_pipeline import build_wisdm_pipeline, FeatureSet, make_feature_set
+
+__all__ = [
+    "Pipeline",
+    "PipelineModel",
+    "Estimator",
+    "Transformer",
+    "StringIndexer",
+    "StringIndexerModel",
+    "OneHotEncoder",
+    "OneHotEncoderModel",
+    "VectorAssembler",
+    "build_wisdm_pipeline",
+    "FeatureSet",
+    "make_feature_set",
+]

@@ -1,0 +1,72 @@
+"""StringIndexer: frequency-descending vocabulary → integer index.
+
+Matches MLlib semantics used by the reference (Main/main.py:52-61): labels
+ordered by descending frequency, ties broken lexicographically, so for WISDM
+ACTIVITY the mapping is Walking=0, Jogging=1, Upstairs=2, Downstairs=3,
+Sitting=4, Standing=5 (reference result.txt class counts).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from har_tpu.features.pipeline import ColumnSpace, FrameLike, as_columns
+
+
+class StringIndexer:
+    def __init__(
+        self,
+        input_col: str,
+        output_col: str,
+        handle_invalid: str = "error",  # error | keep (extra bucket)
+    ):
+        self.input_col = input_col
+        self.output_col = output_col
+        if handle_invalid not in ("error", "keep"):
+            raise ValueError(f"handle_invalid={handle_invalid!r}")
+        self.handle_invalid = handle_invalid
+
+    def fit(self, frame: FrameLike) -> "StringIndexerModel":
+        col = as_columns(frame)[self.input_col]
+        values, counts = np.unique(col.astype(str), return_counts=True)
+        order = np.lexsort((values, -counts))  # freq desc, then lexicographic
+        vocab = tuple(str(values[i]) for i in order)
+        return StringIndexerModel(
+            self.input_col, self.output_col, vocab, self.handle_invalid
+        )
+
+
+class StringIndexerModel:
+    def __init__(
+        self,
+        input_col: str,
+        output_col: str,
+        vocab: tuple[str, ...],
+        handle_invalid: str = "error",
+    ):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.vocab = vocab
+        self.handle_invalid = handle_invalid
+        self._index = {v: i for i, v in enumerate(vocab)}
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.vocab)
+
+    def transform(self, frame: FrameLike) -> ColumnSpace:
+        columns = as_columns(frame)
+        col = columns[self.input_col].astype(str)
+        unseen_bucket = len(self.vocab)
+        idx = np.fromiter(
+            (self._index.get(v, unseen_bucket) for v in col),
+            dtype=np.int32,
+            count=len(col),
+        )
+        if self.handle_invalid == "error" and np.any(idx == unseen_bucket):
+            bad = sorted(set(col[idx == unseen_bucket]))[:5]
+            raise ValueError(
+                f"unseen labels in column {self.input_col!r}: {bad}"
+            )
+        columns[self.output_col] = idx
+        return columns

@@ -1,0 +1,88 @@
+"""The WISDM feature pipeline, assembled like the reference's.
+
+Reference Main/main.py:51-73: for each PEAK column a StringIndexer +
+OneHotEncoder, a label StringIndexer for ACTIVITY, then a VectorAssembler
+over the three one-hot vectors plus the 10 numeric columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from har_tpu.data.table import Table
+from har_tpu.features.assembler import VectorAssembler
+from har_tpu.features.one_hot import OneHotEncoder
+from har_tpu.features.pipeline import ColumnSpace, Pipeline, PipelineModel
+from har_tpu.features.string_indexer import StringIndexer
+from har_tpu.data.wisdm import (
+    LABEL_COLUMN,
+    WISDM_CATEGORICAL_COLUMNS,
+    WISDM_NUMERIC_COLUMNS,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureSet:
+    """Device-ready arrays produced by the pipeline."""
+
+    features: np.ndarray  # (n, d) float32
+    label: np.ndarray  # (n,) int32
+    uid: np.ndarray | None = None
+
+    def __len__(self) -> int:
+        return len(self.features)
+
+    @property
+    def num_features(self) -> int:
+        return self.features.shape[1]
+
+    def take(self, indices: np.ndarray) -> "FeatureSet":
+        return FeatureSet(
+            features=self.features[indices],
+            label=self.label[indices],
+            uid=None if self.uid is None else self.uid[indices],
+        )
+
+    def split(self, fractions, seed: int) -> list["FeatureSet"]:
+        from har_tpu.data.split import split_indices
+
+        return [
+            self.take(idx)
+            for idx in split_indices(len(self), fractions, seed)
+        ]
+
+
+def build_wisdm_pipeline(
+    categorical: tuple[str, ...] = WISDM_CATEGORICAL_COLUMNS,
+    numeric: tuple[str, ...] = WISDM_NUMERIC_COLUMNS,
+    label: str = LABEL_COLUMN,
+) -> Pipeline:
+    stages: list = []
+    assembled: list[str] = []
+    for col in categorical:
+        stages.append(StringIndexer(col, f"{col}_index", handle_invalid="keep"))
+        stages.append(OneHotEncoder(f"{col}_index", f"{col}_vec"))
+        assembled.append(f"{col}_vec")
+    stages.append(StringIndexer(label, "label"))
+    stages.append(VectorAssembler(assembled + list(numeric), "features"))
+    return Pipeline(stages)
+
+
+def make_feature_set(columns: ColumnSpace) -> FeatureSet:
+    return FeatureSet(
+        features=np.ascontiguousarray(columns["features"], dtype=np.float32),
+        label=columns["label"].astype(np.int32),
+        uid=columns.get("UID"),
+    )
+
+
+def fit_transform(
+    pipeline: Pipeline, train: Table, *others: Table
+) -> tuple[PipelineModel, list[FeatureSet]]:
+    """Fit on `train`, transform train + others (reference fits the pipeline
+    on the full df before splitting — Main/main.py:68-80; callers choose)."""
+    model = pipeline.fit(train)
+    sets = [make_feature_set(model.transform(t)) for t in (train, *others)]
+    return model, sets

@@ -1,0 +1,13 @@
+from har_tpu.models.base import Predictions, Classifier, ClassifierModel
+from har_tpu.models.logistic_regression import (
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+
+__all__ = [
+    "Predictions",
+    "Classifier",
+    "ClassifierModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+]

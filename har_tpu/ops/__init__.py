@@ -1,0 +1,15 @@
+from har_tpu.ops.metrics import (
+    classification_report,
+    confusion_matrix,
+    multiclass_metrics,
+    binary_metrics,
+    regression_metrics,
+)
+
+__all__ = [
+    "classification_report",
+    "confusion_matrix",
+    "multiclass_metrics",
+    "binary_metrics",
+    "regression_metrics",
+]

@@ -1,0 +1,48 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; per-the framework's test
+strategy (SURVEY §4) all sharding/parallelism tests execute on
+XLA's host-platform device simulation.  Must run before jax is imported.
+"""
+
+import os
+import sys
+
+# Force CPU even when the environment pins another platform (JAX_PLATFORMS
+# may be preset to a TPU plugin); tests must never depend on accelerator
+# availability.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    if jax.default_backend() != "cpu":  # pragma: no cover - defensive
+        raise RuntimeError(
+            "jax was imported on a non-cpu backend before conftest ran; "
+            "run pytest in a fresh interpreter"
+        )
+
+import pytest  # noqa: E402
+
+from har_tpu.config import REFERENCE_WISDM_CSV  # noqa: E402
+
+
+def has_reference_data() -> bool:
+    return os.path.exists(REFERENCE_WISDM_CSV)
+
+
+requires_wisdm = pytest.mark.skipif(
+    not has_reference_data(), reason="reference WISDM CSV not mounted"
+)
+
+
+@pytest.fixture(scope="session")
+def wisdm_csv_path() -> str:
+    if not has_reference_data():
+        pytest.skip("reference WISDM CSV not mounted")
+    return REFERENCE_WISDM_CSV

@@ -1,0 +1,108 @@
+"""Feature pipeline tests: indexer ordering, dropLast one-hot, assembly,
+and the 3,100-dim WISDM parity check."""
+
+import numpy as np
+
+from har_tpu.data import load_wisdm, synthetic_wisdm
+from har_tpu.features import (
+    OneHotEncoder,
+    Pipeline,
+    StringIndexer,
+    VectorAssembler,
+    build_wisdm_pipeline,
+    make_feature_set,
+)
+
+
+class TestStringIndexer:
+    def test_frequency_descending(self):
+        col = {"c": np.array(["b", "a", "b", "c", "b", "a"], dtype=object)}
+        model = StringIndexer("c", "i").fit(col)
+        assert model.vocab == ("b", "a", "c")
+        out = model.transform(col)
+        np.testing.assert_array_equal(out["i"], [0, 1, 0, 2, 0, 1])
+
+    def test_tie_break_lexicographic(self):
+        col = {"c": np.array(["b", "a"], dtype=object)}
+        model = StringIndexer("c", "i").fit(col)
+        assert model.vocab == ("a", "b")
+
+    def test_unseen_error_and_keep(self):
+        fitted = StringIndexer("c", "i").fit({"c": np.array(["a"], dtype=object)})
+        try:
+            fitted.transform({"c": np.array(["zz"], dtype=object)})
+            assert False, "expected error"
+        except ValueError:
+            pass
+        keep = StringIndexer("c", "i", handle_invalid="keep").fit(
+            {"c": np.array(["a"], dtype=object)}
+        )
+        out = keep.transform({"c": np.array(["zz", "a"], dtype=object)})
+        np.testing.assert_array_equal(out["i"], [1, 0])
+
+
+class TestOneHot:
+    def test_drop_last(self):
+        cols = {"i": np.array([0, 1, 2], dtype=np.int32)}
+        model = OneHotEncoder("i", "v").fit(cols)
+        out = model.transform(cols)
+        assert out["v"].shape == (3, 2)  # cardinality 3 → width 2
+        np.testing.assert_array_equal(
+            out["v"], [[1, 0], [0, 1], [0, 0]]  # last index all-zero
+        )
+
+    def test_no_drop(self):
+        cols = {"i": np.array([0, 2], dtype=np.int32)}
+        model = OneHotEncoder("i", "v", drop_last=False).fit(cols)
+        assert model.transform(cols)["v"].shape == (2, 3)
+
+
+class TestAssembler:
+    def test_concat_order(self):
+        cols = {
+            "v": np.array([[1.0, 2.0]], dtype=np.float32),
+            "x": np.array([3.0]),
+        }
+        out = VectorAssembler(["v", "x"], "f").transform(cols)
+        np.testing.assert_array_equal(out["f"], [[1.0, 2.0, 3.0]])
+
+
+class TestPipelineSynthetic:
+    def test_end_to_end(self):
+        t = synthetic_wisdm(n_rows=400, seed=1)
+        model = build_wisdm_pipeline().fit(t)
+        fs = make_feature_set(model.transform(t))
+        assert len(fs) == 400
+        assert fs.label.min() >= 0 and fs.label.max() <= 5
+        assert fs.features.dtype == np.float32
+
+    def test_transform_is_pure(self):
+        t = synthetic_wisdm(n_rows=100, seed=2)
+        model = build_wisdm_pipeline().fit(t)
+        a = make_feature_set(model.transform(t))
+        b = make_feature_set(model.transform(t))
+        np.testing.assert_array_equal(a.features, b.features)
+
+
+class TestWisdmFeatureParity:
+    """Feature-space golden numbers (reference result.txt '(3100,[...])'
+    rows; SURVEY §2 F/G)."""
+
+    def test_3100_dims_and_label_order(self, wisdm_csv_path):
+        table = load_wisdm(wisdm_csv_path)
+        pipeline = build_wisdm_pipeline()
+        model = pipeline.fit(table)
+        fs = make_feature_set(model.transform(table))
+        assert fs.num_features == 3100  # 934 + 1401 + 755 + 10
+        label_indexer = model.stages[6]  # ACTIVITY StringIndexer
+        assert label_indexer.vocab == (
+            "Walking",
+            "Jogging",
+            "Upstairs",
+            "Downstairs",
+            "Sitting",
+            "Standing",
+        )
+        # every row: 3 one-hot dims at most + 10 numerics
+        row_nnz = (fs.features[:5] != 0).sum(axis=1)
+        assert row_nnz.max() <= 13

@@ -1,0 +1,105 @@
+"""Metrics engine vs hand-computed values and sklearn-style invariants."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from har_tpu.ops import (
+    binary_metrics,
+    classification_report,
+    confusion_matrix,
+    multiclass_metrics,
+    regression_metrics,
+)
+
+
+class TestConfusion:
+    def test_counts(self):
+        labels = jnp.array([0, 0, 1, 2, 2, 2])
+        preds = jnp.array([0, 1, 1, 2, 2, 0])
+        cm = np.asarray(confusion_matrix(labels, preds, 3))
+        expected = np.array([[1, 1, 0], [0, 1, 0], [1, 0, 2]], dtype=np.float32)
+        np.testing.assert_array_equal(cm, expected)
+
+    def test_mask(self):
+        labels = jnp.array([0, 1])
+        preds = jnp.array([0, 1])
+        cm = np.asarray(
+            confusion_matrix(labels, preds, 2, mask=jnp.array([1.0, 0.0]))
+        )
+        assert cm.sum() == 1.0
+
+
+class TestMulticlass:
+    def test_hand_computed(self):
+        cm = jnp.array([[2.0, 1.0], [0.0, 3.0]])
+        m = multiclass_metrics(cm)
+        assert np.isclose(float(m["accuracy"]), 5 / 6)
+        # class0: p=1, r=2/3; class1: p=3/4, r=1
+        w0, w1 = 3 / 6, 3 / 6
+        exp_p = w0 * 1.0 + w1 * 0.75
+        exp_r = w0 * (2 / 3) + w1 * 1.0
+        assert np.isclose(float(m["weightedPrecision"]), exp_p)
+        assert np.isclose(float(m["weightedRecall"]), exp_r)
+        f0 = 2 * 1.0 * (2 / 3) / (1.0 + 2 / 3)
+        f1 = 2 * 0.75 * 1.0 / 1.75
+        assert np.isclose(float(m["f1"]), w0 * f0 + w1 * f1)
+
+    def test_empty_predicted_class_zero_precision(self):
+        cm = jnp.array([[0.0, 2.0], [0.0, 2.0]])
+        m = multiclass_metrics(cm)
+        assert float(m["precision_per_class"][0]) == 0.0
+
+
+class TestBinary:
+    def test_perfect_ranking(self):
+        scores = jnp.array([0.9, 0.8, 0.2, 0.1])
+        pos = jnp.array([1.0, 1.0, 0.0, 0.0])
+        m = binary_metrics(scores, pos)
+        assert np.isclose(float(m["areaUnderROC"]), 1.0)
+        assert np.isclose(float(m["areaUnderPR"]), 1.0)
+
+    def test_random_ranking_half(self):
+        rng = np.random.default_rng(0)
+        scores = jnp.asarray(rng.random(4000))
+        pos = jnp.asarray((rng.random(4000) < 0.5).astype(np.float32))
+        m = binary_metrics(scores, pos)
+        assert abs(float(m["areaUnderROC"]) - 0.5) < 0.05
+
+    def test_auroc_matches_mann_whitney(self):
+        rng = np.random.default_rng(1)
+        scores = rng.normal(size=300)
+        pos = (rng.random(300) < 0.4).astype(np.float32)
+        scores[pos == 1] += 1.0
+        # Mann-Whitney U equivalence (no ties in continuous scores)
+        p_scores = scores[pos == 1][:, None]
+        n_scores = scores[pos == 0][None, :]
+        u = (p_scores > n_scores).mean()
+        m = binary_metrics(jnp.asarray(scores), jnp.asarray(pos))
+        assert np.isclose(float(m["areaUnderROC"]), u, atol=1e-5)
+
+
+class TestRegression:
+    def test_hand_computed(self):
+        y = jnp.array([1.0, 2.0, 3.0])
+        yhat = jnp.array([1.0, 2.0, 5.0])
+        m = regression_metrics(y, yhat)
+        assert np.isclose(float(m["mse"]), 4 / 3)
+        assert np.isclose(float(m["rmse"]), np.sqrt(4 / 3))
+        assert np.isclose(float(m["mae"]), 2 / 3)
+        ss_tot = 2.0  # var around mean 2
+        assert np.isclose(float(m["r2"]), 1 - 4 / ss_tot)
+
+
+class TestReport:
+    def test_one_pass_consistency(self):
+        rng = np.random.default_rng(3)
+        labels = jnp.asarray(rng.integers(0, 6, 512))
+        raw = jnp.asarray(rng.normal(size=(512, 6)).astype(np.float32))
+        rep = classification_report(labels, raw, num_classes=6)
+        cm = np.asarray(rep["confusion_matrix"])
+        assert cm.sum() == 512
+        acc = float(rep["accuracy"])
+        assert np.isclose(
+            acc, np.trace(cm) / 512
+        )
+        assert float(rep["count_correct"]) + float(rep["count_wrong"]) == 512
