@@ -1,0 +1,32 @@
+"""SPMD parallelism: device meshes, shardings, data-parallel steps.
+
+TPU-native replacement for the reference's Spark cluster machinery
+(driver/executor RPC, row partitioning, treeAggregate — SURVEY §2b, §5.8).
+"""
+
+from har_tpu.parallel.mesh import (
+    DP_AXIS,
+    TP_AXIS,
+    create_mesh,
+    single_device_mesh,
+)
+from har_tpu.parallel.sharding import (
+    batch_sharding,
+    pad_to_multiple,
+    replicated,
+    shard_batch,
+)
+from har_tpu.parallel.data_parallel import jit_replicated, make_dp_train_step
+
+__all__ = [
+    "DP_AXIS",
+    "TP_AXIS",
+    "create_mesh",
+    "single_device_mesh",
+    "batch_sharding",
+    "replicated",
+    "pad_to_multiple",
+    "shard_batch",
+    "jit_replicated",
+    "make_dp_train_step",
+]

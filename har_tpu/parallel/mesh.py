@@ -1,0 +1,56 @@
+"""Device-mesh construction for SPMD execution.
+
+This replaces the reference's entire driver/executor topology (Spark master
+URL at reference Main/main.py:8, Netty RPC + treeAggregate under MLlib, see
+SURVEY §2b/§5.8): instead of a cluster manager scheduling tasks onto
+executors, every device in a `jax.sharding.Mesh` runs the same compiled XLA
+program, and cross-device reductions are in-graph collectives (`psum` over
+the `dp` axis is the moral equivalent of Spark's treeAggregate).
+
+Axis convention (scaling-book style):
+  - ``dp``: data parallelism — shards the batch/row dimension.
+  - ``tp``: tensor parallelism — shards feature/hidden dimensions.
+
+Multi-host: callers run `jax.distributed.initialize()` before building a
+mesh; `jax.devices()` then spans all hosts and XLA routes collectives over
+ICI within a slice and DCN across slices automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def create_mesh(
+    dp: int = -1,
+    tp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a 2-D (dp, tp) mesh.
+
+    ``dp=-1`` means "all remaining devices after tp".  tp devices are placed
+    on the fastest-varying axis so tensor-parallel collectives ride the
+    nearest ICI links.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if tp < 1 or n % tp:
+        raise ValueError(f"tp={tp} must divide device count {n}")
+    if dp == -1:
+        dp = n // tp
+    if dp * tp != n:
+        raise ValueError(f"dp*tp={dp * tp} != device count {n}")
+    grid = np.asarray(devices).reshape(dp, tp)
+    return Mesh(grid, (DP_AXIS, TP_AXIS))
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """A 1×1 mesh — lets every code path be mesh-shaped even on one chip."""
+    device = device or jax.devices()[0]
+    return create_mesh(dp=1, tp=1, devices=[device])

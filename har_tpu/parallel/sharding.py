@@ -1,0 +1,65 @@
+"""Sharding specs + host→device placement helpers.
+
+The reference's data distribution is Spark partitioning rows across
+executors (implicit under every action, SURVEY §2c.1).  Here distribution
+is declarative: arrays carry a `NamedSharding`, and XLA inserts the
+collectives the layout implies.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from har_tpu.parallel.mesh import DP_AXIS
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Rows sharded over dp, everything else replicated."""
+    return NamedSharding(mesh, P(DP_AXIS, *([None] * (ndim - 1))))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(
+    arr: np.ndarray, multiple: int, axis: int = 0, fill=0
+) -> tuple[np.ndarray, int]:
+    """Pad ``arr`` along ``axis`` to a multiple; returns (padded, n_pad).
+
+    Static shapes are mandatory under jit, and the dp axis must divide the
+    batch; padding + a validity mask is the XLA-friendly answer to Spark's
+    arbitrary last-partition sizes.
+    """
+    n = arr.shape[axis]
+    n_pad = (-n) % multiple
+    if n_pad == 0:
+        return arr, 0
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, n_pad)
+    return np.pad(arr, widths, constant_values=fill), n_pad
+
+
+def shard_batch(mesh: Mesh, *arrays: np.ndarray) -> tuple:
+    """Pad each array's leading dim to the dp size and place it sharded.
+
+    Returns ``(*device_arrays, mask)`` where ``mask`` is 1.0 for real rows
+    and 0.0 for padding — consumers weight their reductions by it.
+    """
+    dp = mesh.shape[DP_AXIS]
+    out = []
+    n = arrays[0].shape[0]
+    for a in arrays:
+        if a.shape[0] != n:
+            raise ValueError("all arrays must share the leading dimension")
+        padded, _ = pad_to_multiple(a, dp)
+        out.append(
+            jax.device_put(padded, batch_sharding(mesh, padded.ndim))
+        )
+    mask_host, _ = pad_to_multiple(
+        np.ones(n, np.float32), dp
+    )
+    mask = jax.device_put(mask_host, batch_sharding(mesh, 1))
+    return (*out, mask)

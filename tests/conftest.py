@@ -19,13 +19,18 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 if "jax" in sys.modules:
+    # The environment preloads jax in every interpreter; the backend is
+    # still uninitialized at this point, so redirect it to CPU via config
+    # (env vars alone are only read at jax import time).
     import jax
+    from jax._src import xla_bridge
 
-    if jax.default_backend() != "cpu":  # pragma: no cover - defensive
+    if xla_bridge._backends:  # pragma: no cover - defensive
         raise RuntimeError(
-            "jax was imported on a non-cpu backend before conftest ran; "
+            "jax backend initialized before conftest ran; "
             "run pytest in a fresh interpreter"
         )
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
