@@ -18,7 +18,7 @@ Two styles are provided:
 
 from __future__ import annotations
 
-import functools
+import inspect
 from typing import Any, Callable
 
 import jax
@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from har_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from har_tpu.parallel.mesh import DP_AXIS
 
 Pytree = Any
 
@@ -91,8 +91,27 @@ def jit_replicated(
 
     XLA inserts the all-reduces implied by the sharding — the declarative
     twin of :func:`make_dp_train_step` for one-shot whole-dataset programs.
+    ``fn`` must have a fixed positional signature (jit requires one
+    in_sharding per positional argument).
     """
-    n_args = max(batch_argnums) + 1 if batch_argnums else 0
+    params = inspect.signature(fn).parameters.values()
+    if any(
+        p.kind
+        in (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        for p in params
+    ):
+        raise ValueError("jit_replicated requires a fixed-arity function")
+    n_args = len(
+        [
+            p
+            for p in params
+            if p.kind
+            in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+            )
+        ]
+    )
 
     def in_sharding(i):
         if i in batch_argnums:
