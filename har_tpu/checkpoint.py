@@ -1,0 +1,161 @@
+"""Orbax checkpointing: params, optimizer state, pipeline vocabularies.
+
+The reference persists nothing but metrics — models live and die in-process
+(SURVEY §5.4: the only persistence gesture is a commented-out to_csv).  A
+real framework needs restartable training and servable artifacts, so:
+
+  - :func:`save_model` / :func:`load_model` — a trained NeuralClassifier
+    (Flax params + module config + feature scaler) as one checkpoint dir.
+  - :class:`TrainCheckpointer` — mid-training (params, opt_state, epoch)
+    snapshots for resume; the optimizer state carries the LR-schedule
+    step, so a resumed cosine schedule continues where it stopped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from har_tpu.features.scaler import FittedScaler
+from har_tpu.models.neural import build_model
+from har_tpu.models.neural_classifier import NeuralClassifierModel
+from har_tpu.train.trainer import NeuralModel
+
+_META = "har_meta.json"
+
+
+def _abspath(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def save_model(path: str, model: NeuralClassifierModel, model_name: str,
+               model_kwargs: dict | None = None) -> str:
+    """Persist a trained neural classifier (params + scaler + config)."""
+    path = _abspath(path)
+    os.makedirs(path, exist_ok=True)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(
+            os.path.join(path, "params"),
+            jax.device_get(model.inner.params),
+            force=True,
+        )
+    meta: dict[str, Any] = {
+        "model_name": model_name,
+        "model_kwargs": model_kwargs or {},
+        "num_classes": model.num_classes,
+    }
+    if model.scaler is not None:
+        meta["scaler"] = {
+            "mean": np.asarray(model.scaler.mean).tolist(),
+            "std": np.asarray(model.scaler.std).tolist(),
+        }
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def load_model(path: str) -> NeuralClassifierModel:
+    path = _abspath(path)
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        params = ckptr.restore(os.path.join(path, "params"))
+    module = build_model(
+        meta["model_name"],
+        num_classes=meta["num_classes"],
+        **{
+            k: (tuple(v) if isinstance(v, list) else v)
+            for k, v in meta["model_kwargs"].items()
+        },
+    )
+    scaler = None
+    if "scaler" in meta:
+        scaler = FittedScaler(
+            mean=np.asarray(meta["scaler"]["mean"], np.float32),
+            std=np.asarray(meta["scaler"]["std"], np.float32),
+        )
+    inner = NeuralModel(
+        module=module, params=params, num_classes=meta["num_classes"]
+    )
+    return NeuralClassifierModel(
+        inner=inner, scaler=scaler, num_classes=meta["num_classes"]
+    )
+
+
+@dataclasses.dataclass
+class TrainCheckpointer:
+    """Mid-training snapshots: (params, opt_state, epoch) for resume."""
+
+    directory: str
+    keep: int = 3
+
+    def __post_init__(self):
+        self.directory = _abspath(self.directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=self.keep),
+        )
+
+    def save(self, epoch: int, params, opt_state) -> None:
+        state = {
+            "params": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+        }
+        self._mgr.save(epoch, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, epoch: int | None = None, template=None):
+        epoch = epoch if epoch is not None else self.latest_epoch()
+        if epoch is None:
+            return None
+        if template is not None:
+            restored = self._mgr.restore(
+                epoch, args=ocp.args.StandardRestore(template)
+            )
+        else:
+            restored = self._mgr.restore(epoch)
+        return epoch, restored["params"], restored["opt_state"]
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def evaluate_checkpoint(path: str, data_path: str | None = None) -> dict:
+    """CLI `evaluate` backend: load a checkpoint, score it on WISDM."""
+    from har_tpu.config import DataConfig
+    from har_tpu.data.split import split_indices
+    from har_tpu.data.synthetic import synthetic_wisdm
+    from har_tpu.data.wisdm import load_wisdm, numeric_feature_view
+    from har_tpu.features.string_indexer import StringIndexer
+    from har_tpu.ops.metrics import evaluate
+
+    model = load_model(path)
+    resolved = data_path or DataConfig().resolved_path()
+    table = (
+        load_wisdm(resolved)
+        if resolved
+        else synthetic_wisdm(n_rows=5418, seed=2018)
+    )
+    x, _ = numeric_feature_view(table)
+    y = np.asarray(
+        StringIndexer("ACTIVITY", "label").fit(table).transform(table)["label"],
+        np.int32,
+    )
+    _, te = split_indices(len(x), [0.7, 0.3], seed=2018)
+    preds = model.transform(x[te])
+    rep = evaluate(y[te], preds.raw, model.num_classes)
+    return {
+        "accuracy": rep["accuracy"],
+        "f1": rep["f1"],
+        "n_test": int(len(te)),
+    }

@@ -1,0 +1,106 @@
+"""`har` command-line interface.
+
+Replaces the reference's spark-submit entrypoint (README.md:5-8) with a
+real CLI: train/evaluate/benchmark subcommands over a dataclass config
+(the reference hardcodes every knob in the script — SURVEY §5.6).
+
+Usage:
+  python -m har_tpu.cli train  --models lr dt rf --output-dir main_result
+  python -m har_tpu.cli train  --models mlp --epochs 150
+  python -m har_tpu.cli bench
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from har_tpu.config import DataConfig, ModelConfig, RunConfig, TuningConfig
+
+_ALIASES = {
+    "lr": "logistic_regression",
+    "dt": "decision_tree",
+    "rf": "random_forest",
+}
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="har", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="train + evaluate models, write report")
+    t.add_argument("--dataset", default="wisdm",
+                   choices=["wisdm", "ucihar", "synthetic"])
+    t.add_argument("--data-path", default=None)
+    t.add_argument("--models", nargs="+",
+                   default=["lr", "dt", "rf"],
+                   help="lr dt rf mlp cnn1d bilstm")
+    t.add_argument("--train-fraction", type=float, default=0.7)
+    t.add_argument("--seed", type=int, default=2018)
+    t.add_argument("--no-cv", action="store_true",
+                   help="skip the 5-fold CrossValidator pass")
+    t.add_argument("--cv-metric", default="accuracy",
+                   help="model-selection metric; 'mae' replicates the "
+                        "reference's evaluator quirk (SURVEY §2 N)")
+    t.add_argument("--epochs", type=int, default=None)
+    t.add_argument("--batch-size", type=int, default=None)
+    t.add_argument("--learning-rate", type=float, default=None)
+    t.add_argument("--eda", action="store_true",
+                   help="write hexbin pair plots + scatter matrix")
+    t.add_argument("--output-dir", default="main_result")
+
+    e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
+    e.add_argument("--checkpoint", required=True)
+    e.add_argument("--dataset", default="wisdm")
+    e.add_argument("--data-path", default=None)
+
+    sub.add_parser("bench", help="run the headline benchmark (bench.py)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.command == "bench":
+        import bench
+
+        bench.main()
+        return 0
+
+    if args.command == "evaluate":
+        from har_tpu.checkpoint import evaluate_checkpoint
+
+        print(json.dumps(evaluate_checkpoint(args.checkpoint, args.data_path)))
+        return 0
+
+    # train
+    models = [_ALIASES.get(m, m) for m in args.models]
+    neural_params = {}
+    for k in ("epochs", "batch_size", "learning_rate"):
+        v = getattr(args, k)
+        if v is not None:
+            neural_params[k] = v
+    config = RunConfig(
+        data=DataConfig(
+            dataset=args.dataset,
+            path=args.data_path,
+            train_fraction=args.train_fraction,
+            seed=args.seed,
+        ),
+        model=ModelConfig(name=models[0], params=neural_params),
+        tuning=TuningConfig(selection_metric=args.cv_metric),
+        output_dir=args.output_dir,
+    )
+    from har_tpu.runner import run
+
+    outcome = run(
+        config, models=models, with_cv=not args.no_cv, with_eda=args.eda
+    )
+    print(json.dumps({"accuracies": outcome.accuracies,
+                      "artifacts": outcome.report_paths}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

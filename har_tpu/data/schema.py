@@ -24,6 +24,11 @@ class ColumnType(enum.Enum):
     DOUBLE = "double"
     STRING = "string"
 
+    @property
+    def spark_name(self) -> str:
+        """Type name as Spark's printSchema spells it (result.txt:4-17)."""
+        return "integer" if self is ColumnType.INT else self.value
+
 
 def _is_int(value: str) -> bool:
     try:

@@ -60,3 +60,38 @@ def load_wisdm(
     if drop_binned:
         drops.extend(BINNED_COLUMNS)
     return table.drop(drops) if drops else table
+
+
+def numeric_feature_view(
+    table: Table,
+    include_binned: bool = False,
+    missing_value: float = -1.0,
+) -> tuple[np.ndarray, tuple[str, ...]]:
+    """The *numeric* reading of the WISDM features: PEAK columns parsed as
+    floats ('?' → ``missing_value``) instead of one-hot categories.
+
+    The reference's 3,100-dim one-hot space is an artifact of spark-csv
+    schema inference reading the PEAK columns (times-between-peaks in ms)
+    as strings (SURVEY §2 F).  Treating them as the numbers they are is
+    both far smaller and far more informative — the neural models reach
+    ~0.87 test accuracy on this 13-dim view vs 0.73 for the reference's
+    best classical model on the one-hot space.
+    """
+    names: list[str] = list(WISDM_NUMERIC_COLUMNS)
+    cols = [np.asarray(table[c], np.float64) for c in WISDM_NUMERIC_COLUMNS]
+    for c in WISDM_CATEGORICAL_COLUMNS:
+        raw = table[c]
+        vals = np.array(
+            [
+                float(v) if v not in ("?", "") else missing_value
+                for v in raw
+            ],
+            np.float64,
+        )
+        cols.append(vals)
+        names.append(c)
+    if include_binned:
+        for c in BINNED_COLUMNS:
+            cols.append(np.asarray(table[c], np.float64))
+            names.append(c)
+    return np.stack(cols, axis=1).astype(np.float32), tuple(names)

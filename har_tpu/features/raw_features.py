@@ -1,0 +1,94 @@
+"""Jitted raw-window → WISDM-transformed feature extraction.
+
+The WISDM "transformed" dataset the reference trains on (SURVEY §2 S) is
+the output of a 43-feature reduction of each 10 s window: per-axis means,
+absolute/standard deviations, 10-bin value histograms, average
+time-between-peaks, and the mean resultant magnitude.  The reference
+receives this as a CSV (the transform itself lives outside its repo); here
+the transform is a `jax.vmap`'d on-device kernel (BASELINE.json north star:
+"the DataFrame sliding-window feature extractor becomes a jax.vmap over raw
+(x,y,z) accelerometer segments"), so raw streams can feed either the
+classical pipeline (via these features) or the neural models (directly).
+
+Feature layout matches the CSV column order (har_tpu.data.wisdm):
+  X0..X9, Y0..Y9, Z0..Z9   per-axis 10-bin histogram fractions
+  XAVG, YAVG, ZAVG         per-axis means
+  XPEAK, YPEAK, ZPEAK      avg time between detected peaks, milliseconds
+  XABSDEV...               mean |x - mean|
+  XSTDDEV...               population standard deviation
+  RESULTANT                mean ℓ2 magnitude of (x,y,z)
+
+Peak detection is a strict local-maximum test with a mean+0.1·std height
+threshold — the published WISDM description ("time between sensor peaks")
+leaves the detector unspecified, so exact numeric parity with the shipped
+CSV is not expected (nor checkable: the raw stream isn't in the repo).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from har_tpu.data.raw_windows import SAMPLE_HZ
+
+NUM_BINS = 10
+
+
+def _axis_histogram(x: jax.Array) -> jax.Array:
+    """Fraction of samples in 10 equal-width bins over [min, max]."""
+    lo, hi = x.min(), x.max()
+    width = jnp.maximum(hi - lo, 1e-12)
+    bins = jnp.clip(
+        ((x - lo) / width * NUM_BINS).astype(jnp.int32), 0, NUM_BINS - 1
+    )
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(x), bins, num_segments=NUM_BINS
+    )
+    return counts / x.shape[0]
+
+
+def _avg_peak_gap_ms(x: jax.Array) -> jax.Array:
+    """Average distance between strict local maxima above a height
+    threshold, in milliseconds; 0 when fewer than 2 peaks."""
+    mid = x[1:-1]
+    is_peak = (mid > x[:-2]) & (mid > x[2:]) & (
+        mid > x.mean() + 0.1 * x.std()
+    )
+    n_peaks = is_peak.sum()
+    pos = jnp.arange(1, x.shape[0] - 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(is_peak, pos, jnp.inf))
+    last = jnp.max(jnp.where(is_peak, pos, -jnp.inf))
+    span_ms = (last - first) * (1000.0 / SAMPLE_HZ)
+    return jnp.where(n_peaks > 1, span_ms / jnp.maximum(n_peaks - 1, 1), 0.0)
+
+
+def _window_features(window: jax.Array) -> jax.Array:
+    """(T, 3) → (43,) feature vector in CSV column order."""
+    x, y, z = window[:, 0], window[:, 1], window[:, 2]
+    hists = [_axis_histogram(a) for a in (x, y, z)]
+    avgs = jnp.stack([a.mean() for a in (x, y, z)])
+    peaks = jnp.stack([_avg_peak_gap_ms(a) for a in (x, y, z)])
+    absdev = jnp.stack([jnp.abs(a - a.mean()).mean() for a in (x, y, z)])
+    stddev = jnp.stack([a.std() for a in (x, y, z)])
+    resultant = jnp.sqrt(x**2 + y**2 + z**2).mean()
+    return jnp.concatenate(
+        [*hists, avgs, peaks, absdev, stddev, resultant[None]]
+    )
+
+
+@functools.partial(jax.jit)
+def extract_features(windows: jax.Array) -> jax.Array:
+    """(n, T, 3) raw windows → (n, 43) transformed features, on device."""
+    return jax.vmap(_window_features)(windows)
+
+
+FEATURE_NAMES = (
+    tuple(f"{axis}{i}" for axis in ("X", "Y", "Z") for i in range(NUM_BINS))
+    + ("XAVG", "YAVG", "ZAVG")
+    + ("XPEAK", "YPEAK", "ZPEAK")
+    + ("XABSDEV", "YABSDEV", "ZABSDEV")
+    + ("XSTDDEV", "YSTDDEV", "ZSTDDEV")
+    + ("RESULTANT",)
+)

@@ -1,0 +1,177 @@
+"""Random forest: vmapped histogram trees with bootstrap weights.
+
+Replaces MLlib's RandomForestClassifier (reference Main/main.py:478 —
+numTrees=100, maxDepth=4, maxBins=32).  MLlib trains trees in groups over
+row-partitioned data with per-node feature subsampling; here every tree is
+the same static-shape histogram program (har_tpu.models.tree._grow_tree),
+so the whole forest is ONE `vmap` over per-tree bootstrap weights and
+feature-subset RNGs — 100 trees train as a single XLA program, and the
+binning pass is shared across trees instead of repeated.
+
+Bootstrap: Poisson(1) per-row counts used as sample weights (the standard
+with-replacement approximation; MLlib's BaggedPoint does the same).
+Feature subsets: √d features per node (MLlib featureSubsetStrategy="auto"
+for classification).  Prediction averages per-tree leaf class
+distributions (MLlib's normalized-vote rawPrediction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.base import Predictions
+from har_tpu.models.tree import (
+    _grow_tree,
+    _predict_tree,
+    binize,
+    quantile_thresholds,
+)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_classes",
+        "max_depth",
+        "max_bins",
+        "min_instances",
+        "features_per_split",
+        "num_trees",
+        "tree_batch",
+    ),
+)
+def _grow_forest(
+    bins: jax.Array,
+    thresholds: jax.Array,
+    y: jax.Array,
+    rng: jax.Array,
+    num_classes: int,
+    max_depth: int,
+    max_bins: int,
+    min_instances: int,
+    features_per_split: int,
+    num_trees: int,
+    tree_batch: int = 8,
+):
+    n = bins.shape[0]
+    boot_rng, feat_rng = jax.random.split(rng)
+    boot = jax.random.poisson(
+        boot_rng, 1.0, shape=(num_trees, n)
+    ).astype(jnp.float32)
+    feat_rngs = jax.random.split(feat_rng, num_trees)
+
+    def grow_one(weights, tree_rng):
+        return _grow_tree(
+            bins,
+            thresholds,
+            y,
+            weights,
+            tree_rng,
+            num_classes=num_classes,
+            max_depth=max_depth,
+            max_bins=max_bins,
+            min_instances=min_instances,
+            features_per_split=features_per_split,
+        )
+
+    # lax.map with batch_size: trees grow `tree_batch` at a time (vmapped
+    # within a chunk, sequential across chunks) — full 100-tree vmap would
+    # materialize ~80 GB of level histograms on the wide one-hot space.
+    return jax.lax.map(
+        lambda args: grow_one(*args),
+        (boot, feat_rngs),
+        batch_size=min(tree_batch, num_trees),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def _predict_forest(feature, threshold, leaf_probs, x, max_depth):
+    probs = jax.vmap(
+        lambda f, t, p: _predict_tree(f, t, p, x, max_depth=max_depth)
+    )(feature, threshold, leaf_probs)
+    return probs.mean(axis=0)  # (n, C)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForestClassifier:
+    """Reference defaults: numTrees=100, maxDepth=4, maxBins=32
+    (Main/main.py:478)."""
+
+    num_trees: int = 100
+    max_depth: int = 4
+    max_bins: int = 32
+    min_instances_per_node: int = 1
+    feature_subset: str | int = "auto"
+    seed: int = 0
+    num_classes: int | None = None
+
+    def copy_with(self, **params) -> "RandomForestClassifier":
+        return dataclasses.replace(self, **params)
+
+    def _features_per_split(self, d: int) -> int:
+        if isinstance(self.feature_subset, int):
+            return min(self.feature_subset, d)
+        if self.feature_subset in ("auto", "sqrt"):
+            return max(1, int(math.sqrt(d)))
+        if self.feature_subset == "all":
+            return 0
+        if self.feature_subset == "onethird":
+            return max(1, d // 3)
+        raise ValueError(f"unknown feature_subset {self.feature_subset!r}")
+
+    def fit(self, data: FeatureSet) -> "RandomForestModel":
+        x = jnp.asarray(data.features, jnp.float32)
+        y = jnp.asarray(data.label, jnp.int32)
+        num_classes = self.num_classes or int(data.label.max()) + 1
+        thresholds = quantile_thresholds(x, self.max_bins)
+        bins = binize(x, thresholds)
+        feature, threshold, leaf_class, leaf_probs = _grow_forest(
+            bins,
+            thresholds,
+            y,
+            jax.random.PRNGKey(self.seed),
+            num_classes=num_classes,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_instances=self.min_instances_per_node,
+            features_per_split=self._features_per_split(x.shape[1]),
+            num_trees=self.num_trees,
+        )
+        return RandomForestModel(
+            feature=np.asarray(feature),
+            threshold=np.asarray(threshold),
+            leaf_probs=np.asarray(leaf_probs),
+            max_depth=self.max_depth,
+            num_classes=num_classes,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForestModel:
+    feature: np.ndarray  # (T, nodes)
+    threshold: np.ndarray  # (T, nodes)
+    leaf_probs: np.ndarray  # (T, nodes, C)
+    max_depth: int
+    num_classes: int
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.feature)
+
+    def transform(self, data: FeatureSet) -> Predictions:
+        probs = _predict_forest(
+            jnp.asarray(self.feature),
+            jnp.asarray(self.threshold),
+            jnp.asarray(self.leaf_probs),
+            jnp.asarray(data.features, jnp.float32),
+            max_depth=self.max_depth,
+        )
+        probs = np.asarray(probs)
+        return Predictions.from_raw(probs, probs)

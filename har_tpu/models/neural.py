@@ -1,0 +1,122 @@
+"""Flax neural classifiers: MLP, 1D-CNN, BiLSTM.
+
+These are the north-star models from BASELINE.json — the reference tops out
+at 73% WISDM accuracy with MLlib classical models (BASELINE.md); the neural
+configs (MLP on transformed features, CNN/BiLSTM on raw tri-axial windows)
+are where ≥97% accuracy comes from.
+
+TPU design notes:
+  - compute dtype bfloat16 (MXU-native), parameters float32; logits are
+    cast back to float32 before the softmax/loss for stable reductions.
+  - CNN uses channels-last (N, T, C) 1-D convs — XLA maps these onto the
+    MXU as implicit GEMMs; channel widths are multiples of 8 to tile well.
+  - BiLSTM uses `nn.RNN` over `nn.OptimizedLSTMCell` (a fused-gate cell:
+    one (x,h)→4H matmul per step) wrapped in `nn.Bidirectional`; the time
+    loop is a `lax.scan`, so the whole unrolled program is one XLA while
+    loop with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """3-layer perceptron over transformed feature vectors (BASELINE.json
+    config 2, the Flax re-design of MLlib's MultilayerPerceptronClassifier)."""
+
+    num_classes: int = 6
+    hidden: Sequence[int] = (256, 128)
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+class ConvBlock(nn.Module):
+    features: int
+    kernel: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (self.kernel,), dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.max_pool(x, (2,), strides=(2,))
+
+
+class CNN1D(nn.Module):
+    """1-D CNN over raw (T, 3) accelerometer windows (BASELINE.json
+    config 3). Three conv/pool stages then global average pooling."""
+
+    num_classes: int = 6
+    channels: Sequence[int] = (64, 128, 128)
+    kernel: int = 5
+    dropout_rate: float = 0.3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        for ch in self.channels:
+            x = ConvBlock(ch, self.kernel, self.dtype)(x)
+        x = x.mean(axis=-2)  # global average pool over time
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+class BiLSTM(nn.Module):
+    """Bidirectional LSTM over raw windows (BASELINE.json config 5)."""
+
+    num_classes: int = 6
+    hidden: int = 128
+    num_layers: int = 1
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        for _ in range(self.num_layers):
+            bidi = nn.Bidirectional(
+                nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)),
+                nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)),
+            )
+            x = bidi(x)
+        # mean-pool the concatenated fwd/bwd features over time
+        x = x.mean(axis=-2)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+MODEL_REGISTRY = {
+    "mlp": MLP,
+    "cnn1d": CNN1D,
+    "bilstm": BiLSTM,
+}
+
+
+def build_model(name: str, num_classes: int, **kwargs) -> nn.Module:
+    try:
+        cls = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown neural model {name!r}; have {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return cls(num_classes=num_classes, **kwargs)

@@ -1,0 +1,73 @@
+"""Estimator-protocol wrapper around the Flax models + Trainer.
+
+Gives the neural family the same fit/transform surface as the classical
+models (har_tpu.models.base), so cross-validation, the report writer, and
+the CLI treat an MLP exactly like MLlib's estimators are treated by the
+reference script (fit → model.transform, Main/main.py:115-130).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import numpy as np
+
+from har_tpu.features.scaler import FittedScaler, StandardScaler
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.base import Predictions
+from har_tpu.models.neural import build_model
+from har_tpu.train.trainer import NeuralModel, Trainer, TrainerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class NeuralClassifier:
+    model_name: str = "mlp"
+    config: TrainerConfig = dataclasses.field(default_factory=TrainerConfig)
+    model_kwargs: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    standardize: bool = True
+    num_classes: int | None = None
+    mesh: Any = None
+
+    def copy_with(self, **params) -> "NeuralClassifier":
+        known = {f.name for f in dataclasses.fields(self)}
+        direct = {k: v for k, v in params.items() if k in known}
+        extra = {k: v for k, v in params.items() if k not in known}
+        if extra:
+            direct["config"] = dataclasses.replace(self.config, **extra)
+        return dataclasses.replace(self, **direct)
+
+    def fit(self, data: FeatureSet) -> "NeuralClassifierModel":
+        x = np.asarray(data.features, np.float32)
+        y = np.asarray(data.label, np.int32)
+        num_classes = self.num_classes or int(y.max()) + 1
+        scaler = StandardScaler().fit(x) if self.standardize else None
+        if scaler is not None:
+            x = scaler.transform(x)
+        module = build_model(
+            self.model_name, num_classes=num_classes, **self.model_kwargs
+        )
+        trained = Trainer(module, self.config, mesh=self.mesh).fit(
+            x, y, num_classes=num_classes
+        )
+        return NeuralClassifierModel(
+            inner=trained, scaler=scaler, num_classes=num_classes
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NeuralClassifierModel:
+    inner: NeuralModel
+    scaler: FittedScaler | None
+    num_classes: int
+
+    @property
+    def history(self) -> dict | None:
+        return self.inner.history
+
+    def transform(self, data) -> Predictions:
+        x = data.features if hasattr(data, "features") else data
+        x = np.asarray(x, np.float32)
+        if self.scaler is not None:
+            x = self.scaler.transform(x)
+        return self.inner.transform(x)

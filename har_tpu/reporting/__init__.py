@@ -1,0 +1,17 @@
+"""Run reports, metrics CSVs, and EDA plots (reference-format artifacts)."""
+
+from har_tpu.reporting.ascii_table import show
+from har_tpu.reporting.report import (
+    CSV_HEADER,
+    CV_CSV_HEADER,
+    ModelResult,
+    ReportWriter,
+)
+
+__all__ = [
+    "show",
+    "CSV_HEADER",
+    "CV_CSV_HEADER",
+    "ModelResult",
+    "ReportWriter",
+]

@@ -1,0 +1,54 @@
+"""Spark-`show()`-style ASCII tables.
+
+The reference's report is a stdout capture where every DataFrame `.show()`
+prints the +---+---+ bordered table (reference result.txt throughout);
+this renderer reproduces that format so our result.txt diffs cleanly
+against the reference's.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        # Spark prints doubles with full precision but trims trailing zeros;
+        # the reference data shows values like 8.4, 11.96, 0.598788
+        s = f"{v:.10g}"
+        return s
+    return str(v)
+
+
+def show(
+    columns: Sequence[str],
+    rows: Iterable[Sequence],
+    max_rows: int | None = 20,
+    truncate: int = 20,
+) -> str:
+    """Render rows Spark-style; returns the table as a string."""
+    rows = [list(r) for r in rows]
+    shown = rows if max_rows is None else rows[:max_rows]
+    cells = [
+        [
+            (s if len(s) <= truncate else s[: truncate - 3] + "...")
+            for s in map(_fmt, row)
+        ]
+        for row in shown
+    ]
+    widths = [
+        max(len(str(c)), *(len(r[i]) for r in cells)) if cells else len(str(c))
+        for i, c in enumerate(columns)
+    ]
+    sep = "+" + "+".join("-" * w for w in widths) + "+"
+    out = [sep]
+    out.append(
+        "|" + "|".join(str(c).rjust(w) for c, w in zip(columns, widths)) + "|"
+    )
+    out.append(sep)
+    for r in cells:
+        out.append("|" + "|".join(v.rjust(w) for v, w in zip(r, widths)) + "|")
+    out.append(sep)
+    if max_rows is not None and len(rows) > max_rows:
+        out.append(f"only showing top {max_rows} rows")
+    return "\n".join(out) + "\n"

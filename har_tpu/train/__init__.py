@@ -1,0 +1,17 @@
+"""Training loops for the neural model family."""
+
+from har_tpu.train.trainer import (
+    NeuralModel,
+    Trainer,
+    TrainerConfig,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "NeuralModel",
+    "Trainer",
+    "TrainerConfig",
+    "make_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,62 @@
+"""Checkpoint round-trip + resume tests."""
+
+import numpy as np
+import optax
+
+import jax
+import jax.numpy as jnp
+
+from har_tpu.checkpoint import TrainCheckpointer, load_model, save_model
+from har_tpu.data.raw_windows import synthetic_raw_stream
+from har_tpu.features.raw_features import extract_features
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.neural_classifier import NeuralClassifier
+from har_tpu.train import TrainerConfig
+
+
+def _small_fit(tmp_path):
+    raw = synthetic_raw_stream(n_windows=200, seed=0, window=32)
+    feats = np.asarray(extract_features(jnp.asarray(raw.windows)))
+    data = FeatureSet(features=feats, label=raw.labels)
+    est = NeuralClassifier(
+        "mlp",
+        config=TrainerConfig(batch_size=64, epochs=5),
+        model_kwargs={"hidden": (32,)},
+    )
+    return data, est.fit(data)
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    data, model = _small_fit(tmp_path)
+    path = save_model(
+        str(tmp_path / "ckpt"), model, "mlp", {"hidden": (32,)}
+    )
+    restored = load_model(path)
+    p1 = model.transform(data)
+    p2 = restored.transform(data)
+    np.testing.assert_allclose(p1.raw, p2.raw, rtol=1e-6)
+    assert restored.num_classes == model.num_classes
+    assert restored.scaler is not None
+
+
+def test_train_checkpointer_resume(tmp_path):
+    params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+    ck = TrainCheckpointer(str(tmp_path / "train_ck"), keep=2)
+    try:
+        ck.save(1, params, opt_state)
+        ck.save(2, jax.tree.map(lambda a: a * 2, params), opt_state)
+        assert ck.latest_epoch() == 2
+        epoch, p, s = ck.restore(
+            template={"params": params, "opt_state": opt_state}
+        )
+        assert epoch == 2
+        np.testing.assert_allclose(p["w"], 2 * np.ones((3, 2)))
+        # keep=2: epoch 1 still available
+        epoch1, p1, _ = ck.restore(
+            1, template={"params": params, "opt_state": opt_state}
+        )
+        np.testing.assert_allclose(p1["w"], np.ones((3, 2)))
+    finally:
+        ck.close()

@@ -1,0 +1,124 @@
+"""Report writer, ASCII tables, runner + CLI end-to-end on synthetic data."""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from har_tpu.config import DataConfig, ModelConfig, RunConfig
+from har_tpu.data.synthetic import synthetic_wisdm
+from har_tpu.reporting import CSV_HEADER, CV_CSV_HEADER, ReportWriter, show
+from har_tpu.reporting.report import ModelResult
+
+
+def test_show_matches_spark_layout():
+    out = show(["a", "bb"], [[1, 2.5], [10, 0.25]], max_rows=20)
+    lines = out.strip().split("\n")
+    assert lines[0] == "+--+----+"
+    assert lines[1] == "| a|  bb|"
+    assert lines[3] == "| 1| 2.5|"
+    assert lines[4] == "|10|0.25|"
+
+
+def test_show_truncates_rows_and_cells():
+    out = show(["x"], [["abcdefghijklmnopqrstuvwxyz"]], truncate=10)
+    assert "abcdefg..." in out
+    out = show(["x"], [[i] for i in range(25)], max_rows=5)
+    assert "only showing top 5 rows" in out
+
+
+def _fake_result(name, is_cv=False, acc=0.9):
+    cm = np.array([[90, 10], [10, 90]], np.float32)
+    metrics = {
+        "confusion_matrix": cm,
+        "accuracy": acc,
+        "f1": acc,
+        "weightedPrecision": acc,
+        "weightedRecall": acc,
+        "areaUnderROC": 0.95,
+        "areaUnderPR": 0.9,
+        "rmse": 0.3,
+        "mse": 0.09,
+        "r2": 0.5,
+        "mae": 0.1,
+    }
+    return ModelResult(
+        name=name, metrics=metrics, train_time_s=1.5, test_time_s=0.1,
+        is_cv=is_cv,
+    )
+
+
+def test_report_writer_artifacts(tmp_path):
+    table = synthetic_wisdm(n_rows=100, seed=0)
+    w = ReportWriter(str(tmp_path))
+    w.line("Loading Data Set...")
+    w.schema(table)
+    w.sample(table)
+    w.class_counts(table["ACTIVITY"])
+    w.summary(table)
+    w.split_counts(70, 30)
+    w.model_block(_fake_result("lr"))
+    w.model_block(_fake_result("lr_cv", is_cv=True))
+    paths = w.save()
+
+    text = open(paths["result"]).read()
+    assert "root" in text and "|-- UID: integer (nullable = true)" in text
+    assert "Activity Count" in text
+    assert "Training Dataset Count : 70" in text
+    assert "MultiClass Accuracy" in text
+    assert "Total Correct        = 180" in text
+
+    rows = list(csv.reader(open(paths["csv"])))
+    assert rows[0] == CSV_HEADER
+    assert rows[1][0] == "lr" and rows[1][1] == "200"
+    cv_rows = list(csv.reader(open(paths["cv_csv"])))
+    assert cv_rows[0] == CV_CSV_HEADER
+    assert cv_rows[1][0] == "lr_cv"
+
+
+def test_runner_end_to_end_synthetic(tmp_path):
+    from har_tpu.runner import run
+
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", seed=2018),
+        model=ModelConfig(name="logistic_regression"),
+        output_dir=str(tmp_path),
+    )
+    outcome = run(config, models=["logistic_regression"], with_cv=False)
+    assert outcome.accuracies["logistic_regression"] > 0.8
+    assert os.path.exists(outcome.report_paths["result"])
+    assert os.path.exists(outcome.report_paths["csv"])
+
+
+def test_cli_train_synthetic(tmp_path, capsys):
+    from har_tpu.cli import main
+
+    rc = main(
+        [
+            "train",
+            "--dataset", "synthetic",
+            "--models", "dt",
+            "--no-cv",
+            "--output-dir", str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "decision_tree" in out["accuracies"]
+    assert os.path.exists(os.path.join(str(tmp_path), "result.txt"))
+
+
+def test_eda_plots(tmp_path):
+    pytest.importorskip("matplotlib")
+    from har_tpu.data.wisdm import WISDM_NUMERIC_COLUMNS
+    from har_tpu.reporting.eda import save_eda_plots
+
+    table = synthetic_wisdm(n_rows=200, seed=0)
+    cols = list(WISDM_NUMERIC_COLUMNS[:3])
+    paths = save_eda_plots(table, cols, str(tmp_path), sample_fraction=0.5)
+    # 3 features → 6 ordered distinct pairs + scatter matrix
+    assert len(paths) == 7
+    assert all(os.path.exists(p) for p in paths)
+    assert os.path.exists(os.path.join(str(tmp_path), "Fig %s_%s.png" % (cols[0], cols[1])))
