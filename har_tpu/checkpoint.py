@@ -130,8 +130,19 @@ class TrainCheckpointer:
         self._mgr.close()
 
 
-def evaluate_checkpoint(path: str, data_path: str | None = None) -> dict:
-    """CLI `evaluate` backend: load a checkpoint, score it on WISDM."""
+def evaluate_checkpoint(
+    path: str,
+    data_path: str | None = None,
+    dataset: str = "wisdm",
+    train_fraction: float = 0.7,
+    seed: int = 2018,
+) -> dict:
+    """CLI `evaluate` backend: load a checkpoint, score it on held-out data.
+
+    ``train_fraction``/``seed`` must match the values the checkpoint was
+    trained with — the test partition is re-derived from them, so a
+    mismatch would leak training rows into the score.
+    """
     from har_tpu.config import DataConfig
     from har_tpu.data.split import split_indices
     from har_tpu.data.synthetic import synthetic_wisdm
@@ -140,18 +151,39 @@ def evaluate_checkpoint(path: str, data_path: str | None = None) -> dict:
     from har_tpu.ops.metrics import evaluate
 
     model = load_model(path)
-    resolved = data_path or DataConfig().resolved_path()
-    table = (
-        load_wisdm(resolved)
-        if resolved
-        else synthetic_wisdm(n_rows=5418, seed=2018)
+    if dataset == "ucihar":
+        from har_tpu.data.ucihar import (
+            load_ucihar,
+            synthetic_ucihar,
+            ucihar_feature_set,
+        )
+
+        table = (
+            load_ucihar(data_path)
+            if data_path
+            else synthetic_ucihar(n_rows=2000, seed=seed)
+        )
+        data = ucihar_feature_set(table)
+        x, y = data.features, data.label
+    elif dataset == "wisdm":
+        resolved = data_path or DataConfig().resolved_path()
+        table = (
+            load_wisdm(resolved)
+            if resolved
+            else synthetic_wisdm(n_rows=5418, seed=seed)
+        )
+        x, _ = numeric_feature_view(table)
+        y = np.asarray(
+            StringIndexer("ACTIVITY", "label")
+            .fit(table)
+            .transform(table)["label"],
+            np.int32,
+        )
+    else:
+        raise ValueError(f"unknown dataset {dataset!r}")
+    _, te = split_indices(
+        len(x), [train_fraction, 1.0 - train_fraction], seed=seed
     )
-    x, _ = numeric_feature_view(table)
-    y = np.asarray(
-        StringIndexer("ACTIVITY", "label").fit(table).transform(table)["label"],
-        np.int32,
-    )
-    _, te = split_indices(len(x), [0.7, 0.3], seed=2018)
     preds = model.transform(x[te])
     rep = evaluate(y[te], preds.raw, model.num_classes)
     return {

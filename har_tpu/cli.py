@@ -52,8 +52,12 @@ def _parser() -> argparse.ArgumentParser:
 
     e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
     e.add_argument("--checkpoint", required=True)
-    e.add_argument("--dataset", default="wisdm")
+    e.add_argument("--dataset", default="wisdm", choices=["wisdm", "ucihar"])
     e.add_argument("--data-path", default=None)
+    e.add_argument("--train-fraction", type=float, default=0.7,
+                   help="must match the training run (test split re-derived)")
+    e.add_argument("--seed", type=int, default=2018,
+                   help="must match the training run")
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
     return p
@@ -71,7 +75,17 @@ def main(argv=None) -> int:
     if args.command == "evaluate":
         from har_tpu.checkpoint import evaluate_checkpoint
 
-        print(json.dumps(evaluate_checkpoint(args.checkpoint, args.data_path)))
+        print(
+            json.dumps(
+                evaluate_checkpoint(
+                    args.checkpoint,
+                    args.data_path,
+                    dataset=args.dataset,
+                    train_fraction=args.train_fraction,
+                    seed=args.seed,
+                )
+            )
+        )
         return 0
 
     # train

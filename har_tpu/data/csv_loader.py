@@ -3,9 +3,9 @@
 Replaces the reference's ``com.databricks.spark.csv`` read (reference
 Main/main.py:18-20): header row, full-pass schema inference, typed columns.
 
-A native C++ fast path (har_tpu/native, loaded via ctypes) parses large files
-when the extension has been built; the pure-Python path is authoritative and
-always available.
+A native C++ fast path (native/csvloader.cpp via har_tpu/data/native_loader,
+loaded through ctypes) parses files on worker threads when the toolchain is
+available; the pure-Python path is authoritative and always available.
 """
 
 from __future__ import annotations
@@ -33,27 +33,52 @@ def _columns_to_table(names: Sequence[str], columns: list[list[str]]) -> Table:
     return Table(out, schema)
 
 
-def read_csv(path: str, header: bool = True, infer: bool = True) -> Table:
+def read_csv(
+    path: str,
+    header: bool = True,
+    infer: bool = True,
+    engine: str = "auto",
+) -> Table:
     """Read a CSV file into a columnar Table.
 
     `header=True, infer=True` matches the reference's read options
     (Main/main.py:18-20).  Without inference every column is a string.
+
+    engine: "auto" uses the multithreaded C++ parser when the toolchain is
+    available (building it on first use), "native" requires it, "python"
+    forces the pure-Python path.  Both produce identical Tables (tested).
     """
-    native = _try_native(path, header)
-    if native is not None:
-        names, columns = native
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown CSV engine {engine!r}")
+    if engine == "native" and not (header and infer):
+        raise ValueError(
+            "engine='native' supports only header=True, infer=True"
+        )
+    if engine in ("auto", "native") and header and infer:
+        try:
+            from har_tpu.data.native_loader import (
+                native_available,
+                read_csv_native,
+            )
+
+            if native_available():
+                return read_csv_native(path)
+            if engine == "native":
+                raise RuntimeError("native CSV engine unavailable")
+        except Exception:
+            if engine == "native":
+                raise
+    with open(path, newline="") as f:
+        reader = _csv.reader(f)
+        rows = list(reader)
+    if not rows:
+        raise ValueError(f"empty CSV: {path}")
+    if header:
+        names, data = rows[0], rows[1:]
     else:
-        with open(path, newline="") as f:
-            reader = _csv.reader(f)
-            rows = list(reader)
-        if not rows:
-            raise ValueError(f"empty CSV: {path}")
-        if header:
-            names, data = rows[0], rows[1:]
-        else:
-            names = [f"_c{i}" for i in range(len(rows[0]))]
-            data = rows
-        columns = [[row[i] for row in data] for i in range(len(names))]
+        names = [f"_c{i}" for i in range(len(rows[0]))]
+        data = rows
+    columns = [[row[i] for row in data] for i in range(len(names))]
     if not infer:
         schema = Schema(tuple(names), tuple(ColumnType.STRING for _ in names))
         return Table(
@@ -61,15 +86,3 @@ def read_csv(path: str, header: bool = True, infer: bool = True) -> Table:
             schema,
         )
     return _columns_to_table(names, columns)
-
-
-def _try_native(path: str, header: bool):
-    """Use the C++ parser when built; fall back silently otherwise."""
-    try:
-        from har_tpu.native import csv_native
-    except Exception:
-        return None
-    try:
-        return csv_native.parse_columns(path, header)
-    except Exception:
-        return None

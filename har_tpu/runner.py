@@ -36,8 +36,16 @@ from har_tpu.train.trainer import TrainerConfig
 from har_tpu.tuning import CrossValidator, param_grid
 
 
+# trainer-only knobs that classical estimators silently ignore (the CLI
+# forwards one params dict to every model in --models)
+_TRAINER_KEYS = {f.name for f in dataclasses.fields(TrainerConfig)}
+
+
 def build_estimator(name: str, params: dict | None = None, mesh=None):
     params = dict(params or {})
+    if name in ("logistic_regression", "lr", "decision_tree", "dt",
+                "random_forest", "rf"):
+        params = {k: v for k, v in params.items() if k not in _TRAINER_KEYS}
     if name in ("logistic_regression", "lr"):
         return LogisticRegression(**params)
     if name in ("decision_tree", "dt"):
@@ -65,19 +73,34 @@ REFERENCE_GRIDS = {
 
 def load_dataset(config: RunConfig):
     path = config.data.resolved_path()
-    if config.data.dataset == "synthetic" or path is None:
+    if config.data.dataset == "synthetic":
         return synthetic_wisdm(n_rows=5418, seed=config.data.seed)
     if config.data.dataset == "wisdm":
+        if path is None:  # reference mount absent → same-shape synthetic
+            return synthetic_wisdm(n_rows=5418, seed=config.data.seed)
         return load_wisdm(path, drop_binned=config.data.drop_binned)
     if config.data.dataset == "ucihar":
-        from har_tpu.data.ucihar import load_ucihar
+        from har_tpu.data.ucihar import load_ucihar, synthetic_ucihar
 
+        if path is None:
+            return synthetic_ucihar(n_rows=2000, seed=config.data.seed)
         return load_ucihar(path)
     raise ValueError(f"unknown dataset {config.data.dataset!r}")
 
 
 def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
-    """Fit the one-hot pipeline (reference parity) or the numeric view."""
+    """Fit the one-hot pipeline (reference parity) or the numeric view.
+
+    UCI-HAR tables are already numeric (561 FEAT_* columns) and bypass the
+    WISDM-specific views entirely.
+    """
+    if config.data.dataset == "ucihar":
+        from har_tpu.data.ucihar import ucihar_feature_set
+
+        full = ucihar_feature_set(table)
+        frac = config.data.train_fraction
+        train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
+        return train, test, None
     mode = getattr(config.model, "feature_view", None) or (
         "numeric" if config.model.name in ("mlp", "cnn1d", "bilstm") else "onehot"
     )
@@ -150,9 +173,7 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
     models = models or ["logistic_regression", "decision_tree", "random_forest"]
     results = []
     for name in models:
-        est = build_estimator(
-            name, config.model.params if name == config.model.name else {}
-        )
+        est = build_estimator(name, config.model.params)
         results.append(_fit_eval(est, name, train, test, report))
         if with_cv:
             tuning = config.tuning

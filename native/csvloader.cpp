@@ -1,0 +1,257 @@
+// Native CSV loader: multithreaded parse + spark-csv type inference.
+//
+// The reference delegates CSV ingestion to the JVM (com.databricks:spark-csv
+// parsing on executors, reference Main/main.py:18-20; SURVEY §2b).  This is
+// the TPU framework's native-runtime counterpart: a C++ shared library that
+// memory-loads the file, splits it into row chunks parsed on worker threads,
+// and applies the same narrowest-type inference chain (int → double →
+// string) the Python loader implements in har_tpu/data/schema.py — the
+// PEAK columns' '?' sentinels must still infer as strings so the one-hot
+// feature space reproduces.
+//
+// C ABI only (driven from Python via ctypes; no pybind11 in this image).
+// Build: g++ -O2 -march=native -shared -fPIC -pthread csvloader.cpp
+//        -o libharcsv.so   (see har_tpu/data/native_loader.py)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum ColType : int { COL_INT = 0, COL_DOUBLE = 1, COL_STRING = 2 };
+
+struct Column {
+  std::string name;
+  ColType type = COL_INT;
+  std::vector<double> numeric;     // filled when type == COL_DOUBLE
+  std::vector<int64_t> ints;       // filled when type == COL_INT (exact
+                                   // beyond 2^53, unlike a double round-trip)
+  std::vector<std::string> text;   // always filled (source of truth)
+};
+
+struct CsvTable {
+  std::vector<Column> cols;
+  int64_t nrows = 0;
+  std::string error;
+};
+
+// --- field splitting (RFC-4180-lite: quotes + embedded commas) ----------
+void split_fields(const char* begin, const char* end,
+                  std::vector<std::string>* out) {
+  out->clear();
+  std::string cur;
+  bool quoted = false;
+  for (const char* p = begin; p < end; ++p) {
+    char c = *p;
+    if (quoted) {
+      if (c == '"') {
+        if (p + 1 < end && p[1] == '"') { cur.push_back('"'); ++p; }
+        else quoted = false;
+      } else cur.push_back(c);
+    } else if (c == '"') {
+      quoted = true;
+    } else if (c == ',') {
+      out->push_back(cur);
+      cur.clear();
+    } else if (c != '\r') {
+      cur.push_back(c);
+    }
+  }
+  out->push_back(cur);
+}
+
+bool parse_int(const std::string& s, long long* out) {
+  if (s.empty()) return false;
+  errno = 0;
+  char* endp = nullptr;
+  long long v = strtoll(s.c_str(), &endp, 10);
+  if (errno || endp != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+bool parse_double(const std::string& s, double* out) {
+  if (s.empty()) return false;
+  errno = 0;
+  char* endp = nullptr;
+  double v = strtod(s.c_str(), &endp);
+  if (errno || endp != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+struct ChunkResult {
+  std::vector<std::vector<std::string>> rows;
+  std::vector<ColType> types;  // narrowest type seen per column
+};
+
+void parse_chunk(const char* begin, const char* end, size_t ncols,
+                 ChunkResult* result) {
+  result->types.assign(ncols, COL_INT);
+  std::vector<std::string> fields;
+  const char* line = begin;
+  while (line < end) {
+    const char* nl = static_cast<const char*>(
+        memchr(line, '\n', static_cast<size_t>(end - line)));
+    const char* line_end = nl ? nl : end;
+    if (line_end > line) {
+      split_fields(line, line_end, &fields);
+      fields.resize(ncols);  // ragged rows: pad/truncate like spark-csv
+      for (size_t c = 0; c < ncols; ++c) {
+        ColType& t = result->types[c];
+        long long iv;
+        double dv;
+        if (t == COL_INT && !parse_int(fields[c], &iv)) t = COL_DOUBLE;
+        if (t == COL_DOUBLE && !parse_double(fields[c], &dv)) t = COL_STRING;
+      }
+      result->rows.push_back(fields);
+    }
+    if (!nl) break;
+    line = nl + 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+CsvTable* csv_load(const char* path, int num_threads) {
+  auto table = std::make_unique<CsvTable>();
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) {
+    table->error = std::string("cannot open ") + path;
+    return table.release();
+  }
+  std::streamsize size = f.tellg();
+  f.seekg(0);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (!f.read(buf.data(), size)) {
+    table->error = "read failed";
+    return table.release();
+  }
+
+  // header
+  const char* data = buf.data();
+  const char* end = data + buf.size();
+  const char* nl = static_cast<const char*>(memchr(data, '\n', buf.size()));
+  if (!nl) {
+    table->error = "no header line";
+    return table.release();
+  }
+  std::vector<std::string> header;
+  split_fields(data, nl, &header);
+  size_t ncols = header.size();
+  table->cols.resize(ncols);
+  for (size_t c = 0; c < ncols; ++c) table->cols[c].name = header[c];
+
+  // chunk the body on line boundaries
+  int nthreads = num_threads > 0
+      ? num_threads
+      : static_cast<int>(std::thread::hardware_concurrency());
+  if (nthreads < 1) nthreads = 1;
+  const char* body = nl + 1;
+  size_t body_len = static_cast<size_t>(end - body);
+  std::vector<const char*> starts{body};
+  for (int i = 1; i < nthreads; ++i) {
+    const char* guess = body + body_len * i / nthreads;
+    const char* next_nl = static_cast<const char*>(
+        memchr(guess, '\n', static_cast<size_t>(end - guess)));
+    starts.push_back(next_nl ? next_nl + 1 : end);
+  }
+  starts.push_back(end);
+
+  std::vector<ChunkResult> results(static_cast<size_t>(nthreads));
+  std::vector<std::thread> threads;
+  for (int i = 0; i < nthreads; ++i) {
+    threads.emplace_back(parse_chunk, starts[i], starts[i + 1], ncols,
+                         &results[static_cast<size_t>(i)]);
+  }
+  for (auto& t : threads) t.join();
+
+  // merge types (widest wins) and counts
+  std::vector<ColType> types(ncols, COL_INT);
+  int64_t nrows = 0;
+  for (const auto& r : results) {
+    nrows += static_cast<int64_t>(r.rows.size());
+    for (size_t c = 0; c < ncols; ++c)
+      if (r.types[c] > types[c]) types[c] = r.types[c];
+  }
+  table->nrows = nrows;
+
+  for (size_t c = 0; c < ncols; ++c) {
+    Column& col = table->cols[c];
+    col.type = types[c];
+    col.text.reserve(static_cast<size_t>(nrows));
+    if (col.type == COL_DOUBLE)
+      col.numeric.reserve(static_cast<size_t>(nrows));
+    else if (col.type == COL_INT)
+      col.ints.reserve(static_cast<size_t>(nrows));
+  }
+  for (const auto& r : results) {
+    for (const auto& row : r.rows) {
+      for (size_t c = 0; c < ncols; ++c) {
+        Column& col = table->cols[c];
+        col.text.push_back(row[c]);
+        if (col.type == COL_DOUBLE) {
+          double dv = 0.0;
+          parse_double(row[c], &dv);
+          col.numeric.push_back(dv);
+        } else if (col.type == COL_INT) {
+          long long iv = 0;
+          parse_int(row[c], &iv);
+          col.ints.push_back(static_cast<int64_t>(iv));
+        }
+      }
+    }
+  }
+  return table.release();
+}
+
+const char* csv_error(CsvTable* t) {
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+int csv_ncols(CsvTable* t) { return static_cast<int>(t->cols.size()); }
+int64_t csv_nrows(CsvTable* t) { return t->nrows; }
+const char* csv_colname(CsvTable* t, int c) {
+  return t->cols[static_cast<size_t>(c)].name.c_str();
+}
+int csv_coltype(CsvTable* t, int c) {
+  return t->cols[static_cast<size_t>(c)].type;
+}
+void csv_numeric(CsvTable* t, int c, double* out) {
+  const auto& v = t->cols[static_cast<size_t>(c)].numeric;
+  memcpy(out, v.data(), v.size() * sizeof(double));
+}
+void csv_ints(CsvTable* t, int c, int64_t* out) {
+  const auto& v = t->cols[static_cast<size_t>(c)].ints;
+  memcpy(out, v.data(), v.size() * sizeof(int64_t));
+}
+const char* csv_string_at(CsvTable* t, int c, int64_t row) {
+  return t->cols[static_cast<size_t>(c)].text[static_cast<size_t>(row)]
+      .c_str();
+}
+// Bulk extraction: NUL-joined bytes for one string column, so Python makes
+// one ctypes call + one bytes.split instead of nrows round trips.
+int64_t csv_string_col_bytes(CsvTable* t, int c) {
+  int64_t total = 0;
+  for (const auto& s : t->cols[static_cast<size_t>(c)].text)
+    total += static_cast<int64_t>(s.size()) + 1;
+  return total;
+}
+void csv_string_col_packed(CsvTable* t, int c, char* out) {
+  for (const auto& s : t->cols[static_cast<size_t>(c)].text) {
+    memcpy(out, s.data(), s.size());
+    out += s.size();
+    *out++ = '\0';
+  }
+}
+void csv_free(CsvTable* t) { delete t; }
+
+}  // extern "C"

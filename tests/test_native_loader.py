@@ -1,0 +1,54 @@
+"""Native C++ CSV loader: equivalence with the Python path."""
+
+import numpy as np
+import pytest
+
+from har_tpu.data.csv_loader import read_csv
+from har_tpu.data.native_loader import native_available
+
+from tests.conftest import requires_wisdm
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="C++ toolchain unavailable"
+)
+
+
+def _assert_tables_equal(a, b):
+    assert a.schema == b.schema
+    for name in a.column_names:
+        x, y = a[name], b[name]
+        if x.dtype == object:
+            assert (x == y).all(), name
+        else:
+            np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+def test_native_matches_python_small(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "id,val,peak,name\n"
+        "1,2.5,100,alpha\n"
+        "2,3,?,beta\n"          # '?' forces peak column to string
+        '3,-1e3,250,"a,b"\n'    # quoted comma
+        "4,0.125,50,gamma\n"
+    )
+    tn = read_csv(str(p), engine="native")
+    tp = read_csv(str(p), engine="python")
+    _assert_tables_equal(tn, tp)
+    assert tn.schema.type_of("id").value == "int"
+    assert tn.schema.type_of("val").value == "double"
+    assert tn.schema.type_of("peak").value == "string"  # '?' sentinel
+    assert tn["name"][2] == "a,b"
+
+
+def test_native_missing_file_raises():
+    with pytest.raises(FileNotFoundError):
+        read_csv("/nonexistent/x.csv", engine="native")
+
+
+@requires_wisdm
+def test_native_matches_python_wisdm(wisdm_csv_path):
+    tn = read_csv(wisdm_csv_path, engine="native")
+    tp = read_csv(wisdm_csv_path, engine="python")
+    _assert_tables_equal(tn, tp)
+    assert len(tn) == 5418
