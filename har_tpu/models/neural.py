@@ -105,10 +105,17 @@ class BiLSTM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def _transformer(**kwargs):
+    from har_tpu.models.transformer import Transformer1D
+
+    return Transformer1D(**kwargs)
+
+
 MODEL_REGISTRY = {
     "mlp": MLP,
     "cnn1d": CNN1D,
     "bilstm": BiLSTM,
+    "transformer": _transformer,
 }
 
 

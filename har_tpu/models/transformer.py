@@ -1,0 +1,106 @@
+"""Transformer encoder classifier over raw accelerometer windows.
+
+A 4th neural family member (beyond MLP/CNN/BiLSTM) and the carrier for
+long-context support: constructed with ``sp_axis=None`` it's an ordinary
+single-device encoder; constructed with ``sp_axis="sp"`` (inside a
+`shard_map` whose inputs shard the sequence dim over that axis) every
+attention layer runs ring attention (har_tpu.parallel.ring_attention),
+positions are offset by the shard index, and the final mean-pool reduces
+over the ring — bit-for-bit the same function, sequence-parallel.
+
+Both constructions share one parameter pytree, so a model trained
+single-device serves sequence-parallel and vice versa (tested).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from har_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+def sinusoidal_positions(t: int, dim: int, offset) -> jax.Array:
+    """Standard sin/cos positional encoding, positions offset (traced ok)."""
+    pos = jnp.arange(t, dtype=jnp.float32) + offset
+    half = dim // 2
+    freqs = jnp.exp(
+        -jnp.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    angles = pos[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype
+    sp_axis: str | None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        b, t, e = x.shape
+        h = self.num_heads
+        head_dim = e // h
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * e, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, head_dim)
+        k = k.reshape(b, t, h, head_dim)
+        v = v.reshape(b, t, h, head_dim)
+        if self.sp_axis is None:
+            attn = full_attention(q, k, v)
+        else:
+            attn = ring_attention(q, k, v, self.sp_axis)
+        attn = attn.reshape(b, t, e)
+        x = x + nn.Dense(e, dtype=self.dtype, name="proj")(attn)
+
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(4 * e, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(e, dtype=self.dtype)(y)
+        return x + y
+
+
+class Transformer1D(nn.Module):
+    """Encoder classifier: (B, T, C) raw windows → (B, num_classes)."""
+
+    num_classes: int = 6
+    embed_dim: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    sp_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        b, t, _ = x.shape
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, name="embed")(x)
+        if self.sp_axis is None:
+            offset = 0.0
+        else:  # global position = shard index × local block length
+            offset = (jax.lax.axis_index(self.sp_axis) * t).astype(
+                jnp.float32
+            )
+        x = x + sinusoidal_positions(t, self.embed_dim, offset).astype(
+            self.dtype
+        )
+        for _ in range(self.num_layers):
+            x = EncoderBlock(
+                self.num_heads, self.dtype, self.sp_axis
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        pooled = x.mean(axis=1)
+        if self.sp_axis is not None:
+            # local mean → global mean (equal-size shards around the ring)
+            pooled = jax.lax.pmean(pooled, self.sp_axis)
+        pooled = nn.Dropout(self.dropout_rate, deterministic=not train)(
+            pooled
+        )
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
+            pooled
+        )
+        return logits.astype(jnp.float32)

@@ -54,3 +54,24 @@ def single_device_mesh(device=None) -> Mesh:
     """A 1×1 mesh — lets every code path be mesh-shaped even on one chip."""
     device = device or jax.devices()[0]
     return create_mesh(dp=1, tp=1, devices=[device])
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host SPMD bootstrap (call once per host before building a mesh).
+
+    The Spark equivalent is the cluster master URL + executor registration
+    (reference Main/main.py:8, README.md:5-8); here every host runs this
+    and the same program, after which `jax.devices()` spans the whole pod
+    and XLA routes collectives over ICI within a slice / DCN across
+    slices.  Arguments default to the TPU metadata environment (on Cloud
+    TPU pods `jax.distributed.initialize()` autodetects everything).
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,76 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support for the neural family.  The sequence dimension is
+sharded over the ``sp`` mesh axis; each device holds a Q/K/V block.  K/V
+blocks rotate around the ring with `lax.ppermute` while every device
+accumulates its Q-block's attention with the numerically-stable streaming
+softmax (flash-attention style running max / numerator / denominator), so
+the result is *exact* full attention — only ever materializing
+(Tq/sp × Tk/sp) score blocks — and the K/V transfers overlap compute
+around the ICI ring.
+
+The reference has nothing comparable (its sequence dim is pre-collapsed,
+SURVEY §5.7); this is a new capability the TPU design makes first-class.
+
+Layout: (batch, seq, heads, head_dim) — batch can additionally be sharded
+over ``dp`` (the two axes compose; see tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Reference O(T²) attention, (B, T, H, D) layout, no masking."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Must be called inside `shard_map` (or `pmap`) with q/k/v holding the
+    *local* sequence block, shape (B, T_local, H, D).  Returns the local
+    block of the attention output, same shape.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, t_q, h, d = q.shape
+
+    # ring: shard i sends to shard (i+1) — after `axis_size` steps every
+    # device has seen every K/V block
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, _):
+        k_blk, v_blk, m, num, den = carry
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # (B,H,Tq,Tk)
+        blk_max = s.max(axis=-1)  # (B,H,Tq)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)  # rescale old accumulators
+        p = jnp.exp(s - new_m[..., None])  # (B,H,Tq,Tk)
+        num = num * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk
+        )
+        den = den * corr + p.sum(axis=-1)
+        k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_blk, v_blk, new_m, num, den), None
+
+    m0 = jnp.full((b, h, t_q), -jnp.inf, q.dtype)
+    num0 = jnp.zeros((b, h, t_q, d), q.dtype)
+    den0 = jnp.zeros((b, h, t_q), q.dtype)
+    (_, _, m, num, den), _ = jax.lax.scan(
+        step, (k, v, m0, num0, den0), None, length=axis_size
+    )
+    out = num / den[..., None]  # (B,H,Tq,D)
+    return out.transpose(0, 2, 1, 3)  # (B,Tq,H,D)
