@@ -32,13 +32,16 @@ REFERENCE_BEST_ACCURACY = 0.7305  # DecisionTree, additional_param.csv:3
 
 
 def load_table():
+    """One CSV parse serves every lane: the feature views and the one-hot
+    pipeline each select only the columns they name, so keeping the 30
+    binned columns here costs nothing downstream."""
     from har_tpu.config import DataConfig
     from har_tpu.data.synthetic import synthetic_wisdm
     from har_tpu.data.wisdm import load_wisdm
 
     path = DataConfig().resolved_path()
     if path is not None:
-        return load_wisdm(path)
+        return load_wisdm(path, drop_binned=False)
     return synthetic_wisdm(n_rows=5418, seed=2018)
 
 
@@ -79,6 +82,27 @@ def main() -> None:
     train = FeatureSet(features=x[tr], label=y[tr])
     test = FeatureSet(features=x[te], label=y[te])
 
+    # accuracy lane: GBDT on the full 43-feature numeric view (the
+    # reference drops the 30 histogram-bin columns at Main/main.py:22-26;
+    # keeping them + boosted trees is the best real-data accuracy here)
+    from har_tpu.models.gbdt import GradientBoostedTreesClassifier
+
+    has_bins = "X0" in table.column_names
+    fx, _ = numeric_feature_view(table, include_binned=has_bins)
+    gb_train = FeatureSet(features=fx[tr], label=y[tr])
+    gb_test = FeatureSet(features=fx[te], label=y[te])
+    gb_est = GradientBoostedTreesClassifier(
+        num_rounds=300, max_depth=5, learning_rate=0.1,
+        subsample=0.8, max_bins=128,
+    )
+    gb_est.fit(gb_train)  # warmup: compile the scanned boosting program
+    t0 = time.perf_counter()
+    gb_model = gb_est.fit(gb_train)
+    gb_time = time.perf_counter() - t0
+    gb_acc = evaluate(gb_test.label, gb_model.transform(gb_test).raw, 6)[
+        "accuracy"
+    ]
+
     epochs = 150
     est = NeuralClassifier(
         "mlp",
@@ -115,6 +139,9 @@ def main() -> None:
             "mlp_train_time_s": round(train_time, 4),
             "mlp_epochs": epochs,
             "mlp_test_accuracy": round(acc, 4),
+            "gbdt_test_accuracy": round(gb_acc, 4),
+            "gbdt_train_time_s": round(gb_time, 4),
+            "best_test_accuracy": round(max(acc, gb_acc), 4),
             "reference_best_accuracy": REFERENCE_BEST_ACCURACY,
             "lr_parity_train_time_s": round(lr_time, 4),
             "lr_parity_windows_per_sec": round(len(lr_train) / lr_time, 1),

@@ -1,4 +1,8 @@
 from har_tpu.models.base import Predictions, Classifier, ClassifierModel
+from har_tpu.models.gbdt import (
+    GradientBoostedTreesClassifier,
+    GradientBoostedTreesModel,
+)
 from har_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
@@ -8,6 +12,8 @@ __all__ = [
     "Predictions",
     "Classifier",
     "ClassifierModel",
+    "GradientBoostedTreesClassifier",
+    "GradientBoostedTreesModel",
     "LogisticRegression",
     "LogisticRegressionModel",
 ]

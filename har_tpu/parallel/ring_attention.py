@@ -54,23 +54,34 @@ def ring_attention(
 
     def step(carry, _):
         k_blk, v_blk, m, num, den = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale  # (B,H,Tq,Tk)
+        # scores + streaming-softmax state accumulate in f32 regardless of
+        # the input dtype (flash-attention convention): bf16 running
+        # max/num/den would compound rounding error every ring step
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            q,
+            k_blk,
+            preferred_element_type=jnp.float32,
+        ) * scale  # (B,H,Tq,Tk)
         blk_max = s.max(axis=-1)  # (B,H,Tq)
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)  # rescale old accumulators
         p = jnp.exp(s - new_m[..., None])  # (B,H,Tq,Tk)
         num = num * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk
+            "bhqk,bkhd->bhqd",
+            p,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
         )
         den = den * corr + p.sum(axis=-1)
         k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
         return (k_blk, v_blk, new_m, num, den), None
 
-    m0 = jnp.full((b, h, t_q), -jnp.inf, q.dtype)
-    num0 = jnp.zeros((b, h, t_q, d), q.dtype)
-    den0 = jnp.zeros((b, h, t_q), q.dtype)
+    m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t_q), jnp.float32)
     (_, _, m, num, den), _ = jax.lax.scan(
         step, (k, v, m0, num0, den0), None, length=axis_size
     )
-    out = num / den[..., None]  # (B,H,Tq,D)
+    out = (num / den[..., None]).astype(q.dtype)  # (B,H,Tq,D)
     return out.transpose(0, 2, 1, 3)  # (B,Tq,H,D)

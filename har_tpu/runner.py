@@ -27,6 +27,7 @@ from har_tpu.features.wisdm_pipeline import (
     make_feature_set,
 )
 from har_tpu.models.forest import RandomForestClassifier
+from har_tpu.models.gbdt import GradientBoostedTreesClassifier
 from har_tpu.models.logistic_regression import LogisticRegression
 from har_tpu.models.neural_classifier import NeuralClassifier
 from har_tpu.models.tree import DecisionTreeClassifier
@@ -44,7 +45,7 @@ _TRAINER_KEYS = {f.name for f in dataclasses.fields(TrainerConfig)}
 def build_estimator(name: str, params: dict | None = None, mesh=None):
     params = dict(params or {})
     if name in ("logistic_regression", "lr", "decision_tree", "dt",
-                "random_forest", "rf"):
+                "random_forest", "rf", "gbdt", "gbt"):
         params = {k: v for k, v in params.items() if k not in _TRAINER_KEYS}
     if name in ("logistic_regression", "lr"):
         return LogisticRegression(**params)
@@ -52,6 +53,8 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
         return DecisionTreeClassifier(**params)
     if name in ("random_forest", "rf"):
         return RandomForestClassifier(**params)
+    if name in ("gbdt", "gbt"):
+        return GradientBoostedTreesClassifier(**params)
     if name in ("mlp", "cnn1d", "bilstm"):
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
         cfg = TrainerConfig(
@@ -102,12 +105,21 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
         return train, test, None
     mode = getattr(config.model, "feature_view", None) or (
-        "numeric" if config.model.name in ("mlp", "cnn1d", "bilstm") else "onehot"
+        "numeric"
+        if config.model.name in ("mlp", "cnn1d", "bilstm", "gbdt", "gbt")
+        else "onehot"
     )
     if mode == "numeric":
+        from har_tpu.data.wisdm import BINNED_COLUMNS
         from har_tpu.features.string_indexer import StringIndexer
 
-        x, _ = numeric_feature_view(table)
+        # GBDT uses the 30 histogram-bin columns when the loader kept them
+        # (its best-accuracy view); the neural models keep the stable
+        # 13-dim view so checkpoints don't silently change input width.
+        has_bins = config.model.name in ("gbdt", "gbt") and all(
+            c in table.column_names for c in BINNED_COLUMNS
+        )
+        x, _ = numeric_feature_view(table, include_binned=has_bins)
         y = np.asarray(
             StringIndexer("ACTIVITY", "label")
             .fit(table)
