@@ -18,7 +18,13 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from har_tpu.ops.flash_attention import flash_attention, pick_block
 from har_tpu.parallel.ring_attention import full_attention, ring_attention
+
+# sequence length at which fused attention starts paying for itself (the
+# unfused path's (B,H,T,T) f32 score tensor reaches HBM scale; it OOMs a
+# 16G chip around T=8192)
+_FLASH_AUTO_T = 2048
 
 
 def sinusoidal_positions(t: int, dim: int, offset) -> jax.Array:
@@ -36,6 +42,10 @@ class EncoderBlock(nn.Module):
     num_heads: int
     dtype: jnp.dtype
     sp_axis: str | None
+    # None = auto: Pallas flash attention for T >= _FLASH_AUTO_T (where
+    # XLA's unfused path materializes (B,H,T,T) scores in HBM and OOMs by
+    # T=8192); plain XLA below it (faster at short T, same numerics family)
+    use_flash: bool | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -49,10 +59,21 @@ class EncoderBlock(nn.Module):
         q = q.reshape(b, t, h, head_dim)
         k = k.reshape(b, t, h, head_dim)
         v = v.reshape(b, t, h, head_dim)
-        if self.sp_axis is None:
-            attn = full_attention(q, k, v)
-        else:
+        if self.sp_axis is not None:
             attn = ring_attention(q, k, v, self.sp_axis)
+        else:
+            flash = (
+                t >= _FLASH_AUTO_T
+                if self.use_flash is None
+                else self.use_flash
+            )
+            block = pick_block(t) if flash else 0
+            if block:
+                attn = flash_attention(
+                    q, k, v, block_q=block, block_k=block
+                )
+            else:
+                attn = full_attention(q, k, v)
         attn = attn.reshape(b, t, e)
         x = x + nn.Dense(e, dtype=self.dtype, name="proj")(attn)
 
@@ -73,6 +94,7 @@ class Transformer1D(nn.Module):
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
     sp_axis: str | None = None
+    use_flash: bool | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -90,7 +112,7 @@ class Transformer1D(nn.Module):
         )
         for _ in range(self.num_layers):
             x = EncoderBlock(
-                self.num_heads, self.dtype, self.sp_axis
+                self.num_heads, self.dtype, self.sp_axis, self.use_flash
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         pooled = x.mean(axis=1)

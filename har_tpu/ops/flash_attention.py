@@ -1,0 +1,138 @@
+"""Fused flash-attention forward as a Pallas TPU kernel.
+
+The Transformer1D encoder (BASELINE.json's raw-window configs) spends its
+attention FLOPs in `full_attention` (har_tpu/parallel/ring_attention.py),
+which materializes the (B, H, T, T) score tensor in HBM.  This kernel is
+the fused alternative: per (batch×head, q-block) grid step it streams K/V
+blocks through VMEM with the running-max/numerator/denominator softmax, so
+scores never leave on-chip memory and the matmuls land on the MXU.
+
+Scope: bidirectional (no causal mask — sensor windows are encoders, not
+decoders), f32 accumulators regardless of input dtype, forward-only kernel
+with a `jax.custom_vjp` whose backward is the standard XLA recompute —
+training works everywhere, the kernel accelerates the forward path.
+
+Falls back to interpret mode off-TPU (the CPU test mesh), and callers
+should fall back to `full_attention` when T has no usable block divisor
+(see `pick_block`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def pick_block(t: int, max_block: int = 256) -> int:
+    """Largest divisor of ``t`` that is ≤ max_block (kernel needs uniform
+    blocks; returns 0 when only degenerate divisors exist)."""
+    best = 0
+    for b in range(1, min(t, max_block) + 1):
+        if t % b == 0:
+            best = b
+    return best if best >= 8 or best == t else 0
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float):
+    q = q_ref[0].astype(jnp.float32) * scale  # (TQ, D)
+    t = k_ref.shape[1]
+    n_kb = t // block_k
+    tq, d = q.shape
+
+    # all softmax state is kept 2-D (TQ, 1): 1-D vectors map poorly onto
+    # the (sublane, lane) layout and miscompile reductions on some Mosaic
+    # versions — 2-D keepdims reductions are the supported path
+    def body(j, carry):
+        m, num, den = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (TQ, TK)
+        blk_max = s.max(axis=-1, keepdims=True)  # (TQ, 1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        num = num * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        den = den * corr + p.sum(axis=-1, keepdims=True)
+        return new_m, num, den
+
+    m0 = jnp.full((tq, 1), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((tq, d), jnp.float32)
+    den0 = jnp.zeros((tq, 1), jnp.float32)
+    m, num, den = jax.lax.fori_loop(0, n_kb, body, (m0, num0, den0))
+    o_ref[0] = (num / den).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k"))
+def _flash_bht(q, k, v, block_q: int, block_k: int):
+    """(BH, T, D) fused attention."""
+    bh, t, d = q.shape
+    scale = d**-0.5
+    kernel = functools.partial(_flash_kernel, block_k=block_k, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=jax.default_backend() != "tpu",
+    )(q, k, v)
+
+
+def _attention_reference(q, k, v):
+    """XLA attention on (B, T, H, D), f32 internally — the vjp recompute."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128):
+    """Fused attention, (B, T, H, D) layout, bidirectional.
+
+    ``block_q``/``block_k`` must divide T (use `pick_block`); gradients
+    flow via an XLA-recompute backward, so this drop-in replaces
+    `full_attention` under `jax.grad`.
+    """
+    b, t, h, d = q.shape
+    if t % block_q or t % block_k:
+        # a non-dividing block would silently attend over only
+        # (t // block) * block positions — refuse loudly instead
+        raise ValueError(
+            f"block_q={block_q}/block_k={block_k} must divide T={t} "
+            "(use pick_block)"
+        )
+    to_bht = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash_bht(to_bht(q), to_bht(k), to_bht(v), block_q, block_k)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, block_q, block_k):
+    return flash_attention(q, k, v, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(_attention_reference, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
