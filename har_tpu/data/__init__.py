@@ -4,8 +4,12 @@ from har_tpu.data.csv_loader import read_csv
 from har_tpu.data.split import random_split
 from har_tpu.data.wisdm import load_wisdm, WISDM_NUMERIC_COLUMNS, WISDM_CATEGORICAL_COLUMNS
 from har_tpu.data.synthetic import synthetic_wisdm
+from har_tpu.data.raw_loader import RawStream, load_raw_stream, stream_windows
 
 __all__ = [
+    "RawStream",
+    "load_raw_stream",
+    "stream_windows",
     "ColumnType",
     "Schema",
     "infer_schema",

@@ -1,0 +1,76 @@
+"""Shared g++-build-and-load scaffolding for the native C++ libraries.
+
+Each native component (csvloader, rawloader) is a single translation unit
+with a plain C ABI, compiled on first use and cached next to its source
+(pybind11 isn't in this image, so callers bind symbols via ctypes).  This
+module owns the build/staleness/locking logic so the per-library bridges
+only declare their symbol tables.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable
+
+
+class NativeLib:
+    """Lazily built, process-cached ctypes library handle."""
+
+    def __init__(
+        self,
+        src: str,
+        so: str,
+        configure: Callable[[ctypes.CDLL], None],
+        extra_flags: tuple[str, ...] = (),
+    ):
+        self._src = src
+        self._so = so
+        self._configure = configure
+        self._extra_flags = extra_flags
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self.build_error: str | None = None
+
+    def _build(self) -> str | None:
+        """Compile if stale; returns an error string or None."""
+        try:
+            if os.path.exists(self._so) and os.path.getmtime(
+                self._so
+            ) >= os.path.getmtime(self._src):
+                return None
+        except OSError as e:  # source missing alongside a shipped .so
+            if os.path.exists(self._so):
+                return None
+            return f"native source unavailable: {e}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            *self._extra_flags, self._src, "-o", self._so,
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return f"g++ unavailable: {e}"
+        if proc.returncode != 0:
+            return f"native build failed: {proc.stderr[-500:]}"
+        return None
+
+    def load(self) -> ctypes.CDLL | None:
+        with self._lock:
+            if self._lib is not None or self.build_error is not None:
+                return self._lib
+            err = self._build()
+            if err is not None:
+                self.build_error = err
+                return None
+            lib = ctypes.CDLL(self._so)
+            self._configure(lib)
+            self._lib = lib
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

@@ -12,11 +12,10 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
 
+from har_tpu.data._native_build import NativeLib
 from har_tpu.data.schema import ColumnType, Schema
 from har_tpu.data.table import Table
 
@@ -24,92 +23,63 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )
-_SRC = os.path.join(_NATIVE_DIR, "csvloader.cpp")
-_SO = os.path.join(_NATIVE_DIR, "libharcsv.so")
-
-_lock = threading.Lock()
-_lib = None
-_build_error: str | None = None
 
 
-def _build() -> str | None:
-    """Compile the shared library if stale; returns error string or None."""
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return None
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", _SO,
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.csv_load.restype = ctypes.c_void_p
+    lib.csv_load.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.csv_error.restype = ctypes.c_char_p
+    lib.csv_error.argtypes = [ctypes.c_void_p]
+    lib.csv_ncols.restype = ctypes.c_int
+    lib.csv_ncols.argtypes = [ctypes.c_void_p]
+    lib.csv_nrows.restype = ctypes.c_int64
+    lib.csv_nrows.argtypes = [ctypes.c_void_p]
+    lib.csv_colname.restype = ctypes.c_char_p
+    lib.csv_colname.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.csv_coltype.restype = ctypes.c_int
+    lib.csv_coltype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.csv_numeric.restype = None
+    lib.csv_numeric.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double),
     ]
-    try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=120
-        )
-    except (OSError, subprocess.TimeoutExpired) as e:
-        return f"g++ unavailable: {e}"
-    if proc.returncode != 0:
-        return f"native build failed: {proc.stderr[-500:]}"
-    return None
+    lib.csv_ints.restype = None
+    lib.csv_ints.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.csv_string_at.restype = ctypes.c_char_p
+    lib.csv_string_at.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.csv_string_col_bytes.restype = ctypes.c_int64
+    lib.csv_string_col_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.csv_string_col_packed.restype = None
+    lib.csv_string_col_packed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.csv_free.restype = None
+    lib.csv_free.argtypes = [ctypes.c_void_p]
 
 
-def _load_lib():
-    global _lib, _build_error
-    with _lock:
-        if _lib is not None or _build_error is not None:
-            return _lib
-        err = _build()
-        if err is not None:
-            _build_error = err
-            return None
-        lib = ctypes.CDLL(_SO)
-        lib.csv_load.restype = ctypes.c_void_p
-        lib.csv_load.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.csv_error.restype = ctypes.c_char_p
-        lib.csv_error.argtypes = [ctypes.c_void_p]
-        lib.csv_ncols.restype = ctypes.c_int
-        lib.csv_ncols.argtypes = [ctypes.c_void_p]
-        lib.csv_nrows.restype = ctypes.c_int64
-        lib.csv_nrows.argtypes = [ctypes.c_void_p]
-        lib.csv_colname.restype = ctypes.c_char_p
-        lib.csv_colname.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.csv_coltype.restype = ctypes.c_int
-        lib.csv_coltype.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.csv_numeric.restype = None
-        lib.csv_numeric.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        lib.csv_ints.restype = None
-        lib.csv_ints.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.csv_string_at.restype = ctypes.c_char_p
-        lib.csv_string_at.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
-        ]
-        lib.csv_string_col_bytes.restype = ctypes.c_int64
-        lib.csv_string_col_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.csv_string_col_packed.restype = None
-        lib.csv_string_col_packed.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
-        ]
-        lib.csv_free.restype = None
-        lib.csv_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+_NATIVE = NativeLib(
+    src=os.path.join(_NATIVE_DIR, "csvloader.cpp"),
+    so=os.path.join(_NATIVE_DIR, "libharcsv.so"),
+    configure=_configure,
+)
 
 
 def native_available() -> bool:
-    return _load_lib() is not None
+    return _NATIVE.available()
 
 
 _CTYPE_MAP = {0: ColumnType.INT, 1: ColumnType.DOUBLE, 2: ColumnType.STRING}
 
 
 def read_csv_native(path: str, num_threads: int = 0) -> Table:
-    lib = _load_lib()
+    lib = _NATIVE.load()
     if lib is None:
-        raise RuntimeError(f"native loader unavailable: {_build_error}")
+        raise RuntimeError(f"native loader unavailable: {_NATIVE.build_error}")
     handle = lib.csv_load(path.encode(), num_threads)
     try:
         err = lib.csv_error(handle)

@@ -1,0 +1,99 @@
+"""Raw WISDM stream parser: native/python equivalence + windowing."""
+
+import numpy as np
+import pytest
+
+from har_tpu.data.raw_loader import (
+    load_raw_stream,
+    native_available,
+    read_raw_python,
+    stream_windows,
+)
+
+
+def _write_raw(path, n_per_bout=450, seed=0):
+    """Synthetic raw file in the WISDM v1.1 text format, with quirks."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    bouts = [
+        (33, "Jogging"), (33, "Walking"), (17, "Walking"), (17, "Sitting"),
+    ]
+    ts = 49105962326000
+    for uid, act in bouts:
+        for _ in range(n_per_bout):
+            x, y, z = rng.normal(0, 5, 3)
+            lines.append(f"{uid},{act},{ts},{x:.2f},{y:.2f},{z:.2f};")
+            ts += 50_000_000
+    # quirks seen in the public file: blank records, malformed rows,
+    # multiple records on one physical line
+    text = "\n".join(lines[:10]) + "\n"
+    text += lines[10] + lines[11] + "\n"       # two records, one line
+    text += ";;\n"                              # empty records
+    text += "33,Jogging,,0.1,0.2;\n"            # wrong field count → skip
+    text += "33,Jogging,12,a,b,c;\n"            # unparsable floats → skip
+    text += "\n".join(lines[12:]) + "\n"
+    # tolerance parity with Python int()/float(): padded fields + subnormal
+    text += "17,Sitting, 12 ,1e-42, 0.5 ,-3;\n"
+    path.write_text(text)
+    return len(lines) + 1, 2  # valid records, skipped records
+
+
+def test_python_parser_semantics(tmp_path):
+    p = tmp_path / "raw.txt"
+    n_valid, n_skip = _write_raw(p)
+    s = read_raw_python(str(p))
+    assert len(s) == n_valid
+    assert s.skipped == n_skip
+    assert s.activity_names == ("Jogging", "Walking", "Sitting")
+    assert s.xyz.shape == (n_valid, 3)
+    assert s.user[0] == 33 and s.user[-1] == 17
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="C++ toolchain unavailable"
+)
+def test_native_matches_python(tmp_path):
+    p = tmp_path / "raw.txt"
+    _write_raw(p, n_per_bout=700, seed=3)
+    sn = load_raw_stream(str(p), engine="native")
+    sp = load_raw_stream(str(p), engine="python")
+    assert len(sn) == len(sp)
+    assert sn.skipped == sp.skipped
+    assert sn.activity_names == sp.activity_names
+    np.testing.assert_array_equal(sn.user, sp.user)
+    np.testing.assert_array_equal(sn.activity, sp.activity)
+    np.testing.assert_array_equal(sn.timestamp, sp.timestamp)
+    np.testing.assert_allclose(sn.xyz, sp.xyz, rtol=1e-6)
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="C++ toolchain unavailable"
+)
+def test_native_missing_file_raises():
+    with pytest.raises(FileNotFoundError):
+        load_raw_stream("/nonexistent/raw.txt", engine="native")
+
+
+def test_stream_windows_respects_bouts(tmp_path):
+    p = tmp_path / "raw.txt"
+    _write_raw(p, n_per_bout=450)
+    s = read_raw_python(str(p))
+    ds = stream_windows(s, window=200, step=200)
+    # each 450-sample bout yields 2 windows of 200; 4 bouts → 8 windows
+    assert ds.windows.shape == (8, 200, 3)
+    # labels follow the bout activity ids (Jogging=0, Walking=1, Sitting=2)
+    np.testing.assert_array_equal(ds.labels, [0, 0, 1, 1, 1, 1, 2, 2])
+
+
+def test_stream_windows_to_features(tmp_path):
+    """Raw text → windows → jitted 43-feature transform, end to end."""
+    from har_tpu.features.raw_features import extract_features
+
+    p = tmp_path / "raw.txt"
+    _write_raw(p)
+    ds = stream_windows(read_raw_python(str(p)), window=200)
+    feats = np.asarray(extract_features(ds.windows))
+    assert feats.shape == (len(ds), 43)
+    assert np.isfinite(feats).all()
+    # histogram fractions (first 30 cols) each sum to 1 per axis
+    np.testing.assert_allclose(feats[:, :10].sum(axis=1), 1.0, rtol=1e-5)
