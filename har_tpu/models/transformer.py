@@ -63,7 +63,10 @@ class EncoderBlock(nn.Module):
             attn = ring_attention(q, k, v, self.sp_axis)
         else:
             flash = (
-                t >= _FLASH_AUTO_T
+                # auto mode requires a real TPU: off-TPU the Pallas kernel
+                # runs in interpret mode, which is serial and far slower
+                # than XLA's fused attention
+                t >= _FLASH_AUTO_T and jax.default_backend() == "tpu"
                 if self.use_flash is None
                 else self.use_flash
             )

@@ -96,6 +96,7 @@ def _gini(counts: jax.Array) -> jax.Array:
         "max_bins",
         "min_instances",
         "features_per_split",
+        "use_pallas_hist",
     ),
 )
 def _grow_tree(
@@ -109,6 +110,7 @@ def _grow_tree(
     max_bins: int,
     min_instances: int = 1,
     features_per_split: int = 0,  # 0 → all features (DT); >0 → RF subset
+    use_pallas_hist: bool = False,
 ):
     n, d = bins.shape
     n_nodes = 2 ** (max_depth + 1) - 1
@@ -129,9 +131,17 @@ def _grow_tree(
     # histogram into a single MXU matmul per level instead of a giant
     # scatter-add: 0/1 and small-integer weights are exact in bf16 and the
     # matmul accumulates in f32, so the counts are exact.
-    bins_onehot = jax.nn.one_hot(
-        bins, max_bins, dtype=jnp.bfloat16
-    ).reshape(n, d * max_bins)
+    # With use_pallas_hist the indicator is never materialized at all: the
+    # fused kernel (har_tpu.ops.pallas_hist) expands bin ids to the
+    # indicator tile-by-tile in VMEM — at the reference's 3,100-dim one-hot
+    # space the HBM one-hot is ~1 GB, the kernel's working set is ~10 MB.
+    bins_onehot = (
+        None
+        if use_pallas_hist
+        else jax.nn.one_hot(bins, max_bins, dtype=jnp.bfloat16).reshape(
+            n, d * max_bins
+        )
+    )
 
     def grow_level(level, carry):
         feature, threshold, node_counts, node_of_row = carry
@@ -144,20 +154,26 @@ def _grow_tree(
 
         # histogram: (level_width, d, B, C) as (W*C, n) @ (n, d*B) on the MXU
         w = jnp.where(valid, weights, 0.0)
+        m_dtype = jnp.float32 if use_pallas_hist else jnp.bfloat16
         m = (
             jax.nn.one_hot(
                 local * num_classes + y,
                 level_width * num_classes,
-                dtype=jnp.bfloat16,
+                dtype=m_dtype,
             )
-            * w[:, None].astype(jnp.bfloat16)
+            * w[:, None].astype(m_dtype)
         )
-        hist = jax.lax.dot_general(
-            m,
-            bins_onehot,
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (W*C, d*B)
+        if use_pallas_hist:
+            from har_tpu.ops.pallas_hist import hist_matmul
+
+            hist = hist_matmul(bins, m, max_bins)  # (W*C, d*B)
+        else:
+            hist = jax.lax.dot_general(
+                m,
+                bins_onehot,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (W*C, d*B)
         hist = (
             hist.reshape(level_width, num_classes, d, max_bins)
             .transpose(0, 2, 3, 1)
@@ -271,6 +287,10 @@ class DecisionTreeClassifier:
     max_bins: int = 32
     min_instances_per_node: int = 1
     num_classes: int | None = None
+    # None = auto: the fused Pallas histogram on TPU (no HBM one-hot
+    # indicator), the XLA one-hot matmul elsewhere (the kernel would run
+    # in slow interpret mode off-TPU)
+    use_pallas_hist: bool | None = None
 
     def copy_with(self, **params) -> "DecisionTreeClassifier":
         return dataclasses.replace(self, **params)
@@ -298,6 +318,11 @@ class DecisionTreeClassifier:
             max_depth=self.max_depth,
             max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
+            use_pallas_hist=(
+                jax.default_backend() == "tpu"
+                if self.use_pallas_hist is None
+                else self.use_pallas_hist
+            ),
         )
         return DecisionTreeModel(
             tree=TreeArrays(

@@ -9,8 +9,11 @@ scores never leave on-chip memory and the matmuls land on the MXU.
 
 Scope: bidirectional (no causal mask — sensor windows are encoders, not
 decoders), f32 accumulators regardless of input dtype, forward-only kernel
-with a `jax.custom_vjp` whose backward is the standard XLA recompute —
-training works everywhere, the kernel accelerates the forward path.
+with a `jax.custom_vjp`.  The backward is the fused XLA recompute for
+short T and a chunked flash-style backward (`lax.scan` over key blocks,
+online-logsumexp renormalization, O(T·block) memory) past `_BWD_FULL_T`,
+so neither direction materializes the (B, H, T, T) score tensor at the
+lengths where it would dominate HBM.
 
 Falls back to interpret mode off-TPU (the CPU test mesh), and callers
 should fall back to `full_attention` when T has no usable block divisor
@@ -104,6 +107,84 @@ def _attention_reference(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
+# below this T the full-recompute backward (one fused XLA attention vjp) is
+# fastest and its (B,H,T,T) scores are small; above it the chunked backward
+# keeps memory at O(T·block) so training stays feasible at the lengths the
+# forward kernel exists for
+_BWD_FULL_T = 1024
+
+
+def _chunked_attention_bwd(q, k, v, out, g, block_k: int):
+    """Flash-style backward: O(T·block) memory, never materializes scores.
+
+    Standard decomposition (dV = Pᵀ dO; dS = P ∘ (dP − D) with
+    D = rowsum(dO ∘ O); dQ/dK from dS) evaluated per key block under
+    `lax.scan`, with the softmax normalizer recomputed by an online
+    logsumexp pass — the same recurrence the forward kernel runs.
+    All inputs (B, T, H, D); f32 internally; returns grads in input dtype.
+    """
+    in_dtype = q.dtype
+    bhtd = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
+    qh, kh, vh, oh, gh = map(bhtd, (q, k, v, out, g))
+    b, h, t, d = qh.shape
+    scale = d**-0.5
+    n_blocks = t // block_k
+    blocked = lambda x: x.reshape(b, h, n_blocks, block_k, d).transpose(
+        2, 0, 1, 3, 4
+    )
+    kb, vb = blocked(kh), blocked(vh)  # (n, B, H, bk, D)
+
+    def lse_step(carry, kblk):
+        m, l = carry
+        s = jnp.einsum(
+            "bhtd,bhkd->bhtk", qh, kblk,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        blk_max = s.max(-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        l = l * jnp.exp(m - new_m) + jnp.exp(s - new_m).sum(
+            -1, keepdims=True
+        )
+        return (new_m, l), None
+
+    m0 = jnp.full((b, h, t, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    (m, l), _ = jax.lax.scan(lse_step, (m0, l0), kb)
+    lse = m + jnp.log(l)  # (B, H, T, 1)
+    d_vec = (gh * oh).sum(-1, keepdims=True)  # rowsum(dO ∘ O)
+
+    def bwd_step(dq, blk):
+        kblk, vblk = blk
+        s = jnp.einsum(
+            "bhtd,bhkd->bhtk", qh, kblk,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse)  # (B, H, T, bk)
+        dv = jnp.einsum(
+            "bhtk,bhtd->bhkd", p, gh, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "bhtd,bhkd->bhtk", gh, vblk,
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - d_vec)
+        dq = dq + scale * jnp.einsum(
+            "bhtk,bhkd->bhtd", ds, kblk,
+            preferred_element_type=jnp.float32,
+        )
+        dk = scale * jnp.einsum(
+            "bhtk,bhtd->bhkd", ds, qh, preferred_element_type=jnp.float32
+        )
+        return dq, (dk, dv)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        bwd_step, jnp.zeros_like(qh), (kb, vb)
+    )
+    unblock = lambda x: x.transpose(1, 2, 0, 3, 4).reshape(b, h, t, d)
+    to_bthd = lambda x: x.transpose(0, 2, 1, 3).astype(in_dtype)
+    return to_bthd(dq), to_bthd(unblock(dks)), to_bthd(unblock(dvs))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128):
     """Fused attention, (B, T, H, D) layout, bidirectional.
@@ -126,13 +207,16 @@ def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128):
 
 
 def _flash_fwd(q, k, v, block_q, block_k):
-    return flash_attention(q, k, v, block_q, block_k), (q, k, v)
+    out = flash_attention(q, k, v, block_q, block_k)
+    return out, (q, k, v, out)
 
 
 def _flash_bwd(block_q, block_k, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(_attention_reference, q, k, v)
-    return vjp(g)
+    q, k, v, out = residuals
+    if q.shape[1] <= _BWD_FULL_T:
+        _, vjp = jax.vjp(_attention_reference, q, k, v)
+        return vjp(g)
+    return _chunked_attention_bwd(q, k, v, out, g, block_k)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

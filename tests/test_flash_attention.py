@@ -67,6 +67,60 @@ def test_gradients_flow():
         )
 
 
+def test_chunked_backward_matches_reference():
+    """The O(T·block) backward used past _BWD_FULL_T is grad-exact."""
+    import har_tpu.ops.flash_attention as fa
+
+    q, k, v = _qkv(t=64)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=16, block_k=16) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (full_attention(q, k, v) ** 2).sum()
+
+    orig = fa._BWD_FULL_T
+    fa._BWD_FULL_T = 0  # force the chunked path at test-size T
+    try:
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa._BWD_FULL_T = orig
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_auto_flash_requires_tpu():
+    """use_flash=None must not pick the (interpret-mode) kernel off-TPU."""
+    import flax.linen as nn
+
+    from har_tpu.models import transformer as tr
+
+    captured = []
+    orig = tr.flash_attention
+
+    def spy(*args, **kw):
+        captured.append(1)
+        return orig(*args, **kw)
+
+    tr.flash_attention = spy
+    try:
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(1, 2048, 3)), jnp.float32
+        )
+        model = tr.Transformer1D(
+            num_classes=6, embed_dim=8, num_heads=1, num_layers=1,
+            dtype=jnp.float32,
+        )
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        model.apply({"params": params}, x)
+    finally:
+        tr.flash_attention = orig
+    assert jax.default_backend() == "cpu" and not captured
+
+
 def test_pick_block():
     assert pick_block(400) == 200
     assert pick_block(128) == 128
