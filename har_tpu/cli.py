@@ -18,13 +18,6 @@ import sys
 
 from har_tpu.config import DataConfig, ModelConfig, RunConfig, TuningConfig
 
-_ALIASES = {
-    "lr": "logistic_regression",
-    "dt": "decision_tree",
-    "rf": "random_forest",
-    "gbt": "gbdt",
-}
-
 
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="har", description=__doc__)
@@ -64,6 +57,21 @@ def _parser() -> argparse.ArgumentParser:
     e.add_argument("--seed", type=int, default=2018,
                    help="must match the training run")
 
+    s = sub.add_parser(
+        "sweep",
+        help="split-ratio sweep (the paper's Table 1/2 experiment): "
+             "models × {70-30, 80-20, 90-10}",
+    )
+    s.add_argument("--dataset", default="wisdm",
+                   choices=["wisdm", "ucihar", "synthetic"])
+    s.add_argument("--data-path", default=None)
+    s.add_argument("--models", nargs="+", default=["lr", "dt", "rf"])
+    s.add_argument("--fractions", nargs="+", type=float,
+                   default=[0.7, 0.8, 0.9])
+    s.add_argument("--seed", type=int, default=2018)
+    s.add_argument("--no-cv", action="store_true")
+    s.add_argument("--output-dir", default="main_result")
+
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
     return p
 
@@ -75,6 +83,23 @@ def main(argv=None) -> int:
         import bench
 
         bench.main()
+        return 0
+
+    if args.command == "sweep":
+        from har_tpu.runner import sweep
+
+        config = RunConfig(
+            data=DataConfig(
+                dataset=args.dataset, path=args.data_path, seed=args.seed
+            ),
+            output_dir=args.output_dir,
+        )
+        sweep(
+            config,
+            models=args.models,  # runner canonicalizes lr/dt/rf/gbt
+            fractions=tuple(args.fractions),
+            with_cv=not args.no_cv,
+        )
         return 0
 
     if args.command == "evaluate":
@@ -94,7 +119,9 @@ def main(argv=None) -> int:
         return 0
 
     # train
-    models = [_ALIASES.get(m, m) for m in args.models]
+    from har_tpu.runner import canonical_model_name
+
+    models = [canonical_model_name(m) for m in args.models]
     neural_params = {}
     for k in ("epochs", "batch_size", "learning_rate"):
         v = getattr(args, k)

@@ -37,25 +37,52 @@ from har_tpu.train.trainer import TrainerConfig
 from har_tpu.tuning import CrossValidator, param_grid
 
 
-# trainer-only knobs that classical estimators silently ignore (the CLI
-# forwards one params dict to every model in --models)
-_TRAINER_KEYS = {f.name for f in dataclasses.fields(TrainerConfig)}
+_ALIASES = {
+    "lr": "logistic_regression",
+    "dt": "decision_tree",
+    "rf": "random_forest",
+    "gbt": "gbdt",
+}
+
+_CLASSICAL = {
+    "logistic_regression": LogisticRegression,
+    "decision_tree": DecisionTreeClassifier,
+    "random_forest": RandomForestClassifier,
+    "gbdt": GradientBoostedTreesClassifier,
+}
+
+_NEURAL = ("mlp", "cnn1d", "bilstm")
+
+# every hyperparameter name any estimator accepts; a param outside this
+# union is a typo, not a cross-model knob, and must fail loudly
+_KNOWN_PARAMS = (
+    {f.name for cls in _CLASSICAL.values() for f in dataclasses.fields(cls)}
+    | {f.name for f in dataclasses.fields(TrainerConfig)}
+)
+
+
+def canonical_model_name(name: str) -> str:
+    return _ALIASES.get(name, name)
 
 
 def build_estimator(name: str, params: dict | None = None, mesh=None):
+    name = canonical_model_name(name)
     params = dict(params or {})
-    if name in ("logistic_regression", "lr", "decision_tree", "dt",
-                "random_forest", "rf", "gbdt", "gbt"):
-        params = {k: v for k, v in params.items() if k not in _TRAINER_KEYS}
-    if name in ("logistic_regression", "lr"):
-        return LogisticRegression(**params)
-    if name in ("decision_tree", "dt"):
-        return DecisionTreeClassifier(**params)
-    if name in ("random_forest", "rf"):
-        return RandomForestClassifier(**params)
-    if name in ("gbdt", "gbt"):
-        return GradientBoostedTreesClassifier(**params)
-    if name in ("mlp", "cnn1d", "bilstm"):
+    if name in _CLASSICAL:
+        # one params dict serves every model in --models: keep only the
+        # knobs this estimator actually has (trainer-only keys and other
+        # estimators' keys fall away) — but reject names no estimator
+        # anywhere accepts, so misspellings don't silently train defaults
+        unknown = set(params) - _KNOWN_PARAMS
+        if unknown:
+            raise ValueError(
+                f"unknown hyperparameter(s) {sorted(unknown)} — not "
+                "accepted by any estimator"
+            )
+        cls = _CLASSICAL[name]
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in params.items() if k in fields})
+    if name in _NEURAL:
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
         cfg = TrainerConfig(
             **{k: params.pop(k) for k in list(params) if k in train_keys}
@@ -91,6 +118,18 @@ def load_dataset(config: RunConfig):
     raise ValueError(f"unknown dataset {config.data.dataset!r}")
 
 
+def _feature_mode(config: RunConfig) -> str:
+    """Which feature view this config's model trains on."""
+    if config.data.dataset == "ucihar":
+        return "ucihar"
+    return getattr(config.model, "feature_view", None) or (
+        "numeric"
+        if canonical_model_name(config.model.name)
+        in (*_NEURAL, "gbdt")
+        else "onehot"
+    )
+
+
 def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
     """Fit the one-hot pipeline (reference parity) or the numeric view.
 
@@ -104,11 +143,7 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         frac = config.data.train_fraction
         train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
         return train, test, None
-    mode = getattr(config.model, "feature_view", None) or (
-        "numeric"
-        if config.model.name in ("mlp", "cnn1d", "bilstm", "gbdt", "gbt")
-        else "onehot"
-    )
+    mode = _feature_mode(config)
     if mode == "numeric":
         from har_tpu.data.wisdm import BINNED_COLUMNS
         from har_tpu.features.string_indexer import StringIndexer
@@ -116,7 +151,7 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         # GBDT uses the 30 histogram-bin columns when the loader kept them
         # (its best-accuracy view); the neural models keep the stable
         # 13-dim view so checkpoints don't silently change input width.
-        has_bins = config.model.name in ("gbdt", "gbt") and all(
+        has_bins = canonical_model_name(config.model.name) == "gbdt" and all(
             c in table.column_names for c in BINNED_COLUMNS
         )
         x, _ = numeric_feature_view(table, include_binned=has_bins)
@@ -168,6 +203,113 @@ def _fit_eval(est, name, train, test, report, is_cv=False):
     return result
 
 
+def sweep(
+    config: RunConfig,
+    models=None,
+    fractions=(0.7, 0.8, 0.9),
+    with_cv=True,
+) -> list[dict]:
+    """Split-ratio sweep: the paper's Table 1/2 experiment as one command.
+
+    The paper (reference Paper/, §4 Tables 1-2) re-runs the pipeline at
+    70-30 / 80-20 / 90-10 splits by hand-editing the script; here it's a
+    config sweep.  Returns one row per (split, model) with timings and
+    test metrics, writes ``sweep.csv`` + a Spark-`show()`-style table to
+    ``sweep.txt`` under ``config.output_dir``.
+
+    CV rows are produced only for estimators with a non-empty reference
+    grid (LR — Main/main.py:202-207), matching the paper's "LR with
+    cross fold" rows.
+    """
+    import csv
+    import os
+
+    from har_tpu.reporting.ascii_table import show
+
+    models = [
+        canonical_model_name(m)
+        for m in (
+            models
+            or ["logistic_regression", "decision_tree", "random_forest"]
+        )
+    ]
+    if not models or not fractions:
+        raise ValueError("sweep needs at least one model and one fraction")
+    table = load_dataset(config)
+    rows: list[dict] = []
+    for frac in fractions:
+        cfg = dataclasses.replace(
+            config,
+            data=dataclasses.replace(config.data, train_fraction=frac),
+        )
+        # each model trains on the same view `run()` would give it
+        # (featurize keys the view off model.name), computed once per
+        # distinct view per split
+        view_cache: dict[str, tuple] = {}
+        split_name = f"{round(frac * 100)}-{round((1 - frac) * 100)}"
+        for name in models:
+            model_cfg = dataclasses.replace(
+                cfg, model=dataclasses.replace(cfg.model, name=name)
+            )
+            mode = _feature_mode(model_cfg)
+            if mode not in view_cache:
+                view_cache[mode] = featurize(model_cfg, table)[:2]
+            train, test = view_cache[mode]
+            est = build_estimator(name, config.model.params)
+            jobs = [(name, est)]
+            if with_cv and name in REFERENCE_GRIDS:
+                jobs.append(
+                    (
+                        f"{name}_cv",
+                        CrossValidator(
+                            estimator=est,
+                            grid=param_grid(**REFERENCE_GRIDS[name]),
+                            num_folds=5,
+                            selection_metric=(
+                                config.tuning.selection_metric
+                                if config.tuning
+                                else "accuracy"
+                            ),
+                            seed=config.data.seed,
+                        ),
+                    )
+                )
+            for job_name, job_est in jobs:
+                t0 = time.perf_counter()
+                model = job_est.fit(train)
+                train_time = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                preds = model.transform(test)
+                test_time = time.perf_counter() - t0
+                metrics = evaluate(test.label, preds.raw, model.num_classes)
+                rows.append(
+                    {
+                        "split": split_name,
+                        "model": job_name,
+                        "n_train": len(train),
+                        "n_test": len(test),
+                        "train_time_s": round(train_time, 3),
+                        "test_time_s": round(test_time, 3),
+                        "accuracy": round(float(metrics["accuracy"]), 6),
+                        "f1": round(float(metrics["f1"]), 6),
+                    }
+                )
+
+    os.makedirs(config.output_dir, exist_ok=True)
+    columns = list(rows[0].keys())
+    csv_path = os.path.join(config.output_dir, "sweep.csv")
+    with open(csv_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=columns)
+        writer.writeheader()
+        writer.writerows(rows)
+    txt = show(columns, [[r[c] for c in columns] for r in rows],
+               max_rows=None)
+    with open(os.path.join(config.output_dir, "sweep.txt"), "w") as f:
+        f.write(txt)
+    print(txt, end="")
+    return rows
+
+
 def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutcome:
     """The whole reference pipeline: EDA → features → models → artifacts."""
     report = ReportWriter(config.output_dir)
@@ -182,7 +324,13 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
     train, test, _ = featurize(config, table)
     report.split_counts(len(train), len(test))
 
-    models = models or ["logistic_regression", "decision_tree", "random_forest"]
+    models = [
+        canonical_model_name(m)
+        for m in (
+            models
+            or ["logistic_regression", "decision_tree", "random_forest"]
+        )
+    ]
     results = []
     for name in models:
         est = build_estimator(name, config.model.params)

@@ -72,17 +72,35 @@ class CrossValidator:
         grid = list(self.grid) or [{}]
         sign = -1.0 if self.selection_metric in _MINIMIZE else 1.0
 
-        avg_metrics = []
-        for params in grid:
-            est = self.estimator.copy_with(**params) if params else self.estimator
-            scores = []
-            for train_idx, val_idx in folds:
-                model = est.fit(data.take(train_idx))
-                val = data.take(val_idx)
-                preds = model.transform(val)
-                rep = evaluate(val.label, preds.raw, model.num_classes)
-                scores.append(rep[self.selection_metric])
-            avg_metrics.append(float(np.mean(scores)))
+        # fast path: estimators exposing a vectorized sweep (the whole
+        # grid×fold matrix as a few compiled programs — SURVEY §2c.2's
+        # "embarrassingly parallel → vmap") return the score matrix at
+        # once; anything else falls back to fit-per-cell
+        score_matrix = (
+            self.estimator.cv_scores(
+                data, folds, grid, self.selection_metric
+            )
+            if hasattr(self.estimator, "cv_scores")
+            else None
+        )
+        if score_matrix is not None:
+            avg_metrics = [float(m) for m in score_matrix.mean(axis=1)]
+        else:
+            avg_metrics = []
+            for params in grid:
+                est = (
+                    self.estimator.copy_with(**params)
+                    if params
+                    else self.estimator
+                )
+                scores = []
+                for train_idx, val_idx in folds:
+                    model = est.fit(data.take(train_idx))
+                    val = data.take(val_idx)
+                    preds = model.transform(val)
+                    rep = evaluate(val.label, preds.raw, model.num_classes)
+                    scores.append(rep[self.selection_metric])
+                avg_metrics.append(float(np.mean(scores)))
 
         best_i = int(np.argmax(sign * np.asarray(avg_metrics)))
         best_params = dict(grid[best_i])

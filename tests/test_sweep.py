@@ -1,0 +1,89 @@
+"""Split-ratio sweep (the paper's Table 1/2 experiment) on synthetic data."""
+
+import csv
+import os
+
+from har_tpu.config import DataConfig, ModelConfig, RunConfig
+from har_tpu.runner import sweep
+
+
+def test_sweep_rows_and_artifacts(tmp_path):
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", seed=7),
+        model=ModelConfig(name="decision_tree", params={"max_depth": 2}),
+        output_dir=str(tmp_path),
+    )
+    rows = sweep(
+        config,
+        models=["decision_tree"],
+        fractions=(0.7, 0.8),
+        with_cv=False,
+    )
+    assert [r["split"] for r in rows] == ["70-30", "80-20"]
+    for r in rows:
+        assert r["n_train"] + r["n_test"] == 5418
+        assert 0.0 <= r["accuracy"] <= 1.0
+        assert r["train_time_s"] > 0
+    # artifacts: csv parses back to the same rows, txt is a bordered table
+    with open(os.path.join(tmp_path, "sweep.csv")) as f:
+        parsed = list(csv.DictReader(f))
+    assert len(parsed) == 2
+    assert parsed[0]["model"] == "decision_tree"
+    with open(os.path.join(tmp_path, "sweep.txt")) as f:
+        txt = f.read()
+    assert txt.startswith("+") and "70-30" in txt
+
+
+def test_sweep_cv_rows_only_for_gridded_models(tmp_path):
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", seed=7),
+        model=ModelConfig(
+            name="logistic_regression", params={"max_iter": 5}
+        ),
+        output_dir=str(tmp_path),
+    )
+    rows = sweep(
+        config,
+        models=["logistic_regression", "decision_tree"],
+        fractions=(0.7,),
+        with_cv=True,
+    )
+    names = [r["model"] for r in rows]
+    assert names == [
+        "logistic_regression",
+        "logistic_regression_cv",
+        "decision_tree",
+    ]
+
+
+def test_sweep_aliases_and_per_model_views(tmp_path):
+    """'gbt' alias resolves, and gbdt gets its numeric view in the sweep."""
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", seed=7),
+        model=ModelConfig(params={"num_rounds": 3, "max_depth": 2}),
+        output_dir=str(tmp_path),
+    )
+    rows = sweep(config, models=["gbt"], fractions=(0.7,), with_cv=False)
+    assert rows[0]["model"] == "gbdt"
+
+
+def test_sweep_empty_args_raise(tmp_path):
+    import pytest
+
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic"), output_dir=str(tmp_path)
+    )
+    with pytest.raises(ValueError):
+        sweep(config, fractions=())
+
+
+def test_build_estimator_rejects_typos():
+    import pytest
+
+    from har_tpu.runner import build_estimator
+
+    with pytest.raises(ValueError, match="reg_parm"):
+        build_estimator("lr", {"reg_parm": 0.01})
+    # cross-model keys still pass through silently (one dict, many models)
+    est = build_estimator("lr", {"max_depth": 3, "reg_param": 0.01})
+    assert est.reg_param == 0.01

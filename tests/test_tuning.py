@@ -60,3 +60,35 @@ def test_cv_mae_quirk_flips_direction():
     assert model.selection_metric == "mae"
     # mae is minimized: avg_metrics are errors, best has the smallest
     assert model.avg_metrics[0] == min(model.avg_metrics)
+
+
+def test_vectorized_cv_matches_generic_loop():
+    """cv_scores (vmap sweep) must agree with fit-per-cell scores."""
+    data = _separable(n=210)
+    grid = param_grid(
+        reg_param=[0.01, 0.3], elastic_net_param=[0.0, 0.2]
+    )
+    est = LogisticRegression(max_iter=15)
+    folds = kfold_indices(len(data), 3, seed=2018)
+
+    fast = est.cv_scores(data, folds, grid, "accuracy")
+    assert fast is not None and fast.shape == (4, 3)
+
+    slow = np.zeros_like(fast)
+    for i, params in enumerate(grid):
+        e = est.copy_with(**params)
+        for j, (tr, va) in enumerate(folds):
+            model = e.fit(data.take(tr))
+            preds = model.transform(data.take(va))
+            slow[i, j] = evaluate(
+                data.take(va).label, preds.raw, model.num_classes
+            )["accuracy"]
+    np.testing.assert_allclose(fast, slow, atol=1e-6)
+
+
+def test_cv_scores_declines_unsupported():
+    data = _separable(n=120)
+    est = LogisticRegression()
+    folds = kfold_indices(len(data), 2, seed=0)
+    assert est.cv_scores(data, folds, [{"max_iter": 5}], "accuracy") is None
+    assert est.cv_scores(data, folds, [{}], "f1") is None
