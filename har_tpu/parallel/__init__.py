@@ -17,8 +17,16 @@ from har_tpu.parallel.sharding import (
     shard_batch,
 )
 from har_tpu.parallel.data_parallel import jit_replicated, make_dp_train_step
+from har_tpu.parallel.tensor_parallel import (
+    dense_alternating_specs,
+    make_gspmd_scan_fit,
+    shard_params,
+)
 
 __all__ = [
+    "dense_alternating_specs",
+    "make_gspmd_scan_fit",
+    "shard_params",
     "DP_AXIS",
     "TP_AXIS",
     "create_mesh",

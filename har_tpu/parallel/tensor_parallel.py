@@ -1,0 +1,153 @@
+"""Tensor parallelism: sharded parameters, XLA-inserted collectives.
+
+The reference has no model large enough to shard (SURVEY §2c.3), but the
+framework's neural families (wide MLPs, transformer FFN/attention) are —
+so tp is first-class here.  The design is GSPMD, not hand-written
+collectives: parameters carry `NamedSharding`s over the mesh's ``tp``
+axis, the batch is sharded over ``dp``, and XLA inserts the
+all-reduce/all-gather the layout implies (the scaling-book recipe: pick a
+mesh, annotate shardings, let the compiler place collectives on ICI).
+
+`dense_alternating_specs` produces the Megatron layout for stacks of
+Dense layers: kernels alternately column-parallel ``P(None, tp)`` and
+row-parallel ``P(tp, None)``, biases following their kernel — one
+all-reduce per pair, activations stay sharded on the hidden dim between
+them.  It walks any Flax param tree in deterministic order, so it covers
+the MLP and the transformer's qkv/proj + FFN pairs alike.
+
+`make_gspmd_scan_fit` is the tp-aware twin of
+har_tpu.train.trainer.make_scan_fit: same whole-run `lax.scan`, but
+jit-with-shardings instead of `shard_map`, because tensor parallelism
+wants the compiler to split the matmuls themselves.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from har_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+
+def dense_alternating_specs(params, tp_axis: str = TP_AXIS):
+    """PartitionSpec pytree: alternate column-/row-parallel 2-D kernels.
+
+    Walks the tree in sorted-key order (Flax names are Dense_0, Dense_1, …
+    so traversal order is layer order).  The i-th 2-D kernel gets
+    ``P(None, tp)`` for even i (column-parallel: output dim sharded) and
+    ``P(tp, None)`` for odd i (row-parallel: input dim sharded — its
+    input activations are already sharded by the previous layer).  A bias
+    directly following a column-parallel kernel is ``P(tp)``; everything
+    else (LayerNorm scales, small heads, LSTM cells) is replicated.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    # natural-order traversal: lexicographic dict order puts Dense_10
+    # before Dense_2, which would flip the parity of every later layer —
+    # sort each path component on its (prefix, numeric-suffix) pair
+    def natural_key(path):
+        def component(k):
+            k = getattr(k, "key", str(k))
+            head, _, tail = str(k).rpartition("_")
+            return (head, int(tail)) if tail.isdigit() else (str(k), -1)
+
+        return tuple(component(k) for k in path)
+
+    ordered = sorted(flat, key=lambda pl: natural_key(pl[0]))
+    specs = {}
+    kernel_index = 0
+    last_kernel_spec: dict[tuple, P] = {}
+    for path, leaf in ordered:
+        if leaf.ndim == 2 and path[-1].key == "kernel":
+            spec = (
+                P(None, tp_axis) if kernel_index % 2 == 0 else P(tp_axis, None)
+            )
+            kernel_index += 1
+            last_kernel_spec[path[:-1]] = spec
+            specs[path] = spec
+        else:
+            specs[path] = P()
+    # biases follow their kernel's output sharding
+    for path in list(specs):
+        if path[-1].key == "bias":
+            ks = last_kernel_spec.get(path[:-1])
+            if ks is not None and ks == P(None, tp_axis):
+                specs[path] = P(tp_axis)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), [specs[p] for p, _ in flat]
+    )
+
+
+def shard_params(params, mesh: Mesh, specs=None):
+    """Place a param pytree on the mesh per ``specs`` (default Megatron)."""
+    specs = dense_alternating_specs(params) if specs is None else specs
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+    )
+
+
+def make_gspmd_scan_fit(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+) -> Callable:
+    """fit(params, opt_state, rng, x, y, batch_idx) → (params, opt_state, losses).
+
+    Inputs' placements drive the partitioning: params arrive tp-sharded
+    (see `shard_params`), x/y replicated, and each gathered batch is
+    constrained to ``P(dp)`` — XLA propagates from there and inserts the
+    tp all-reduces and the dp gradient reduction itself (no explicit
+    psum: the compiler's reduction IS the treeAggregate equivalent).
+    """
+
+    def fit(params, opt_state, rng, x, y, batch_idx):
+        def step(carry, step_and_idx):
+            params, opt_state = carry
+            step_i, idx = step_and_idx
+            xb = jax.lax.with_sharding_constraint(
+                x[idx], NamedSharding(mesh, P(DP_AXIS))
+            )
+            yb = jax.lax.with_sharding_constraint(
+                y[idx], NamedSharding(mesh, P(DP_AXIS))
+            )
+            step_rng = jax.random.fold_in(rng, step_i)
+
+            def mean_loss(p):
+                logits = apply_fn(
+                    {"params": p}, xb, train=True,
+                    rngs={"dropout": step_rng},
+                )
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb
+                ).mean()
+
+            loss, grads = jax.value_and_grad(mean_loss)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        steps = jnp.arange(batch_idx.shape[0])
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), (steps, batch_idx)
+        )
+        return params, opt_state, losses
+
+    return jax.jit(fit, donate_argnums=(0, 1))
+
+
+def tp_dim_check(params, specs, tp: int) -> None:
+    """Refuse silently-unsharded layouts: every tp-sharded dim must divide."""
+    def check(x, s):
+        for dim, name in zip(x.shape, tuple(s) + (None,) * x.ndim):
+            if name is not None and dim % tp:
+                raise ValueError(
+                    f"param dim {dim} not divisible by tp={tp} "
+                    f"(shape {x.shape}, spec {s})"
+                )
+    jax.tree.map(check, params, specs)

@@ -53,12 +53,25 @@ _CLASSICAL = {
 
 _NEURAL = ("mlp", "cnn1d", "bilstm")
 
-# every hyperparameter name any estimator accepts; a param outside this
-# union is a typo, not a cross-model knob, and must fail loudly
-_KNOWN_PARAMS = (
-    {f.name for cls in _CLASSICAL.values() for f in dataclasses.fields(cls)}
-    | {f.name for f in dataclasses.fields(TrainerConfig)}
-)
+def _known_params() -> set[str]:
+    """Every hyperparameter name any estimator accepts (classical fields,
+    trainer knobs, neural module attributes); a param outside this union
+    is a typo, not a cross-model knob, and must fail loudly."""
+    from har_tpu.models.neural import MODEL_REGISTRY
+
+    known = {
+        f.name
+        for cls in _CLASSICAL.values()
+        for f in dataclasses.fields(cls)
+    } | {f.name for f in dataclasses.fields(TrainerConfig)}
+    for cls in MODEL_REGISTRY.values():
+        if dataclasses.is_dataclass(cls):
+            known |= {
+                f.name
+                for f in dataclasses.fields(cls)
+                if f.name not in ("parent", "name")
+            }
+    return known
 
 
 def canonical_model_name(name: str) -> str:
@@ -73,7 +86,7 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
         # knobs this estimator actually has (trainer-only keys and other
         # estimators' keys fall away) — but reject names no estimator
         # anywhere accepts, so misspellings don't silently train defaults
-        unknown = set(params) - _KNOWN_PARAMS
+        unknown = set(params) - _known_params()
         if unknown:
             raise ValueError(
                 f"unknown hyperparameter(s) {sorted(unknown)} — not "

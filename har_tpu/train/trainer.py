@@ -26,7 +26,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from har_tpu.models.base import Predictions
-from har_tpu.parallel.mesh import DP_AXIS, single_device_mesh
+from har_tpu.parallel.mesh import DP_AXIS, TP_AXIS, single_device_mesh
 from har_tpu.parallel.sharding import batch_sharding, pad_to_multiple
 
 
@@ -252,6 +252,12 @@ class Trainer:
         host_rng = np.random.default_rng(cfg.seed)
         history: dict[str, Any] = {"loss": []}
         t0 = time.perf_counter()
+        tp = mesh.shape.get(TP_AXIS, 1)
+        if tp > 1 and not self.scan:
+            raise ValueError(
+                "tensor parallelism (tp>1 mesh) requires scan=True — the "
+                "streaming path would silently train replicated params"
+            )
         if self.scan:
             batch_idx = np.stack(
                 [
@@ -260,7 +266,25 @@ class Trainer:
                     for idx in batch_iterator(n, cfg.batch_size, host_rng)
                 ]
             ).astype(np.int32)
-            fit = make_scan_fit(self.module.apply, optimizer, mesh)
+            if tp > 1:
+                # tensor parallelism: params sharded over tp, XLA inserts
+                # the collectives (GSPMD) — see har_tpu.parallel.tensor_parallel
+                from har_tpu.parallel.tensor_parallel import (
+                    dense_alternating_specs,
+                    make_gspmd_scan_fit,
+                    shard_params,
+                    tp_dim_check,
+                )
+
+                specs = dense_alternating_specs(params)
+                tp_dim_check(params, specs, tp)
+                params = shard_params(params, mesh, specs)
+                opt_state = optimizer.init(params)
+                fit = make_gspmd_scan_fit(
+                    self.module.apply, optimizer, mesh
+                )
+            else:
+                fit = make_scan_fit(self.module.apply, optimizer, mesh)
             params, opt_state, losses = fit(
                 params,
                 opt_state,

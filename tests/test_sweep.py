@@ -56,15 +56,33 @@ def test_sweep_cv_rows_only_for_gridded_models(tmp_path):
     ]
 
 
-def test_sweep_aliases_and_per_model_views(tmp_path):
-    """'gbt' alias resolves, and gbdt gets its numeric view in the sweep."""
+def test_sweep_aliases_and_per_model_views(tmp_path, monkeypatch):
+    """'gbt' alias resolves, and each model gets its own feature view."""
+    import har_tpu.runner as runner_mod
+
+    seen_modes = []
+    real_featurize = runner_mod.featurize
+
+    def spy(cfg, table):
+        seen_modes.append(runner_mod._feature_mode(cfg))
+        return real_featurize(cfg, table)
+
+    monkeypatch.setattr(runner_mod, "featurize", spy)
     config = RunConfig(
         data=DataConfig(dataset="synthetic", seed=7),
         model=ModelConfig(params={"num_rounds": 3, "max_depth": 2}),
         output_dir=str(tmp_path),
     )
-    rows = sweep(config, models=["gbt"], fractions=(0.7,), with_cv=False)
-    assert rows[0]["model"] == "gbdt"
+    rows = sweep(
+        config,
+        models=["gbt", "decision_tree"],
+        fractions=(0.7,),
+        with_cv=False,
+    )
+    assert [r["model"] for r in rows] == ["gbdt", "decision_tree"]
+    # gbdt got the numeric view, the tree the one-hot view — one
+    # featurize call per distinct view
+    assert sorted(seen_modes) == ["numeric", "onehot"]
 
 
 def test_sweep_empty_args_raise(tmp_path):
