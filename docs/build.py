@@ -1,0 +1,72 @@
+"""Render docs/*.md to docs/*.html (the reference ships its docs as a
+GitHub-Pages HTML export of the notebook — docs/index.html there; this is
+our equivalent static export).
+
+Usage: python docs/build.py
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import markdown
+
+_TEMPLATE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title}</title>
+<style>
+body {{ font: 16px/1.6 system-ui, sans-serif; max-width: 54rem;
+       margin: 2rem auto; padding: 0 1rem; color: #1a1a1a; }}
+pre {{ background: #f6f8fa; padding: .8rem; overflow-x: auto;
+      border-radius: 6px; }}
+code {{ background: #f6f8fa; padding: .1em .3em; border-radius: 4px;
+       font-size: .92em; }}
+pre code {{ padding: 0; }}
+table {{ border-collapse: collapse; width: 100%; margin: 1rem 0; }}
+th, td {{ border: 1px solid #d0d7de; padding: .4rem .6rem;
+         text-align: left; vertical-align: top; }}
+th {{ background: #f6f8fa; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+a {{ color: #0969da; }}
+nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
+</style>
+</head>
+<body>
+<nav><a href="index.html">overview</a> ·
+<a href="architecture.html">architecture</a> ·
+<a href="parallelism.html">parallelism</a> ·
+<a href="api.html">api</a></nav>
+{body}
+</body>
+</html>
+"""
+
+
+def build() -> list[str]:
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for md_path in sorted(glob.glob(os.path.join(here, "*.md"))):
+        with open(md_path) as f:
+            text = f.read()
+        title = next(
+            (ln.lstrip("# ") for ln in text.splitlines() if ln.startswith("#")),
+            os.path.basename(md_path),
+        )
+        body = markdown.markdown(
+            text, extensions=["tables", "fenced_code"]
+        )
+        body = body.replace(".md", ".html")  # inter-doc links
+        html_path = md_path[:-3] + ".html"
+        with open(html_path, "w") as f:
+            f.write(_TEMPLATE.format(title=title, body=body))
+        out.append(html_path)
+    return out
+
+
+if __name__ == "__main__":
+    for p in build():
+        print(p)
