@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 
 import markdown
 
@@ -59,7 +60,14 @@ def build() -> list[str]:
         body = markdown.markdown(
             text, extensions=["tables", "fenced_code"]
         )
-        body = body.replace(".md", ".html")  # inter-doc links
+        # rewrite only hrefs targeting sibling docs — prose mentions of
+        # other .md files (SURVEY.md, BASELINE.md, the reference's
+        # README.md) have no HTML export and must stay as written
+        body = re.sub(
+            r'href="(index|architecture|parallelism|api)\.md"',
+            r'href="\1.html"',
+            body,
+        )
         html_path = md_path[:-3] + ".html"
         with open(html_path, "w") as f:
             f.write(_TEMPLATE.format(title=title, body=body))

@@ -46,6 +46,9 @@ def _parser() -> argparse.ArgumentParser:
                         "best-accuracy view")
     t.add_argument("--eda", action="store_true",
                    help="write hexbin pair plots + scatter matrix")
+    t.add_argument("--trace-dir", default=None,
+                   help="write a TensorBoard-loadable jax.profiler trace "
+                        "of the whole run to this directory")
     t.add_argument("--output-dir", default="main_result")
 
     e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
@@ -140,10 +143,12 @@ def main(argv=None) -> int:
         output_dir=args.output_dir,
     )
     from har_tpu.runner import run
+    from har_tpu.utils.profiling import trace
 
-    outcome = run(
-        config, models=models, with_cv=not args.no_cv, with_eda=args.eda
-    )
+    with trace(args.trace_dir):
+        outcome = run(
+            config, models=models, with_cv=not args.no_cv, with_eda=args.eda
+        )
     print(json.dumps({"accuracies": outcome.accuracies,
                       "artifacts": outcome.report_paths}))
     return 0

@@ -9,6 +9,7 @@ through the framework's layers from a single RunConfig.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any
 
@@ -197,13 +198,16 @@ class RunOutcome:
         }
 
 
-def _fit_eval(est, name, train, test, report, is_cv=False):
-    t0 = time.perf_counter()
-    model = est.fit(train)
-    train_time = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    preds = model.transform(test)
-    test_time = time.perf_counter() - t0
+def _fit_eval(est, name, train, test, report, is_cv=False, timer=None):
+    from har_tpu.utils.profiling import StepTimer
+
+    timer = timer if timer is not None else StepTimer()
+    with timer(f"{name}_fit") as fit_sec:
+        model = est.fit(train)
+    train_time = fit_sec.seconds
+    with timer(f"{name}_transform") as tf_sec:
+        preds = model.transform(test)
+    test_time = tf_sec.seconds
     metrics = evaluate(test.label, preds.raw, model.num_classes)
     result = ModelResult(
         name=name,
@@ -325,16 +329,21 @@ def sweep(
 
 def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutcome:
     """The whole reference pipeline: EDA → features → models → artifacts."""
+    from har_tpu.utils.profiling import StepTimer, write_timing_csv
+
+    timer = StepTimer()
     report = ReportWriter(config.output_dir)
     report.line("Loading Data Set...")
-    table = load_dataset(config)
+    with timer("load"):
+        table = load_dataset(config)
     report.schema(table)
     report.sample(table)
     if "ACTIVITY" in table.column_names:
         report.class_counts(table["ACTIVITY"])
     report.summary(table)
 
-    train, test, _ = featurize(config, table)
+    with timer("featurize"):
+        train, test, _ = featurize(config, table)
     report.split_counts(len(train), len(test))
 
     models = [
@@ -347,7 +356,9 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
     results = []
     for name in models:
         est = build_estimator(name, config.model.params)
-        results.append(_fit_eval(est, name, train, test, report))
+        results.append(
+            _fit_eval(est, name, train, test, report, timer=timer)
+        )
         if with_cv:
             tuning = config.tuning
             grid_spec = (
@@ -364,7 +375,10 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
                 seed=config.data.seed,
             )
             results.append(
-                _fit_eval(cv, f"{name}_cv", train, test, report, is_cv=True)
+                _fit_eval(
+                    cv, f"{name}_cv", train, test, report,
+                    is_cv=True, timer=timer,
+                )
             )
 
     if with_eda:
@@ -374,4 +388,7 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
         save_eda_plots(table, numeric, config.output_dir + "/plot")
 
     paths = report.save()
+    paths["timing"] = write_timing_csv(
+        os.path.join(config.output_dir, "timing.csv"), timer
+    )
     return RunOutcome(report_paths=paths, results=results)

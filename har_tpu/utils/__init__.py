@@ -1,0 +1,3 @@
+from har_tpu.utils.profiling import StepTimer, trace, write_timing_csv
+
+__all__ = ["StepTimer", "trace", "write_timing_csv"]

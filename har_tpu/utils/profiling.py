@@ -1,0 +1,114 @@
+"""Tracing and step timing (SURVEY §5.1).
+
+The reference's entire observability story is `time.time()` pairs around
+each fit/transform printed into the report (reference Main/main.py:116-124
+and five sibling blocks) — Spark's own UI/event-log is never configured.
+This module is the TPU-native upgrade:
+
+  - :func:`trace` — context manager around `jax.profiler.trace`, emitting
+    a TensorBoard-loadable XLA trace (op-level HLO timing, HBM usage) to a
+    directory; a no-op when disabled so call sites can leave it in place.
+  - :class:`StepTimer` — wall-clock section timing with the reference's
+    semantics (label → seconds, rounded like the report's "trained in N
+    seconds" lines) plus windows/s derivation.
+  - :func:`write_timing_csv` — persists timings next to the metric CSVs.
+
+`jax.profiler` traces are the ground truth for *device* time; StepTimer
+measures *host-observed* time (includes dispatch + transfer), which is what
+the reference reports and what `bench.py`/`sweep` print — keep the two
+distinct when comparing numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import csv
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """`with trace("/tmp/trace"):` profiles the block for TensorBoard.
+
+    Pass None to disable (the context is then free), so pipelines can
+    accept an optional ``--trace-dir`` and leave the call site unchanged.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Section:
+    """One timed interval; ``.seconds`` is set when its block exits."""
+
+    seconds: float = 0.0
+
+
+class StepTimer:
+    """Labelled wall-clock sections: ``with timer("lr_fit") as s: ...``.
+
+    Repeated labels accumulate in the per-label totals (epochs, CV
+    cells); the yielded :class:`Section` always holds just the interval
+    its own block measured, so callers reporting a single fit don't pick
+    up earlier runs under the same label.  ``rate(label, count)`` derives
+    items/s the way the benchmark counts windows/s.
+    """
+
+    def __init__(self):
+        self._totals: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, label: str):
+        section = Section()
+        t0 = time.perf_counter()
+        try:
+            yield section
+        finally:
+            section.seconds = time.perf_counter() - t0
+            self._totals[label] = (
+                self._totals.get(label, 0.0) + section.seconds
+            )
+            self._counts[label] = self._counts.get(label, 0) + 1
+
+    @property
+    def seconds(self) -> dict[str, float]:
+        return dict(self._totals)
+
+    def calls(self, label: str) -> int:
+        return self._counts.get(label, 0)
+
+    def rate(self, label: str, items: int) -> float:
+        total = self._totals.get(label, 0.0)
+        return items / total if total > 0 else 0.0
+
+    def rows(self) -> list[dict]:
+        return [
+            {
+                "section": label,
+                "seconds": round(total, 6),
+                "calls": self._counts[label],
+            }
+            for label, total in self._totals.items()
+        ]
+
+
+def write_timing_csv(path: str, timer: StepTimer) -> str:
+    """Persist section timings (the CSVs' sibling artifact, `timing.csv`)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(
+            f, fieldnames=["section", "seconds", "calls"]
+        )
+        writer.writeheader()
+        writer.writerows(timer.rows())
+    return path

@@ -1,0 +1,85 @@
+"""Profiling utilities: step timing, timing.csv artifact, trace context."""
+
+import csv
+import os
+import time
+
+from har_tpu.utils.profiling import StepTimer, trace, write_timing_csv
+
+
+def test_step_timer_accumulates_labels():
+    timer = StepTimer()
+    for _ in range(3):
+        with timer("fit"):
+            time.sleep(0.01)
+    with timer("transform"):
+        time.sleep(0.01)
+    assert timer.calls("fit") == 3
+    assert timer.calls("transform") == 1
+    assert timer.seconds["fit"] >= 0.03
+    assert timer.rate("fit", items=300) > 0
+    assert timer.rate("never_ran", items=10) == 0.0
+
+
+def test_write_timing_csv(tmp_path):
+    timer = StepTimer()
+    with timer("a"):
+        pass
+    path = write_timing_csv(str(tmp_path / "timing.csv"), timer)
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["section"] == "a"
+    assert int(rows[0]["calls"]) == 1
+
+
+def test_trace_disabled_is_noop():
+    with trace(None):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_trace_writes_profile(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with trace(d):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    # jax writes plugins/profile/<timestamp>/ under the log dir
+    found = []
+    for root, _, files in os.walk(d):
+        found.extend(files)
+    assert found, "profiler produced no trace files"
+
+
+def test_runner_writes_timing_csv(tmp_path):
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
+    from har_tpu.runner import run
+
+    outcome = run(
+        RunConfig(
+            data=DataConfig(dataset="synthetic", seed=3),
+            model=ModelConfig(
+                name="decision_tree", params={"max_depth": 2}
+            ),
+            output_dir=str(tmp_path),
+        ),
+        models=["decision_tree"],
+        with_cv=False,
+    )
+    path = outcome.report_paths["timing"]
+    with open(path) as f:
+        sections = {r["section"] for r in csv.DictReader(f)}
+    assert {"load", "featurize", "decision_tree_fit",
+            "decision_tree_transform"} <= sections
+
+
+def test_section_holds_own_interval_not_total():
+    timer = StepTimer()
+    with timer("fit"):
+        time.sleep(0.02)
+    with timer("fit") as second:
+        pass
+    # the yielded section is this block's interval, not the running total
+    assert second.seconds < 0.01
+    assert timer.seconds["fit"] >= 0.02
