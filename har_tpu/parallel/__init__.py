@@ -22,8 +22,18 @@ from har_tpu.parallel.tensor_parallel import (
     make_gspmd_scan_fit,
     shard_params,
 )
+from har_tpu.parallel.pipeline_parallel import (
+    PP_AXIS,
+    make_pipeline_fn,
+    pipeline_mesh,
+    stack_stage_params,
+)
 
 __all__ = [
+    "PP_AXIS",
+    "make_pipeline_fn",
+    "pipeline_mesh",
+    "stack_stage_params",
     "dense_alternating_specs",
     "make_gspmd_scan_fit",
     "shard_params",

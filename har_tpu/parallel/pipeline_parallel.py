@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh axis.
+
+The fourth parallelism axis (with dp/tp/sp): homogeneous stages are laid
+out one per device, activations rotate around the ring with
+`jax.lax.ppermute`, and microbatches stream through so every stage is busy
+once the pipeline fills (the classic GPipe schedule: M + S − 1 ticks for
+M microbatches over S stages, bubble fraction (S−1)/(M+S−1)).
+
+Design constraints, chosen for XLA:
+  - **Homogeneous stages.** Every stage applies the same `stage_fn` with
+    its own parameter slice (stacked on a leading S axis, sharded over
+    ``pp``).  Input/output projections that differ per position run
+    replicated outside the pipelined block — this keeps the rotating
+    activation a fixed shape, which is what makes the whole schedule one
+    `lax.scan` with static shapes.
+  - **In-graph schedule.** The tick loop is a `lax.scan`, the stage-0
+    feed and last-stage collect are `where`-masked — no host round trips
+    per tick, and the program differentiates (ppermute and scan both have
+    transpose rules), so the same function serves forward and training.
+
+The reference has nothing to pipeline (its models are single-stage;
+SURVEY §2c.3) — this exists for the neural families and for parity with
+the multi-axis sharding contract (`__graft_entry__.dryrun_multichip`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+PP_AXIS = "pp"
+
+
+def pipeline_mesh(pp: int = -1, devices: list | None = None) -> Mesh:
+    """1-D ``pp`` mesh (stage i on device i)."""
+    import numpy as np
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if pp == -1:
+        pp = len(devices)
+    if pp < 1 or pp > len(devices):
+        raise ValueError(f"pp={pp} needs 1..{len(devices)} devices")
+    return Mesh(np.asarray(devices[:pp]), (PP_AXIS,))
+
+
+def make_pipeline_fn(
+    stage_fn: Callable, mesh: Mesh, axis: str = PP_AXIS
+) -> Callable:
+    """Build ``f(stacked_params, x) -> y`` running S pipelined stages.
+
+    ``stage_fn(params, a) -> a`` must preserve the activation shape
+    (homogeneous stages).  ``stacked_params`` leaves carry a leading S
+    axis (stage i's slice lives on device i); ``x`` is (M, mb, d)
+    microbatches, replicated in and out (the activation shapes here are
+    small; shard the batch dim with an outer dp axis when they aren't).
+    """
+    s = mesh.shape[axis]
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    def pipelined(stacked_params, x):
+        m = x.shape[0]
+        idx = jax.lax.axis_index(axis)
+        # in_specs=P(axis) split the stacked S axis across devices: each
+        # local slice must hold exactly ONE stage — a stage count that is
+        # a larger multiple of the mesh size would silently drop stages
+        for leaf in jax.tree.leaves(stacked_params):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stage count {leaf.shape[0] * s} != pp mesh size {s}"
+                    " — stack exactly one stage per pipeline device"
+                )
+        params = jax.tree.map(lambda p: p[0], stacked_params)
+
+        def tick(carry, t):
+            state, outbuf = carry
+            # stage 0 feeds microbatch t while t < M, zeros during drain
+            x_t = jax.lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            feed = jnp.where(t < m, 1.0, 0.0) * x_t
+            inp = jnp.where(idx == 0, feed, state)
+            out = stage_fn(params, inp)
+            nxt = jax.lax.ppermute(out, axis, perm)
+            # last stage collects out for microbatch t-(S-1)
+            pos = jnp.clip(t - (s - 1), 0, m - 1)
+            cur = jax.lax.dynamic_index_in_dim(
+                outbuf, pos, 0, keepdims=False
+            )
+            write = (idx == s - 1) & (t >= s - 1)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(write, out, cur), pos, 0
+            )
+            return (nxt, outbuf), None
+
+        state0 = jnp.zeros_like(x[0])
+        outbuf0 = jnp.zeros_like(x)
+        (_, outbuf), _ = jax.lax.scan(
+            tick, (state0, outbuf0), jnp.arange(m + s - 1)
+        )
+        # result lives on the last stage; mask + psum broadcasts it
+        return jax.lax.psum(
+            jnp.where(idx == s - 1, 1.0, 0.0) * outbuf, axis
+        )
+
+    return jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def stack_stage_params(param_list):
+    """[stage0_params, stage1_params, ...] → stacked (S, ...) pytree."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
