@@ -28,8 +28,18 @@ from har_tpu.parallel.pipeline_parallel import (
     pipeline_mesh,
     stack_stage_params,
 )
+from har_tpu.parallel.expert_parallel import (
+    EP_AXIS,
+    expert_mesh,
+    init_moe_params,
+    make_moe_fn,
+)
 
 __all__ = [
+    "EP_AXIS",
+    "expert_mesh",
+    "init_moe_params",
+    "make_moe_fn",
     "PP_AXIS",
     "make_pipeline_fn",
     "pipeline_mesh",

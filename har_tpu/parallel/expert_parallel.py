@@ -1,0 +1,166 @@
+"""Expert parallelism: switch-routed MoE with `all_to_all` dispatch.
+
+The fifth parallelism axis (dp/tp/pp/sp/ep): E feed-forward experts live
+one-per-device on an ``ep`` mesh axis, a top-1 (switch) router assigns
+each token an expert, and two `jax.lax.all_to_all` collectives carry
+tokens to their expert's device and back.  Dispatch/combine are one-hot
+einsums (the Mesh-TensorFlow/GShard formulation) so the whole layer is
+static-shape MXU work — no gathers, no dynamic shapes, differentiable end
+to end (`all_to_all` has a transpose rule, so the same function trains).
+
+Capacity semantics: each expert processes at most ``capacity`` tokens per
+shard; beyond it, tokens are *dropped* (their combine weight is zero and
+they contribute nothing) — the standard switch-transformer behavior.
+``dropless_capacity(n_local)`` returns the capacity at which dropping is
+impossible, which the exactness tests use.
+
+Like pipeline parallelism, nothing in the reference needs this (its
+models are single-expert by construction — SURVEY §2c.3); it completes
+the mesh-axis vocabulary for the neural families and the multi-axis
+driver contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+EP_AXIS = "ep"
+
+
+def expert_mesh(ep: int = -1, devices: list | None = None) -> Mesh:
+    """1-D ``ep`` mesh (expert i on device i)."""
+    from har_tpu.parallel.mesh import linear_mesh
+
+    return linear_mesh(ep, EP_AXIS, devices)
+
+
+def dropless_capacity(n_local: int) -> int:
+    """Capacity at which no token can be dropped (worst case: every local
+    token routes to the same expert)."""
+    return n_local
+
+
+def init_moe_params(
+    rng: jax.Array, num_experts: int, hidden: int, ff: int
+) -> dict:
+    """Router (replicated) + stacked expert FFNs (leading E axis)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale1 = (2.0 / hidden) ** 0.5
+    scale2 = (2.0 / ff) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (hidden, num_experts)) * 0.02,
+        "experts": {
+            "w1": jax.random.normal(k2, (num_experts, hidden, ff)) * scale1,
+            "b1": jnp.zeros((num_experts, ff)),
+            "w2": jax.random.normal(k3, (num_experts, ff, hidden)) * scale2,
+            "b2": jnp.zeros((num_experts, hidden)),
+        },
+    }
+
+
+def _expert_ffn(p, x):
+    return jax.nn.relu(x @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+
+def make_moe_fn(
+    mesh: Mesh, capacity: int, axis: str = EP_AXIS
+) -> Callable:
+    """Build ``f(params, x) -> (y, aux)`` for a switch-routed MoE layer.
+
+    ``x`` is (n, h) with n sharded over ``ep`` (tokens are data-sharded;
+    experts are model-sharded — the axis serves both roles, as in real
+    MoE deployments).  ``params["experts"]`` leaves carry a leading E
+    axis, one expert per device.  Returns the mixed output and an aux
+    dict with the load-balancing loss (switch-transformer's f·P dot) and
+    the per-expert assignment fractions.
+    """
+    e = mesh.shape[axis]
+
+    def moe(params, x):
+        for leaf in jax.tree.leaves(params["experts"]):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"expert count {leaf.shape[0] * e} != ep mesh size {e}"
+                    " — stack exactly one expert per device"
+                )
+        nl, h = x.shape
+        logits = x @ params["router"]  # (nl, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)  # top-1 routing
+        gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+
+        onehot = jax.nn.one_hot(expert, e, dtype=x.dtype)  # (nl, E)
+        # position of each token within its expert's capacity buffer;
+        # tokens past capacity drop out here — one_hot maps their
+        # out-of-range pos_id to an all-zero row
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        pos_id = pos.sum(-1).astype(jnp.int32)
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos_id, capacity, dtype=x.dtype)[:, None, :]
+        )  # (nl, E, C)
+        combine = dispatch * gate[:, None, None]
+
+        # tokens → expert devices: (E, C, h) → exchange → (S, C, h),
+        # the capacity slots every shard routed to MY expert
+        ein = jnp.einsum("nec,nh->ech", dispatch, x)
+        recv = jax.lax.all_to_all(
+            ein, axis, split_axis=0, concat_axis=0
+        )
+        my_expert = jax.tree.map(lambda p: p[0], params["experts"])
+        out = _expert_ffn(my_expert, recv.reshape(e * capacity, h))
+        # back to the token owners: shard j's row i holds outputs bound
+        # for shard i; the second all_to_all completes the round trip
+        send = jax.lax.all_to_all(
+            out.reshape(e, capacity, h), axis,
+            split_axis=0, concat_axis=0,
+        )
+        y = jnp.einsum("nec,ech->nh", combine, send)
+
+        # switch load-balance loss: E · Σ_e fraction_e · mean-prob_e,
+        # both averaged over the GLOBAL batch
+        frac = jax.lax.pmean(onehot.mean(0), axis)
+        mean_prob = jax.lax.pmean(probs.mean(0), axis)
+        aux = {
+            "load_balance_loss": e * jnp.sum(frac * mean_prob),
+            "expert_fraction": frac,
+        }
+        return y, aux
+
+    # router replicated, expert stacks split on their leading E axis,
+    # tokens split on the batch axis; aux scalars replicated
+    param_specs = {"router": P(), "experts": P(axis)}
+    return jax.shard_map(
+        moe,
+        mesh=mesh,
+        in_specs=(param_specs, P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+
+
+def moe_dense_reference(params, x):
+    """Every-token-through-its-expert, no parallelism — the exactness
+    oracle for `make_moe_fn` at dropless capacity."""
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    e = params["experts"]["w1"].shape[0]
+    outs = jnp.stack(
+        [
+            _expert_ffn(
+                jax.tree.map(lambda p: p[i], params["experts"]), x
+            )
+            for i in range(e)
+        ],
+        axis=1,
+    )  # (n, E, h)
+    sel = jnp.take_along_axis(
+        outs, expert[:, None, None].repeat(x.shape[-1], -1), 1
+    )[:, 0]
+    return gate[:, None] * sel

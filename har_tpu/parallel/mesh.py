@@ -50,6 +50,16 @@ def create_mesh(
     return Mesh(grid, (DP_AXIS, TP_AXIS))
 
 
+def linear_mesh(n: int, axis: str, devices: list | None = None) -> Mesh:
+    """1-D mesh over ``n`` devices with one named axis (pp/ep layouts)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n == -1:
+        n = len(devices)
+    if n < 1 or n > len(devices):
+        raise ValueError(f"{axis}={n} needs 1..{len(devices)} devices")
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
 def single_device_mesh(device=None) -> Mesh:
     """A 1×1 mesh — lets every code path be mesh-shaped even on one chip."""
     device = device or jax.devices()[0]

@@ -36,14 +36,9 @@ PP_AXIS = "pp"
 
 def pipeline_mesh(pp: int = -1, devices: list | None = None) -> Mesh:
     """1-D ``pp`` mesh (stage i on device i)."""
-    import numpy as np
+    from har_tpu.parallel.mesh import linear_mesh
 
-    devices = list(jax.devices()) if devices is None else list(devices)
-    if pp == -1:
-        pp = len(devices)
-    if pp < 1 or pp > len(devices):
-        raise ValueError(f"pp={pp} needs 1..{len(devices)} devices")
-    return Mesh(np.asarray(devices[:pp]), (PP_AXIS,))
+    return linear_mesh(pp, PP_AXIS, devices)
 
 
 def make_pipeline_fn(
