@@ -141,53 +141,31 @@ def evaluate_checkpoint(
 
     ``train_fraction``/``seed`` must match the values the checkpoint was
     trained with — the test partition is re-derived from them, so a
-    mismatch would leak training rows into the score.
+    mismatch would leak training rows into the score.  The feature view
+    (numeric / raw windows / ucihar) is re-derived from the checkpoint's
+    saved model name through the same runner logic that trained it.
     """
-    from har_tpu.config import DataConfig
-    from har_tpu.data.split import split_indices
-    from har_tpu.data.synthetic import synthetic_wisdm
-    from har_tpu.data.wisdm import load_wisdm, numeric_feature_view
-    from har_tpu.features.string_indexer import StringIndexer
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
     from har_tpu.ops.metrics import evaluate
+    from har_tpu.runner import featurize, load_dataset
 
     model = load_model(path)
-    if dataset == "ucihar":
-        from har_tpu.data.ucihar import (
-            load_ucihar,
-            synthetic_ucihar,
-            ucihar_feature_set,
-        )
-
-        table = (
-            load_ucihar(data_path)
-            if data_path
-            else synthetic_ucihar(n_rows=2000, seed=seed)
-        )
-        data = ucihar_feature_set(table)
-        x, y = data.features, data.label
-    elif dataset == "wisdm":
-        resolved = data_path or DataConfig().resolved_path()
-        table = (
-            load_wisdm(resolved)
-            if resolved
-            else synthetic_wisdm(n_rows=5418, seed=seed)
-        )
-        x, _ = numeric_feature_view(table)
-        y = np.asarray(
-            StringIndexer("ACTIVITY", "label")
-            .fit(table)
-            .transform(table)["label"],
-            np.int32,
-        )
-    else:
-        raise ValueError(f"unknown dataset {dataset!r}")
-    _, te = split_indices(
-        len(x), [train_fraction, 1.0 - train_fraction], seed=seed
+    with open(os.path.join(_abspath(path), _META)) as f:
+        model_name = json.load(f)["model_name"]
+    config = RunConfig(
+        data=DataConfig(
+            dataset=dataset,
+            path=data_path,
+            train_fraction=train_fraction,
+            seed=seed,
+        ),
+        model=ModelConfig(name=model_name),
     )
-    preds = model.transform(x[te])
-    rep = evaluate(y[te], preds.raw, model.num_classes)
+    _, test, _ = featurize(config, load_dataset(config))
+    preds = model.transform(test)
+    rep = evaluate(test.label, preds.raw, model.num_classes)
     return {
         "accuracy": rep["accuracy"],
         "f1": rep["f1"],
-        "n_test": int(len(te)),
+        "n_test": int(len(test)),
     }

@@ -25,11 +25,13 @@ def _parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="train + evaluate models, write report")
     t.add_argument("--dataset", default="wisdm",
-                   choices=["wisdm", "ucihar", "synthetic"])
+                   choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"],
+                   help="wisdm_raw = raw tri-axial stream (the view the "
+                        "cnn1d/bilstm/transformer models train on)")
     t.add_argument("--data-path", default=None)
     t.add_argument("--models", nargs="+",
                    default=["lr", "dt", "rf"],
-                   help="lr dt rf gbt mlp cnn1d bilstm")
+                   help="lr dt rf gbt mlp cnn1d bilstm transformer")
     t.add_argument("--train-fraction", type=float, default=0.7)
     t.add_argument("--seed", type=int, default=2018)
     t.add_argument("--no-cv", action="store_true",
@@ -53,7 +55,8 @@ def _parser() -> argparse.ArgumentParser:
 
     e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
     e.add_argument("--checkpoint", required=True)
-    e.add_argument("--dataset", default="wisdm", choices=["wisdm", "ucihar"])
+    e.add_argument("--dataset", default="wisdm",
+                   choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
     e.add_argument("--data-path", default=None)
     e.add_argument("--train-fraction", type=float, default=0.7,
                    help="must match the training run (test split re-derived)")
@@ -66,7 +69,7 @@ def _parser() -> argparse.ArgumentParser:
              "models × {70-30, 80-20, 90-10}",
     )
     s.add_argument("--dataset", default="wisdm",
-                   choices=["wisdm", "ucihar", "synthetic"])
+                   choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
     s.add_argument("--data-path", default=None)
     s.add_argument("--models", nargs="+", default=["lr", "dt", "rf"])
     s.add_argument("--fractions", nargs="+", type=float,

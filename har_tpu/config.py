@@ -30,7 +30,7 @@ def default_wisdm_path() -> str | None:
 class DataConfig:
     """Dataset + split configuration (reference Main/main.py:16-26,80)."""
 
-    dataset: str = "wisdm"  # wisdm | ucihar | synthetic
+    dataset: str = "wisdm"  # wisdm | wisdm_raw | ucihar | synthetic
     path: str | None = None
     # Columns dropped by the reference: USER + the 30 histogram-bin columns.
     drop_binned: bool = True

@@ -200,6 +200,7 @@ def stream_windows(
         return WindowedDataset(
             windows=np.empty((0, window, 3), np.float32),
             labels=np.empty((0,), np.int32),
+            class_names=stream.activity_names,
         )
     key = stream.user.astype(np.int64) << 32 | stream.activity.astype(np.int64)
     boundaries = np.flatnonzero(np.diff(key)) + 1
@@ -217,8 +218,10 @@ def stream_windows(
         return WindowedDataset(
             windows=np.empty((0, window, 3), np.float32),
             labels=np.empty((0,), np.int32),
+            class_names=stream.activity_names,
         )
     return WindowedDataset(
         windows=np.concatenate(wins, axis=0),
         labels=np.concatenate(labels),
+        class_names=stream.activity_names,
     )

@@ -27,10 +27,14 @@ WINDOW_STEPS = 200  # 10 s @ 20 Hz, the WISDM window
 
 @dataclasses.dataclass(frozen=True)
 class WindowedDataset:
-    """(n, T, 3) float32 windows with integer labels."""
+    """(n, T, 3) float32 windows with integer labels.
+
+    ``class_names[i]`` names label id i (None when the source carries no
+    names — e.g. hand-built test fixtures)."""
 
     windows: np.ndarray
     labels: np.ndarray
+    class_names: tuple[str, ...] | None = None
 
     def __len__(self) -> int:
         return len(self.windows)
@@ -39,7 +43,9 @@ class WindowedDataset:
         from har_tpu.data.split import split_indices
 
         return [
-            WindowedDataset(self.windows[idx], self.labels[idx])
+            WindowedDataset(
+                self.windows[idx], self.labels[idx], self.class_names
+            )
             for idx in split_indices(len(self), fractions, seed)
         ]
 
@@ -88,7 +94,7 @@ def synthetic_raw_stream(
     class_weights: tuple[float, ...] = (0.38, 0.30, 0.12, 0.10, 0.06, 0.04),
 ) -> WindowedDataset:
     """Directly generate labeled windows of synthetic accelerometer data."""
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng((seed, 20823))
     labels = rng.choice(
         len(ACTIVITIES), size=n_windows, p=np.asarray(class_weights)
     ).astype(np.int32)
@@ -107,4 +113,6 @@ def synthetic_raw_stream(
             windows[i, :, axis] = (
                 gravity[axis] + osc + rng.normal(0, 0.4, size=window)
             )
-    return WindowedDataset(windows=windows, labels=labels)
+    return WindowedDataset(
+        windows=windows, labels=labels, class_names=ACTIVITIES
+    )

@@ -66,7 +66,7 @@ def load_ucihar(root: str, split: str = "all") -> Table:
 
 def synthetic_ucihar(n_rows: int = 2000, seed: int = 0) -> Table:
     """Synthetic stand-in with the UCI-HAR shape (tests / no-data envs)."""
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng((seed, 20907))
     y = rng.integers(1, 7, size=n_rows)
     means = rng.normal(0.0, 1.5, size=(6, NUM_FEATURES))
     x = means[y - 1] + rng.normal(0.0, 1.0, size=(n_rows, NUM_FEATURES))

@@ -52,26 +52,39 @@ _CLASSICAL = {
     "gbdt": GradientBoostedTreesClassifier,
 }
 
-_NEURAL = ("mlp", "cnn1d", "bilstm")
+_NEURAL = ("mlp", "cnn1d", "bilstm", "transformer")
+# models that consume (n, T, 3) raw windows, not tabular feature vectors
+_RAW_MODELS = ("cnn1d", "bilstm", "transformer")
+
+def _neural_model_fields(name: str) -> set[str]:
+    """Attribute names of a neural family's Flax module (they are
+    dataclasses), minus flax-internal fields."""
+    if name == "transformer":
+        from har_tpu.models.transformer import Transformer1D as cls
+    else:
+        from har_tpu.models.neural import MODEL_REGISTRY
+
+        cls = MODEL_REGISTRY[name]
+    if not dataclasses.is_dataclass(cls):
+        return set()
+    return {
+        f.name
+        for f in dataclasses.fields(cls)
+        if f.name not in ("parent", "name")
+    }
+
 
 def _known_params() -> set[str]:
     """Every hyperparameter name any estimator accepts (classical fields,
     trainer knobs, neural module attributes); a param outside this union
     is a typo, not a cross-model knob, and must fail loudly."""
-    from har_tpu.models.neural import MODEL_REGISTRY
-
     known = {
         f.name
         for cls in _CLASSICAL.values()
         for f in dataclasses.fields(cls)
     } | {f.name for f in dataclasses.fields(TrainerConfig)}
-    for cls in MODEL_REGISTRY.values():
-        if dataclasses.is_dataclass(cls):
-            known |= {
-                f.name
-                for f in dataclasses.fields(cls)
-                if f.name not in ("parent", "name")
-            }
+    for name in _NEURAL:
+        known |= _neural_model_fields(name)
     return known
 
 
@@ -97,12 +110,24 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
         fields = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in params.items() if k in fields})
     if name in _NEURAL:
+        unknown = set(params) - _known_params()
+        if unknown:
+            raise ValueError(
+                f"unknown hyperparameter(s) {sorted(unknown)} — not "
+                "accepted by any estimator"
+            )
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
         cfg = TrainerConfig(
             **{k: params.pop(k) for k in list(params) if k in train_keys}
         )
+        # cross-model keys (other estimators' knobs) fall away here just
+        # like in the classical branch
+        fields = _neural_model_fields(name)
         return NeuralClassifier(
-            name, config=cfg, model_kwargs=params, mesh=mesh
+            name,
+            config=cfg,
+            model_kwargs={k: v for k, v in params.items() if k in fields},
+            mesh=mesh,
         )
     raise ValueError(f"unknown model {name!r}")
 
@@ -117,6 +142,34 @@ REFERENCE_GRIDS = {
 
 def load_dataset(config: RunConfig):
     path = config.data.resolved_path()
+    if config.data.dataset == "wisdm_raw":
+        # the raw tri-axial stream (BASELINE.json configs 3/5): a real
+        # WISDM_ar_v1.1_raw.txt via the native parser, or the synthetic
+        # class-conditional generator when no path is given
+        from har_tpu.data.raw_loader import load_raw_stream, stream_windows
+        from har_tpu.data.raw_windows import (
+            WindowedDataset,
+            synthetic_raw_stream,
+        )
+        from har_tpu.data.wisdm import ACTIVITIES
+
+        if config.data.path is not None:
+            stream = load_raw_stream(config.data.path)
+            ds = stream_windows(stream)
+            # parser ids are first-appearance order; remap to the
+            # canonical WISDM label order when the names line up
+            if set(stream.activity_names) <= set(ACTIVITIES):
+                remap = np.asarray(
+                    [ACTIVITIES.index(n) for n in stream.activity_names],
+                    np.int32,
+                )
+                ds = WindowedDataset(
+                    ds.windows, remap[ds.labels], class_names=ACTIVITIES
+                )
+            # non-canonical names (e.g. WISDM v2 activities) keep the
+            # parser's first-appearance ids + names from stream_windows
+            return ds
+        return synthetic_raw_stream(n_windows=4000, seed=config.data.seed)
     if config.data.dataset == "synthetic":
         return synthetic_wisdm(n_rows=5418, seed=config.data.seed)
     if config.data.dataset == "wisdm":
@@ -134,13 +187,22 @@ def load_dataset(config: RunConfig):
 
 def _feature_mode(config: RunConfig) -> str:
     """Which feature view this config's model trains on."""
+    name = canonical_model_name(config.model.name)
+    if config.data.dataset == "wisdm_raw":
+        # raw-window models consume the windows directly; everything
+        # else gets the jitted 43-feature WISDM transform of them
+        return "raw" if name in _RAW_MODELS else "raw_features"
+    if name in _RAW_MODELS:
+        raise ValueError(
+            f"{name} trains on raw (T, 3) windows — use "
+            "--dataset wisdm_raw (optionally --data-path "
+            "WISDM_ar_v1.1_raw.txt), not a tabular dataset "
+            f"({config.data.dataset})"
+        )
     if config.data.dataset == "ucihar":
         return "ucihar"
     return getattr(config.model, "feature_view", None) or (
-        "numeric"
-        if canonical_model_name(config.model.name)
-        in (*_NEURAL, "gbdt")
-        else "onehot"
+        "numeric" if name in ("mlp", "gbdt") else "onehot"
     )
 
 
@@ -158,6 +220,18 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
         return train, test, None
     mode = _feature_mode(config)
+    if mode in ("raw", "raw_features"):
+        # table is a WindowedDataset here (load_dataset, wisdm_raw)
+        if mode == "raw":
+            x = np.asarray(table.windows, np.float32)
+        else:
+            from har_tpu.features.raw_features import extract_features
+
+            x = np.asarray(extract_features(table.windows), np.float32)
+        full = FeatureSet(features=x, label=np.asarray(table.labels, np.int32))
+        frac = config.data.train_fraction
+        train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
+        return train, test, None
     if mode == "numeric":
         from har_tpu.data.wisdm import BINNED_COLUMNS
         from har_tpu.features.string_indexer import StringIndexer
@@ -184,6 +258,34 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
     frac = config.data.train_fraction
     train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
     return train, test, pipe_model
+
+
+def _views_for(models, config: RunConfig, table, timer=None):
+    """Resolve each model's feature view, featurizing once per view.
+
+    Raises before any featurization if some model can't run on this
+    dataset.  Returns ``(modes, view_cache)`` — ``view_cache[mode]`` is
+    the (train, test) pair every model with that mode trains on.
+    Shared by run() and sweep() so the two entry points can never drift
+    onto different views for the same model.
+    """
+    model_cfgs = {
+        name: dataclasses.replace(
+            config, model=dataclasses.replace(config.model, name=name)
+        )
+        for name in models
+    }
+    modes = {name: _feature_mode(cfg) for name, cfg in model_cfgs.items()}
+    view_cache: dict[str, tuple] = {}
+    for name in models:
+        if modes[name] not in view_cache:
+            if timer is not None:
+                with timer("featurize"):
+                    view = featurize(model_cfgs[name], table)[:2]
+            else:
+                view = featurize(model_cfgs[name], table)[:2]
+            view_cache[modes[name]] = view
+    return modes, view_cache
 
 
 @dataclasses.dataclass
@@ -259,19 +361,12 @@ def sweep(
             config,
             data=dataclasses.replace(config.data, train_fraction=frac),
         )
-        # each model trains on the same view `run()` would give it
-        # (featurize keys the view off model.name), computed once per
-        # distinct view per split
-        view_cache: dict[str, tuple] = {}
+        # each model trains on the same view `run()` would give it,
+        # computed once per distinct view per split
+        modes, view_cache = _views_for(models, cfg, table)
         split_name = f"{round(frac * 100)}-{round((1 - frac) * 100)}"
         for name in models:
-            model_cfg = dataclasses.replace(
-                cfg, model=dataclasses.replace(cfg.model, name=name)
-            )
-            mode = _feature_mode(model_cfg)
-            if mode not in view_cache:
-                view_cache[mode] = featurize(model_cfg, table)[:2]
-            train, test = view_cache[mode]
+            train, test = view_cache[modes[name]]
             est = build_estimator(name, config.model.params)
             jobs = [(name, est)]
             if with_cv and name in REFERENCE_GRIDS:
@@ -336,15 +431,24 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
     report.line("Loading Data Set...")
     with timer("load"):
         table = load_dataset(config)
-    report.schema(table)
-    report.sample(table)
-    if "ACTIVITY" in table.column_names:
-        report.class_counts(table["ACTIVITY"])
-    report.summary(table)
-
-    with timer("featurize"):
-        train, test, _ = featurize(config, table)
-    report.split_counts(len(train), len(test))
+    is_raw = not hasattr(table, "column_names")  # WindowedDataset
+    if is_raw:
+        report.line(
+            f"Raw windows: {tuple(table.windows.shape)} "
+            f"({table.windows.shape[1]} steps, tri-axial)"
+        )
+        names = table.class_names or tuple(
+            str(i) for i in range(int(table.labels.max()) + 1)
+        )
+        report.class_counts(
+            [names[i] for i in np.asarray(table.labels)]
+        )
+    else:
+        report.schema(table)
+        report.sample(table)
+        if "ACTIVITY" in table.column_names:
+            report.class_counts(table["ACTIVITY"])
+        report.summary(table)
 
     models = [
         canonical_model_name(m)
@@ -353,8 +457,15 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
             or ["logistic_regression", "decision_tree", "random_forest"]
         )
     ]
+    # resolve every model's view up front (raises before any training if
+    # a model can't run on this dataset), featurizing each view once
+    modes, view_cache = _views_for(models, config, table, timer=timer)
+    first_train, first_test = view_cache[modes[models[0]]]
+    report.split_counts(len(first_train), len(first_test))
+
     results = []
     for name in models:
+        train, test = view_cache[modes[name]]
         est = build_estimator(name, config.model.params)
         results.append(
             _fit_eval(est, name, train, test, report, timer=timer)
@@ -381,7 +492,7 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
                 )
             )
 
-    if with_eda:
+    if with_eda and not is_raw:
         from har_tpu.reporting.eda import save_eda_plots
 
         numeric = [c for c in WISDM_NUMERIC_COLUMNS if c in table.column_names]

@@ -60,3 +60,22 @@ def test_train_checkpointer_resume(tmp_path):
         np.testing.assert_allclose(p1["w"], np.ones((3, 2)))
     finally:
         ck.close()
+
+
+def test_evaluate_checkpoint_raw_model(tmp_path):
+    """Save a raw-window model, re-score it via the evaluate backend."""
+    from har_tpu.checkpoint import evaluate_checkpoint, save_model
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
+    from har_tpu.runner import build_estimator, featurize, load_dataset
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="wisdm_raw", seed=5),
+        model=ModelConfig(name="cnn1d"),
+    )
+    train, _, _ = featurize(cfg, load_dataset(cfg))
+    est = build_estimator("cnn1d", {"epochs": 2, "batch_size": 64})
+    model = est.fit(train)
+    path = save_model(str(tmp_path / "ckpt"), model, "cnn1d")
+    rep = evaluate_checkpoint(path, dataset="wisdm_raw", seed=5)
+    assert rep["accuracy"] > 0.5
+    assert rep["n_test"] > 0
