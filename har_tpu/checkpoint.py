@@ -35,8 +35,14 @@ def _abspath(path: str) -> str:
 
 
 def save_model(path: str, model: NeuralClassifierModel, model_name: str,
-               model_kwargs: dict | None = None) -> str:
-    """Persist a trained neural classifier (params + scaler + config)."""
+               model_kwargs: dict | None = None,
+               dataset: str | None = None) -> str:
+    """Persist a trained neural classifier (params + scaler + config).
+
+    ``dataset`` records which dataset (and thereby which feature view)
+    the model was trained on, so `evaluate_checkpoint` can re-derive the
+    matching test features without the caller re-stating it.
+    """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
     with ocp.PyTreeCheckpointer() as ckptr:
@@ -50,6 +56,8 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
         "model_kwargs": model_kwargs or {},
         "num_classes": model.num_classes,
     }
+    if dataset is not None:
+        meta["dataset"] = dataset
     if model.scaler is not None:
         meta["scaler"] = {
             "mean": np.asarray(model.scaler.mean).tolist(),
@@ -133,7 +141,7 @@ class TrainCheckpointer:
 def evaluate_checkpoint(
     path: str,
     data_path: str | None = None,
-    dataset: str = "wisdm",
+    dataset: str | None = None,
     train_fraction: float = 0.7,
     seed: int = 2018,
 ) -> dict:
@@ -142,8 +150,10 @@ def evaluate_checkpoint(
     ``train_fraction``/``seed`` must match the values the checkpoint was
     trained with — the test partition is re-derived from them, so a
     mismatch would leak training rows into the score.  The feature view
-    (numeric / raw windows / ucihar) is re-derived from the checkpoint's
-    saved model name through the same runner logic that trained it.
+    is re-derived from the checkpoint's saved model name + dataset
+    through the same runner logic that trained it; ``dataset=None``
+    uses the recorded one, and an explicit value that contradicts the
+    recording is refused (the features would not match the params).
     """
     from har_tpu.config import DataConfig, ModelConfig, RunConfig
     from har_tpu.ops.metrics import evaluate
@@ -151,7 +161,17 @@ def evaluate_checkpoint(
 
     model = load_model(path)
     with open(os.path.join(_abspath(path), _META)) as f:
-        model_name = json.load(f)["model_name"]
+        meta = json.load(f)
+    model_name = meta["model_name"]
+    saved_dataset = meta.get("dataset")
+    if dataset is None:
+        dataset = saved_dataset or "wisdm"
+    elif saved_dataset is not None and dataset != saved_dataset:
+        raise ValueError(
+            f"checkpoint was trained on dataset {saved_dataset!r}; "
+            f"evaluating against {dataset!r} would derive a different "
+            "feature view than the saved parameters expect"
+        )
     config = RunConfig(
         data=DataConfig(
             dataset=dataset,

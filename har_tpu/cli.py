@@ -55,8 +55,10 @@ def _parser() -> argparse.ArgumentParser:
 
     e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
     e.add_argument("--checkpoint", required=True)
-    e.add_argument("--dataset", default="wisdm",
-                   choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
+    e.add_argument("--dataset", default=None,
+                   choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"],
+                   help="defaults to the dataset recorded in the "
+                        "checkpoint metadata")
     e.add_argument("--data-path", default=None)
     e.add_argument("--train-fraction", type=float, default=0.7,
                    help="must match the training run (test split re-derived)")

@@ -95,27 +95,21 @@ def canonical_model_name(name: str) -> str:
 def build_estimator(name: str, params: dict | None = None, mesh=None):
     name = canonical_model_name(name)
     params = dict(params or {})
+    # one params dict serves every model in --models: each estimator
+    # keeps only the knobs it has (trainer-only keys and other
+    # estimators' keys fall away) — but names NO estimator anywhere
+    # accepts are typos and must fail loudly
+    unknown = set(params) - _known_params()
+    if unknown:
+        raise ValueError(
+            f"unknown hyperparameter(s) {sorted(unknown)} — not "
+            "accepted by any estimator"
+        )
     if name in _CLASSICAL:
-        # one params dict serves every model in --models: keep only the
-        # knobs this estimator actually has (trainer-only keys and other
-        # estimators' keys fall away) — but reject names no estimator
-        # anywhere accepts, so misspellings don't silently train defaults
-        unknown = set(params) - _known_params()
-        if unknown:
-            raise ValueError(
-                f"unknown hyperparameter(s) {sorted(unknown)} — not "
-                "accepted by any estimator"
-            )
         cls = _CLASSICAL[name]
         fields = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in params.items() if k in fields})
     if name in _NEURAL:
-        unknown = set(params) - _known_params()
-        if unknown:
-            raise ValueError(
-                f"unknown hyperparameter(s) {sorted(unknown)} — not "
-                "accepted by any estimator"
-            )
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
         cfg = TrainerConfig(
             **{k: params.pop(k) for k in list(params) if k in train_keys}
@@ -212,14 +206,14 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
     UCI-HAR tables are already numeric (561 FEAT_* columns) and bypass the
     WISDM-specific views entirely.
     """
-    if config.data.dataset == "ucihar":
+    mode = _feature_mode(config)  # raises for impossible model/dataset
+    if mode == "ucihar":
         from har_tpu.data.ucihar import ucihar_feature_set
 
         full = ucihar_feature_set(table)
         frac = config.data.train_fraction
         train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
         return train, test, None
-    mode = _feature_mode(config)
     if mode in ("raw", "raw_features"):
         # table is a WindowedDataset here (load_dataset, wisdm_raw)
         if mode == "raw":

@@ -2,6 +2,7 @@
 
 import numpy as np
 import optax
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -79,3 +80,26 @@ def test_evaluate_checkpoint_raw_model(tmp_path):
     rep = evaluate_checkpoint(path, dataset="wisdm_raw", seed=5)
     assert rep["accuracy"] > 0.5
     assert rep["n_test"] > 0
+
+
+def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
+    from har_tpu.checkpoint import evaluate_checkpoint, save_model
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
+    from har_tpu.runner import build_estimator, featurize, load_dataset
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="wisdm_raw", seed=5),
+        model=ModelConfig(name="cnn1d"),
+    )
+    train, _, _ = featurize(cfg, load_dataset(cfg))
+    model = build_estimator("cnn1d", {"epochs": 1, "batch_size": 64}).fit(
+        train
+    )
+    path = save_model(
+        str(tmp_path / "ckpt"), model, "cnn1d", dataset="wisdm_raw"
+    )
+    # None → recorded dataset; mismatching explicit dataset refused
+    rep = evaluate_checkpoint(path, seed=5)
+    assert rep["n_test"] > 0
+    with pytest.raises(ValueError, match="trained on dataset 'wisdm_raw'"):
+        evaluate_checkpoint(path, dataset="wisdm", seed=5)
