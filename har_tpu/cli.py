@@ -42,6 +42,10 @@ def _parser() -> argparse.ArgumentParser:
     t.add_argument("--epochs", type=int, default=None)
     t.add_argument("--batch-size", type=int, default=None)
     t.add_argument("--learning-rate", type=float, default=None)
+    t.add_argument("--checkpoint-dir", default=None,
+                   help="snapshot (params, opt_state) here during neural "
+                        "training and auto-resume from the newest one")
+    t.add_argument("--save-every-epochs", type=int, default=None)
     t.add_argument("--keep-binned", action="store_true",
                    help="keep the 30 histogram-bin columns X0..Z9 the "
                         "reference drops (Main/main.py:22-26); gbt's "
@@ -131,7 +135,8 @@ def main(argv=None) -> int:
 
     models = [canonical_model_name(m) for m in args.models]
     neural_params = {}
-    for k in ("epochs", "batch_size", "learning_rate"):
+    for k in ("epochs", "batch_size", "learning_rate",
+              "checkpoint_dir", "save_every_epochs"):
         v = getattr(args, k)
         if v is not None:
             neural_params[k] = v

@@ -97,8 +97,10 @@ def make_gspmd_scan_fit(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
 ) -> Callable:
-    """fit(params, opt_state, rng, x, y, batch_idx) → (params, opt_state, losses).
+    """fit(params, opt_state, rng, x, y, batch_idx, step0) → (params, opt_state, losses).
 
+    ``step0`` is the global index of the first step (nonzero when a
+    checkpointed run executes in chunks).
     Inputs' placements drive the partitioning: params arrive tp-sharded
     (see `shard_params`), x/y replicated, and each gathered batch is
     constrained to ``P(dp)`` — XLA propagates from there and inserts the
@@ -106,7 +108,7 @@ def make_gspmd_scan_fit(
     psum: the compiler's reduction IS the treeAggregate equivalent).
     """
 
-    def fit(params, opt_state, rng, x, y, batch_idx):
+    def fit(params, opt_state, rng, x, y, batch_idx, step0):
         def step(carry, step_and_idx):
             params, opt_state = carry
             step_i, idx = step_and_idx
@@ -132,7 +134,8 @@ def make_gspmd_scan_fit(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss
 
-        steps = jnp.arange(batch_idx.shape[0])
+        # step0: global step numbering across checkpointed chunks
+        steps = step0 + jnp.arange(batch_idx.shape[0])
         (params, opt_state), losses = jax.lax.scan(
             step, (params, opt_state), (steps, batch_idx)
         )

@@ -39,6 +39,42 @@ class TrainerConfig:
     warmup_fraction: float = 0.1
     seed: int = 0
     log_every: int = 0  # 0 → silent
+    # fault tolerance: checkpoint (params, opt_state) every N epochs
+    # under checkpoint_dir and auto-resume from the latest snapshot.
+    # Snapshots live in a subdirectory keyed by a fingerprint of the
+    # training data + schedule config, so a resume only ever matches the
+    # identical run (CV folds, refits, or changed seeds/batch sizes each
+    # get their own slot instead of silently adopting another run's
+    # params).  The batch schedule is derived deterministically from
+    # `seed`, so an interrupted-and-resumed run executes the same step
+    # sequence as an uninterrupted one (tested equal).
+    # save_every_epochs=0 with a checkpoint_dir means every epoch.
+    checkpoint_dir: str | None = None
+    save_every_epochs: int = 0
+
+
+def _run_fingerprint(cfg: TrainerConfig, x: np.ndarray, y: np.ndarray) -> str:
+    """Stable id for (data, schedule): the checkpoint-slot key.
+
+    Hashes shapes, a data sample, and every config field that shapes the
+    step sequence or optimizer schedule — two fits resume each other's
+    snapshots only when they would execute the identical run.
+    """
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(repr((x.shape, y.shape, str(x.dtype))).encode())
+    h.update(np.ascontiguousarray(x[:64]).tobytes())
+    h.update(np.ascontiguousarray(y[:64]).tobytes())
+    h.update(
+        repr(
+            (
+                cfg.batch_size, cfg.epochs, cfg.learning_rate,
+                cfg.weight_decay, cfg.warmup_fraction, cfg.seed,
+            )
+        ).encode()
+    )
+    return h.hexdigest()[:16]
 
 
 def make_optimizer(cfg: TrainerConfig, total_steps: int):
@@ -96,9 +132,12 @@ def make_scan_fit(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
 ) -> Callable:
-    """fit(params, opt_state, rng, x, y, batch_idx) -> (params, opt_state, losses).
+    """fit(params, opt_state, rng, x, y, batch_idx, step0) -> (params, opt_state, losses).
 
-    The whole training run as ONE compiled program: `lax.scan` over
+    ``step0`` is the global index of the first step (nonzero when a
+    checkpointed run executes in chunks — keeps per-step rng folds on
+    the uninterrupted schedule).  The whole training run as ONE
+    compiled program: `lax.scan` over
     precomputed shuffled batch indices, gathering each batch from the
     device-resident dataset.  This amortizes host→device dispatch latency
     (the per-step python loop costs ~0.5 s/step through a remote-chip
@@ -109,7 +148,7 @@ def make_scan_fit(
     (total_steps, batch_size) and is sharded on its second axis.
     """
 
-    def local_fit(params, opt_state, rng, x, y, batch_idx):
+    def local_fit(params, opt_state, rng, x, y, batch_idx, step0):
         shard = jax.lax.axis_index(DP_AXIS)
 
         def step(carry, step_and_idx):
@@ -141,7 +180,10 @@ def make_scan_fit(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), loss_sum / count
 
-        steps = jnp.arange(batch_idx.shape[0])
+        # step0 keeps global step numbering when the run is executed in
+        # checkpointed chunks (per-step rng folds stay aligned with the
+        # uninterrupted schedule)
+        steps = step0 + jnp.arange(batch_idx.shape[0])
         (params, opt_state), losses = jax.lax.scan(
             step, (params, opt_state), (steps, batch_idx)
         )
@@ -151,7 +193,7 @@ def make_scan_fit(
     fit = jax.shard_map(
         local_fit,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, rep, P(None, DP_AXIS)),
+        in_specs=(rep, rep, rep, rep, rep, P(None, DP_AXIS), rep),
         out_specs=(rep, rep, rep),
         check_vma=False,
     )
@@ -258,6 +300,16 @@ class Trainer:
                 "tensor parallelism (tp>1 mesh) requires scan=True — the "
                 "streaming path would silently train replicated params"
             )
+        if cfg.save_every_epochs and not cfg.checkpoint_dir:
+            raise ValueError(
+                "save_every_epochs is set but checkpoint_dir is not — "
+                "snapshots have nowhere to go"
+            )
+        if cfg.checkpoint_dir and not self.scan:
+            raise ValueError(
+                "mid-training checkpointing is implemented for the "
+                "scanned path (scan=True)"
+            )
         if self.scan:
             batch_idx = np.stack(
                 [
@@ -285,19 +337,98 @@ class Trainer:
                 )
             else:
                 fit = make_scan_fit(self.module.apply, optimizer, mesh)
-            params, opt_state, losses = fit(
-                params,
-                opt_state,
-                step_root,
-                jnp.asarray(x),
-                jnp.asarray(y),
-                jnp.asarray(batch_idx),
-            )
-            losses = np.asarray(losses)  # blocks until the run finishes
-            history["loss"] = list(
-                losses.reshape(cfg.epochs, steps_per_epoch)[:, -1]
-            )
-            step_idx = len(batch_idx)
+            x_dev, y_dev = jnp.asarray(x), jnp.asarray(y)
+            start_epoch = 0
+            if cfg.checkpoint_dir:
+                # fault tolerance: run in save_every_epochs chunks — one
+                # dispatch each — snapshotting (params, opt_state) after
+                # every chunk and resuming from the newest snapshot.  The
+                # batch schedule and per-step rng are derived from global
+                # step numbers, so resumed runs retrace the uninterrupted
+                # step sequence exactly.  Snapshots live under a
+                # fingerprint of (data, schedule config): only the
+                # identical run resumes them.
+                import os
+
+                from har_tpu.checkpoint import TrainCheckpointer
+
+                ckpt_every = cfg.save_every_epochs or 1
+                slot = os.path.join(
+                    cfg.checkpoint_dir, _run_fingerprint(cfg, x, y)
+                )
+                ckptr = TrainCheckpointer(slot)
+                try:
+                    restored = ckptr.restore(
+                        template={
+                            "params": jax.device_get(params),
+                            "opt_state": jax.device_get(opt_state),
+                        }
+                    )
+                    if restored is not None:
+                        start_epoch, params, opt_state = restored
+                        start_epoch = min(start_epoch, cfg.epochs)
+                        if tp > 1:
+                            # restored leaves are host arrays; re-place
+                            # params in the tp layout and the optimizer
+                            # state replicated mesh-wide (GSPMD reshards
+                            # mu/nu on first use, and the first chunk's
+                            # donated output re-adopts the computed
+                            # sharded layout for the rest of the run)
+                            from jax.sharding import (
+                                NamedSharding,
+                                PartitionSpec,
+                            )
+
+                            params = shard_params(params, mesh, specs)
+                            rep = NamedSharding(mesh, PartitionSpec())
+                            opt_state = jax.tree.map(
+                                lambda res: jax.device_put(res, rep),
+                                opt_state,
+                            )
+                    chunks_losses = []
+                    epoch = start_epoch
+                    while epoch < cfg.epochs:
+                        chunk = min(ckpt_every, cfg.epochs - epoch)
+                        lo = epoch * steps_per_epoch
+                        hi = (epoch + chunk) * steps_per_epoch
+                        params, opt_state, losses = fit(
+                            params, opt_state, step_root, x_dev, y_dev,
+                            jnp.asarray(batch_idx[lo:hi]),
+                            jnp.asarray(lo, jnp.int32),
+                        )
+                        chunks_losses.append(np.asarray(losses))
+                        epoch += chunk
+                        ckptr.save(epoch, params, opt_state)
+                finally:
+                    ckptr.close()
+                losses = (
+                    np.concatenate(chunks_losses)
+                    if chunks_losses
+                    else np.zeros((0,), np.float32)
+                )
+                history["resumed_from_epoch"] = start_epoch
+                history["loss"] = (
+                    list(
+                        losses.reshape(-1, steps_per_epoch)[:, -1]
+                    )
+                    if len(losses)
+                    else []
+                )
+            else:
+                params, opt_state, losses = fit(
+                    params,
+                    opt_state,
+                    step_root,
+                    x_dev,
+                    y_dev,
+                    jnp.asarray(batch_idx),
+                    jnp.asarray(0, jnp.int32),
+                )
+                losses = np.asarray(losses)  # blocks until the run ends
+                history["loss"] = list(
+                    losses.reshape(cfg.epochs, steps_per_epoch)[:, -1]
+                )
+            step_idx = (cfg.epochs - start_epoch) * steps_per_epoch
         else:
             step = make_train_step(self.module.apply, optimizer, mesh)
             x_shard = batch_sharding(mesh, x.ndim)

@@ -103,3 +103,164 @@ def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
     assert rep["n_test"] > 0
     with pytest.raises(ValueError, match="trained on dataset 'wisdm_raw'"):
         evaluate_checkpoint(path, dataset="wisdm", seed=5)
+
+
+def _resume_data(n=96, d=8, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y = (x @ w).argmax(1).astype(np.int32)
+    return x, y
+
+
+def test_resumed_training_equals_uninterrupted(tmp_path):
+    """Interrupt after 2/6 epochs, resume, compare to a straight run."""
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data()
+    mk = lambda **kw: Trainer(
+        MLP(num_classes=4, hidden=(16,), dropout_rate=0.0),
+        TrainerConfig(batch_size=32, epochs=6, learning_rate=1e-2,
+                      seed=7, **kw),
+    )
+    straight = mk().fit(x, y)
+
+    ckdir = str(tmp_path / "ck")
+    # crash the SAME 6-epoch run right after its first 2-epoch snapshot
+    from har_tpu.checkpoint import TrainCheckpointer
+
+    orig_save = TrainCheckpointer.save
+    saves = []
+
+    def crashing_save(self, epoch, params, opt_state):
+        orig_save(self, epoch, params, opt_state)
+        saves.append(epoch)
+        raise RuntimeError("simulated crash")
+
+    TrainCheckpointer.save = crashing_save
+    try:
+        with pytest.raises(RuntimeError, match="simulated crash"):
+            mk(checkpoint_dir=ckdir, save_every_epochs=2).fit(x, y)
+    finally:
+        TrainCheckpointer.save = orig_save
+    assert saves == [2]
+
+    resumed = mk(checkpoint_dir=ckdir, save_every_epochs=2).fit(x, y)
+    assert resumed.history["resumed_from_epoch"] == 2
+    np.testing.assert_allclose(
+        resumed.history["loss"],
+        straight.history["loss"][2:],
+        rtol=1e-4,
+    )
+    for a, b in zip(
+        jax.tree.leaves(straight.params),
+        jax.tree.leaves(resumed.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-6
+        )
+
+
+def test_chunked_run_equals_single_dispatch(tmp_path):
+    """No interruption: checkpointed chunks == one-dispatch run exactly."""
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data(seed=1)
+    module = lambda: MLP(num_classes=4, hidden=(16,), dropout_rate=0.0)
+    one = Trainer(
+        module(),
+        TrainerConfig(batch_size=32, epochs=4, learning_rate=1e-2, seed=9),
+    ).fit(x, y)
+    chunked = Trainer(
+        module(),
+        TrainerConfig(batch_size=32, epochs=4, learning_rate=1e-2, seed=9,
+                      checkpoint_dir=str(tmp_path / "ck2"),
+                      save_every_epochs=2),
+    ).fit(x, y)
+    np.testing.assert_allclose(
+        chunked.history["loss"], one.history["loss"], rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(one.params), jax.tree.leaves(chunked.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_checkpoint_slots_keyed_by_data_and_config(tmp_path):
+    """Different data or schedule never resumes another run's snapshot."""
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    ckdir = str(tmp_path / "shared")
+    mk = lambda **kw: Trainer(
+        MLP(num_classes=4, hidden=(8,), dropout_rate=0.0),
+        TrainerConfig(batch_size=32, epochs=2, learning_rate=1e-2, seed=7,
+                      checkpoint_dir=ckdir, save_every_epochs=2, **kw),
+    )
+    x1, y1 = _resume_data(seed=0)
+    x2, y2 = _resume_data(seed=9)  # a "CV fold": different rows
+    m1 = mk().fit(x1, y1)
+    m2 = mk().fit(x2, y2)  # same dir, different data → fresh training
+    assert m1.history["resumed_from_epoch"] == 0
+    assert m2.history["resumed_from_epoch"] == 0
+    # identical rerun DOES resume (and trains zero further epochs)
+    m3 = mk().fit(x1, y1)
+    assert m3.history["resumed_from_epoch"] == 2
+    # changed schedule → own slot, fresh training
+    m4 = Trainer(
+        MLP(num_classes=4, hidden=(8,), dropout_rate=0.0),
+        TrainerConfig(batch_size=16, epochs=2, learning_rate=1e-2, seed=7,
+                      checkpoint_dir=ckdir, save_every_epochs=2),
+    ).fit(x1, y1)
+    assert m4.history["resumed_from_epoch"] == 0
+
+
+def test_save_every_without_dir_raises():
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data()
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Trainer(
+            MLP(num_classes=4), TrainerConfig(save_every_epochs=2)
+        ).fit(x, y)
+
+
+def test_tp_resume_restores_sharded_layout(tmp_path):
+    """Resuming a tensor-parallel run re-places params on the tp axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.parallel import create_mesh
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data(d=8, c=4)
+    mesh = create_mesh(dp=2, tp=4)
+    cfg = TrainerConfig(batch_size=32, epochs=4, learning_rate=1e-2,
+                        seed=3, checkpoint_dir=str(tmp_path / "cktp"),
+                        save_every_epochs=2)
+    mk = lambda: Trainer(
+        MLP(num_classes=4, hidden=(16,), dropout_rate=0.0), cfg, mesh=mesh
+    )
+
+    from har_tpu.checkpoint import TrainCheckpointer
+
+    orig_save = TrainCheckpointer.save
+
+    def crashing_save(self, epoch, params, opt_state):
+        orig_save(self, epoch, params, opt_state)
+        raise RuntimeError("crash")
+
+    TrainCheckpointer.save = crashing_save
+    try:
+        with pytest.raises(RuntimeError):
+            mk().fit(x, y)
+    finally:
+        TrainCheckpointer.save = orig_save
+    resumed = mk().fit(x, y)
+    assert resumed.history["resumed_from_epoch"] == 2
+    assert np.isfinite(resumed.history["loss"]).all()
