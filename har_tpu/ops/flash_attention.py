@@ -93,6 +93,11 @@ def _flash_bht(q, k, v, block_q: int, block_k: int):
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        # every grid step owns a disjoint output block → both dims are
+        # free for Mosaic to parallelize
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
         interpret=jax.default_backend() != "tpu",
     )(q, k, v)
 

@@ -31,6 +31,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # feature tile must keep the bins block's lane dim at 128; the row tile is
 # sized so the two (NT, DT·B) f32 VMEM temporaries fit comfortably
@@ -89,6 +90,11 @@ def _hist_padded(bins, m, max_bins: int):
         ],
         out_specs=pl.BlockSpec(
             (wc, _DT * max_bins), lambda j, i: (0, j)
+        ),
+        # feature tiles are independent; row tiles accumulate into the
+        # same output block and must stay sequential
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=jax.default_backend() != "tpu",
     )(bins, m)

@@ -53,16 +53,20 @@ class TrainerConfig:
     save_every_epochs: int = 0
 
 
-def _run_fingerprint(cfg: TrainerConfig, x: np.ndarray, y: np.ndarray) -> str:
-    """Stable id for (data, schedule): the checkpoint-slot key.
+def _run_fingerprint(
+    cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module
+) -> str:
+    """Stable id for (model, data, schedule): the checkpoint-slot key.
 
-    Hashes shapes, a data sample, and every config field that shapes the
-    step sequence or optimizer schedule — two fits resume each other's
-    snapshots only when they would execute the identical run.
+    Hashes the module's configuration (Flax modules repr their dataclass
+    fields), data shapes + a sample, and every config field that shapes
+    the step sequence or optimizer schedule — two fits resume each
+    other's snapshots only when they would execute the identical run.
     """
     import hashlib
 
     h = hashlib.sha1()
+    h.update(repr(module).encode())
     h.update(repr((x.shape, y.shape, str(x.dtype))).encode())
     h.update(np.ascontiguousarray(x[:64]).tobytes())
     h.update(np.ascontiguousarray(y[:64]).tobytes())
@@ -300,6 +304,8 @@ class Trainer:
                 "tensor parallelism (tp>1 mesh) requires scan=True — the "
                 "streaming path would silently train replicated params"
             )
+        if cfg.save_every_epochs < 0:
+            raise ValueError("save_every_epochs must be >= 0")
         if cfg.save_every_epochs and not cfg.checkpoint_dir:
             raise ValueError(
                 "save_every_epochs is set but checkpoint_dir is not — "
@@ -354,7 +360,8 @@ class Trainer:
 
                 ckpt_every = cfg.save_every_epochs or 1
                 slot = os.path.join(
-                    cfg.checkpoint_dir, _run_fingerprint(cfg, x, y)
+                    cfg.checkpoint_dir,
+                    _run_fingerprint(cfg, x, y, self.module),
                 )
                 ckptr = TrainCheckpointer(slot)
                 try:

@@ -264,3 +264,35 @@ def test_tp_resume_restores_sharded_layout(tmp_path):
     resumed = mk().fit(x, y)
     assert resumed.history["resumed_from_epoch"] == 2
     assert np.isfinite(resumed.history["loss"]).all()
+
+
+def test_checkpoint_slot_keyed_by_model_identity(tmp_path):
+    """A different module config must not resume another model's slot."""
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data()
+    cfg = TrainerConfig(batch_size=32, epochs=2, learning_rate=1e-2,
+                        seed=7, checkpoint_dir=str(tmp_path / "ck"),
+                        save_every_epochs=2)
+    m1 = Trainer(
+        MLP(num_classes=4, hidden=(16,), dropout_rate=0.0), cfg
+    ).fit(x, y)
+    # same shapes, different dropout → different model → fresh slot
+    m2 = Trainer(
+        MLP(num_classes=4, hidden=(16,), dropout_rate=0.3), cfg
+    ).fit(x, y)
+    assert m1.history["resumed_from_epoch"] == 0
+    assert m2.history["resumed_from_epoch"] == 0
+
+
+def test_negative_save_every_rejected():
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x, y = _resume_data()
+    with pytest.raises(ValueError, match=">= 0"):
+        Trainer(
+            MLP(num_classes=4),
+            TrainerConfig(checkpoint_dir="/tmp/x", save_every_epochs=-1),
+        ).fit(x, y)
