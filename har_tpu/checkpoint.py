@@ -36,12 +36,13 @@ def _abspath(path: str) -> str:
 
 def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                model_kwargs: dict | None = None,
-               dataset: str | None = None) -> str:
+               dataset: str | None = None,
+               synthetic_rows: int | None = None) -> str:
     """Persist a trained neural classifier (params + scaler + config).
 
-    ``dataset`` records which dataset (and thereby which feature view)
-    the model was trained on, so `evaluate_checkpoint` can re-derive the
-    matching test features without the caller re-stating it.
+    ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks) records
+    what the model was trained on, so `evaluate_checkpoint` can re-derive
+    the matching test features without the caller re-stating it.
     """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -58,6 +59,8 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
     }
     if dataset is not None:
         meta["dataset"] = dataset
+    if synthetic_rows is not None:
+        meta["synthetic_rows"] = synthetic_rows
     if model.scaler is not None:
         meta["scaler"] = {
             "mean": np.asarray(model.scaler.mean).tolist(),
@@ -144,6 +147,7 @@ def evaluate_checkpoint(
     dataset: str | None = None,
     train_fraction: float = 0.7,
     seed: int = 2018,
+    synthetic_rows: int | None = None,
 ) -> dict:
     """CLI `evaluate` backend: load a checkpoint, score it on held-out data.
 
@@ -172,12 +176,15 @@ def evaluate_checkpoint(
             f"evaluating against {dataset!r} would derive a different "
             "feature view than the saved parameters expect"
         )
+    if synthetic_rows is None:
+        synthetic_rows = meta.get("synthetic_rows")
     config = RunConfig(
         data=DataConfig(
             dataset=dataset,
             path=data_path,
             train_fraction=train_fraction,
             seed=seed,
+            synthetic_rows=synthetic_rows,
         ),
         model=ModelConfig(name=model_name),
     )

@@ -36,6 +36,10 @@ class DataConfig:
     drop_binned: bool = True
     train_fraction: float = 0.7
     seed: int = 2018
+    # Row count for synthetic fallbacks (None → dataset-matching defaults:
+    # 5418 tabular rows / 4000 raw windows / 2000 UCI rows); tests shrink
+    # it to keep CPU runs fast.
+    synthetic_rows: int | None = None
 
     def resolved_path(self) -> str | None:
         if self.path is not None:

@@ -163,18 +163,30 @@ def load_dataset(config: RunConfig):
             # non-canonical names (e.g. WISDM v2 activities) keep the
             # parser's first-appearance ids + names from stream_windows
             return ds
-        return synthetic_raw_stream(n_windows=4000, seed=config.data.seed)
+        return synthetic_raw_stream(
+            n_windows=config.data.synthetic_rows or 4000,
+            seed=config.data.seed,
+        )
     if config.data.dataset == "synthetic":
-        return synthetic_wisdm(n_rows=5418, seed=config.data.seed)
+        return synthetic_wisdm(
+            n_rows=config.data.synthetic_rows or 5418,
+            seed=config.data.seed,
+        )
     if config.data.dataset == "wisdm":
         if path is None:  # reference mount absent → same-shape synthetic
-            return synthetic_wisdm(n_rows=5418, seed=config.data.seed)
+            return synthetic_wisdm(
+                n_rows=config.data.synthetic_rows or 5418,
+                seed=config.data.seed,
+            )
         return load_wisdm(path, drop_binned=config.data.drop_binned)
     if config.data.dataset == "ucihar":
         from har_tpu.data.ucihar import load_ucihar, synthetic_ucihar
 
         if path is None:
-            return synthetic_ucihar(n_rows=2000, seed=config.data.seed)
+            return synthetic_ucihar(
+                n_rows=config.data.synthetic_rows or 2000,
+                seed=config.data.seed,
+            )
         return load_ucihar(path)
     raise ValueError(f"unknown dataset {config.data.dataset!r}")
 

@@ -42,10 +42,10 @@ class TrainerConfig:
     # fault tolerance: checkpoint (params, opt_state) every N epochs
     # under checkpoint_dir and auto-resume from the latest snapshot.
     # Snapshots live in a subdirectory keyed by a fingerprint of the
-    # training data + schedule config, so a resume only ever matches the
-    # identical run (CV folds, refits, or changed seeds/batch sizes each
-    # get their own slot instead of silently adopting another run's
-    # params).  The batch schedule is derived deterministically from
+    # model configuration + training data + schedule config, so a resume
+    # only ever matches the identical run (CV folds, refits, changed
+    # architectures, or changed seeds/batch sizes each get their own
+    # slot instead of silently adopting another run's params).  The batch schedule is derived deterministically from
     # `seed`, so an interrupted-and-resumed run executes the same step
     # sequence as an uninterrupted one (tested equal).
     # save_every_epochs=0 with a checkpoint_dir means every epoch.
@@ -352,7 +352,7 @@ class Trainer:
                 # batch schedule and per-step rng are derived from global
                 # step numbers, so resumed runs retrace the uninterrupted
                 # step sequence exactly.  Snapshots live under a
-                # fingerprint of (data, schedule config): only the
+                # fingerprint of (model, data, schedule config): only the
                 # identical run resumes them.
                 import os
 

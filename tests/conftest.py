@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compilation cache (env form covers fresh interpreters; the
+# preloaded-jax branch below re-applies via config, since env vars set
+# after jax import are ignored).  min_compile_time=0: the suite's many
+# sub-second programs are exactly the ones worth caching.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/har_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+
 if "jax" in sys.modules:
     # The environment preloads jax in every interpreter; the backend is
     # still uninitialized at this point, so redirect it to CPU via config
@@ -31,6 +38,11 @@ if "jax" in sys.modules:
             "run pytest in a fresh interpreter"
         )
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache"
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 

@@ -70,14 +70,18 @@ def test_evaluate_checkpoint_raw_model(tmp_path):
     from har_tpu.runner import build_estimator, featurize, load_dataset
 
     cfg = RunConfig(
-        data=DataConfig(dataset="wisdm_raw", seed=5),
+        data=DataConfig(dataset="wisdm_raw", seed=5, synthetic_rows=600),
         model=ModelConfig(name="cnn1d"),
     )
     train, _, _ = featurize(cfg, load_dataset(cfg))
-    est = build_estimator("cnn1d", {"epochs": 2, "batch_size": 64})
+    est = build_estimator("cnn1d", {"epochs": 5, "batch_size": 64})
     model = est.fit(train)
-    path = save_model(str(tmp_path / "ckpt"), model, "cnn1d")
-    rep = evaluate_checkpoint(path, dataset="wisdm_raw", seed=5)
+    path = save_model(
+        str(tmp_path / "ckpt"), model, "cnn1d",
+        dataset="wisdm_raw", synthetic_rows=600,
+    )
+    # no dataset/synthetic_rows restated: both come from metadata
+    rep = evaluate_checkpoint(path, seed=5)
     assert rep["accuracy"] > 0.5
     assert rep["n_test"] > 0
 
@@ -88,7 +92,7 @@ def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
     from har_tpu.runner import build_estimator, featurize, load_dataset
 
     cfg = RunConfig(
-        data=DataConfig(dataset="wisdm_raw", seed=5),
+        data=DataConfig(dataset="wisdm_raw", seed=5, synthetic_rows=600),
         model=ModelConfig(name="cnn1d"),
     )
     train, _, _ = featurize(cfg, load_dataset(cfg))
@@ -96,7 +100,8 @@ def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
         train
     )
     path = save_model(
-        str(tmp_path / "ckpt"), model, "cnn1d", dataset="wisdm_raw"
+        str(tmp_path / "ckpt"), model, "cnn1d",
+        dataset="wisdm_raw", synthetic_rows=600,
     )
     # None → recorded dataset; mismatching explicit dataset refused
     rep = evaluate_checkpoint(path, seed=5)

@@ -9,7 +9,7 @@ from har_tpu.runner import _feature_mode, featurize, load_dataset, run
 
 def _cfg(model, params=None, seed=3, tmp="/tmp/raw_models"):
     return RunConfig(
-        data=DataConfig(dataset="wisdm_raw", seed=seed),
+        data=DataConfig(dataset="wisdm_raw", seed=seed, synthetic_rows=600),
         model=ModelConfig(name=model, params=params or {}),
         output_dir=tmp,
     )
@@ -25,7 +25,10 @@ def test_generator_split_decorrelated():
     te = np.bincount(test.label, minlength=6) / len(test)
     # every class present on both sides, frequencies within a few points
     assert (tr > 0).all() and (te > 0).all()
-    np.testing.assert_allclose(tr, te, atol=0.05)
+    # 600 windows → sampling noise up to ~0.09 on the largest class;
+    # the regression this guards produced entirely missing classes
+    # (diffs ~0.5 and zero-count bins), far outside this bound
+    np.testing.assert_allclose(tr, te, atol=0.12)
 
 
 def test_cnn1d_trains_on_raw_windows(tmp_path):
