@@ -176,8 +176,15 @@ def evaluate_checkpoint(
             f"evaluating against {dataset!r} would derive a different "
             "feature view than the saved parameters expect"
         )
+    saved_rows = meta.get("synthetic_rows")
     if synthetic_rows is None:
-        synthetic_rows = meta.get("synthetic_rows")
+        synthetic_rows = saved_rows
+    elif saved_rows is not None and synthetic_rows != saved_rows:
+        raise ValueError(
+            f"checkpoint was trained with synthetic_rows={saved_rows}; "
+            f"evaluating against synthetic_rows={synthetic_rows} would "
+            "regenerate different data than the saved parameters saw"
+        )
     config = RunConfig(
         data=DataConfig(
             dataset=dataset,

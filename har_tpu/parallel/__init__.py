@@ -6,8 +6,10 @@ TPU-native replacement for the reference's Spark cluster machinery
 
 from har_tpu.parallel.mesh import (
     DP_AXIS,
+    DP_DCN_AXIS,
     TP_AXIS,
     create_mesh,
+    create_multihost_mesh,
     single_device_mesh,
 )
 from har_tpu.parallel.sharding import (
@@ -36,6 +38,8 @@ from har_tpu.parallel.expert_parallel import (
 )
 
 __all__ = [
+    "DP_DCN_AXIS",
+    "create_multihost_mesh",
     "EP_AXIS",
     "expert_mesh",
     "init_moe_params",

@@ -50,6 +50,42 @@ def create_mesh(
     return Mesh(grid, (DP_AXIS, TP_AXIS))
 
 
+DP_DCN_AXIS = "dp_dcn"
+
+
+def create_multihost_mesh(
+    num_slices: int,
+    tp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """3-D (dp_dcn, dp, tp) mesh for multi-slice pods.
+
+    The slice axis (`dp_dcn`) is outermost and slowest-varying, so data
+    parallelism across slices reduces over DCN exactly once per step
+    while tensor-parallel collectives stay on the innermost (fastest)
+    ICI axis — the standard hybrid layout.  Devices must be ordered
+    slice-major (which `jax.devices()` is on multi-slice TPU after
+    `initialize_distributed()`).  Gradient reduction over both dp axes:
+    ``psum(psum(g, 'dp'), 'dp_dcn')`` or `psum` over the tuple.
+
+    Single-host testing: any device list divisible by num_slices×tp
+    works — the CPU test mesh treats virtual device groups as slices.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if num_slices < 1 or n % num_slices:
+        raise ValueError(
+            f"num_slices={num_slices} must divide device count {n}"
+        )
+    per_slice = n // num_slices
+    if tp < 1 or per_slice % tp:
+        raise ValueError(
+            f"tp={tp} must divide per-slice device count {per_slice}"
+        )
+    grid = np.asarray(devices).reshape(num_slices, per_slice // tp, tp)
+    return Mesh(grid, (DP_DCN_AXIS, DP_AXIS, TP_AXIS))
+
+
 def linear_mesh(n: int, axis: str, devices: list | None = None) -> Mesh:
     """1-D mesh over ``n`` devices with one named axis (pp/ep layouts)."""
     devices = list(jax.devices()) if devices is None else list(devices)

@@ -45,9 +45,10 @@ class TrainerConfig:
     # model configuration + training data + schedule config, so a resume
     # only ever matches the identical run (CV folds, refits, changed
     # architectures, or changed seeds/batch sizes each get their own
-    # slot instead of silently adopting another run's params).  The batch schedule is derived deterministically from
-    # `seed`, so an interrupted-and-resumed run executes the same step
-    # sequence as an uninterrupted one (tested equal).
+    # slot instead of silently adopting another run's params).  The
+    # batch schedule is derived deterministically from `seed`, so an
+    # interrupted-and-resumed run executes the same step sequence as an
+    # uninterrupted one (tested equal).
     # save_every_epochs=0 with a checkpoint_dir means every epoch.
     checkpoint_dir: str | None = None
     save_every_epochs: int = 0

@@ -301,3 +301,24 @@ def test_negative_save_every_rejected():
             MLP(num_classes=4),
             TrainerConfig(checkpoint_dir="/tmp/x", save_every_epochs=-1),
         ).fit(x, y)
+
+
+def test_evaluate_checkpoint_synthetic_rows_enforced(tmp_path):
+    from har_tpu.checkpoint import evaluate_checkpoint, save_model
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
+    from har_tpu.runner import build_estimator, featurize, load_dataset
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="wisdm_raw", seed=5, synthetic_rows=600),
+        model=ModelConfig(name="cnn1d"),
+    )
+    train, _, _ = featurize(cfg, load_dataset(cfg))
+    model = build_estimator("cnn1d", {"epochs": 1, "batch_size": 64}).fit(
+        train
+    )
+    path = save_model(
+        str(tmp_path / "ck"), model, "cnn1d",
+        dataset="wisdm_raw", synthetic_rows=600,
+    )
+    with pytest.raises(ValueError, match="synthetic_rows=600"):
+        evaluate_checkpoint(path, seed=5, synthetic_rows=4000)

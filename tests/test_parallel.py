@@ -104,3 +104,42 @@ def test_jit_replicated_reduction():
 
     out = jit_replicated(col_sum, mesh, batch_argnums=(0,))(x)
     np.testing.assert_allclose(np.asarray(out), x.sum(axis=0))
+
+
+def test_multihost_mesh_layout_and_reduction():
+    """(dp_dcn, dp, tp) hybrid mesh: axis sizes + two-stage psum."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.parallel.mesh import (
+        DP_AXIS,
+        DP_DCN_AXIS,
+        TP_AXIS,
+        create_multihost_mesh,
+    )
+
+    mesh = create_multihost_mesh(num_slices=2, tp=2)
+    assert dict(mesh.shape) == {DP_DCN_AXIS: 2, DP_AXIS: 2, TP_AXIS: 2}
+
+    # a global sum reduced over both dp axes equals the plain sum
+    x = np.arange(8, dtype=np.float32)
+
+    def local_sum(v):
+        s = jnp.sum(v)
+        return jax.lax.psum(jax.lax.psum(s, DP_AXIS), DP_DCN_AXIS)
+
+    f = jax.shard_map(
+        local_sum,
+        mesh=mesh,
+        in_specs=P((DP_DCN_AXIS, DP_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(float(out), x.sum())
+
+    import pytest
+
+    with pytest.raises(ValueError, match="must divide"):
+        create_multihost_mesh(num_slices=3)
