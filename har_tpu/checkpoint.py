@@ -6,6 +6,13 @@ real framework needs restartable training and servable artifacts, so:
 
   - :func:`save_model` / :func:`load_model` — a trained NeuralClassifier
     (Flax params + module config + feature scaler) as one checkpoint dir.
+  - :func:`save_classical_model` / :func:`load_classical_model` — the
+    classical families (LR coefficients, DT/RF tree arrays, GBDT
+    ensembles) as npz + JSON; optionally bundling the fitted feature
+    pipeline's vocabularies so the artifact can featurize raw tables.
+  - :func:`save_pipeline_model` / :func:`load_pipeline_model` — a fitted
+    feature Pipeline (StringIndexer vocabs, one-hot cardinalities,
+    assembler layout) as JSON.
   - :class:`TrainCheckpointer` — mid-training (params, opt_state, epoch)
     snapshots for resume; the optimizer state carries the LR-schedule
     step, so a resumed cosine schedule continues where it stopped.
@@ -99,6 +106,259 @@ def load_model(path: str) -> NeuralClassifierModel:
     )
 
 
+# ---------------------------------------------------------------------------
+# Classical models (LR / DT / RF / GBDT) + pipeline vocabularies
+# ---------------------------------------------------------------------------
+
+_ARRAYS = "arrays.npz"
+_PIPELINE = "pipeline.json"
+
+
+def _classical_registry():
+    """kind -> (canonical model name, extractor, builder).
+
+    ``extractor(model) -> (arrays, scalars)`` and
+    ``builder(arrays, scalars) -> model`` are each other's inverses, so
+    every field's save/load mapping lives in exactly this one place.
+    Arrays are stored in ``arrays.npz``; scalars go in the JSON metadata.
+    """
+    from har_tpu.models.forest import RandomForestModel
+    from har_tpu.models.gbdt import GradientBoostedTreesModel
+    from har_tpu.models.logistic_regression import LogisticRegressionModel
+    from har_tpu.models.tree import DecisionTreeModel, TreeArrays
+
+    def flat_extractor(array_fields, scalar_fields):
+        def extract(model):
+            return (
+                {f: np.asarray(getattr(model, f)) for f in array_fields},
+                {f: getattr(model, f) for f in scalar_fields},
+            )
+
+        return extract
+
+    def extract_tree(model):
+        t = model.tree
+        return (
+            {
+                "tree_feature": t.feature,
+                "tree_threshold": t.threshold,
+                "tree_leaf_class": t.leaf_class,
+                "tree_leaf_probs": t.leaf_probs,
+            },
+            {"max_depth": t.max_depth, "num_classes": model.num_classes},
+        )
+
+    def build_tree(arrays, scalars):
+        return DecisionTreeModel(
+            tree=TreeArrays(
+                feature=arrays["tree_feature"],
+                threshold=arrays["tree_threshold"],
+                leaf_class=arrays["tree_leaf_class"],
+                leaf_probs=arrays["tree_leaf_probs"],
+                max_depth=scalars["max_depth"],
+            ),
+            num_classes=scalars["num_classes"],
+        )
+
+    return {
+        "LogisticRegressionModel": (
+            "logistic_regression",
+            flat_extractor(("coefficients", "intercept"), ("num_classes",)),
+            lambda a, s: LogisticRegressionModel(
+                coefficients=a["coefficients"],
+                intercept=a["intercept"],
+                num_classes=s["num_classes"],
+            ),
+        ),
+        "DecisionTreeModel": (
+            "decision_tree",
+            extract_tree,
+            build_tree,
+        ),
+        "RandomForestModel": (
+            "random_forest",
+            flat_extractor(
+                ("feature", "threshold", "leaf_probs"),
+                ("max_depth", "num_classes"),
+            ),
+            lambda a, s: RandomForestModel(
+                feature=a["feature"],
+                threshold=a["threshold"],
+                leaf_probs=a["leaf_probs"],
+                max_depth=s["max_depth"],
+                num_classes=s["num_classes"],
+            ),
+        ),
+        "GradientBoostedTreesModel": (
+            "gbdt",
+            flat_extractor(
+                ("feature", "split_bin", "leaf_value", "thresholds"),
+                ("learning_rate", "max_depth", "num_classes"),
+            ),
+            lambda a, s: GradientBoostedTreesModel(
+                feature=a["feature"],
+                split_bin=a["split_bin"],
+                leaf_value=a["leaf_value"],
+                thresholds=a["thresholds"],
+                learning_rate=s["learning_rate"],
+                max_depth=s["max_depth"],
+                num_classes=s["num_classes"],
+            ),
+        ),
+    }
+
+
+def _classical_arrays_scalars(model) -> tuple[dict, dict, str]:
+    """Split a classical model into (arrays, scalars, kind)."""
+    kind = type(model).__name__
+    registry = _classical_registry()
+    if kind not in registry:
+        raise TypeError(
+            f"{kind} is not a persistable classical model "
+            f"(expected one of {sorted(registry)})"
+        )
+    arrays, scalars = registry[kind][1](model)
+    return arrays, scalars, kind
+
+
+def save_classical_model(
+    path: str,
+    model,
+    dataset: str | None = None,
+    synthetic_rows: int | None = None,
+    drop_binned: bool | None = None,
+    pipeline=None,
+) -> str:
+    """Persist a classical model (and optionally its feature pipeline).
+
+    The reference never saves models (SURVEY §5.4); here every family is a
+    servable artifact.  ``pipeline`` — the fitted PipelineModel whose
+    vocabularies produced the model's design matrix — is bundled so the
+    checkpoint can featurize raw tables without refitting.
+    """
+    path = _abspath(path)
+    os.makedirs(path, exist_ok=True)
+    arrays, scalars, kind = _classical_arrays_scalars(model)
+    np.savez_compressed(os.path.join(path, _ARRAYS), **arrays)
+    model_name = _classical_registry()[kind][0]
+    meta: dict[str, Any] = {
+        "format": "classical",
+        "kind": kind,
+        "model_name": model_name,
+        "scalars": {
+            k: (v.item() if isinstance(v, np.generic) else v)
+            for k, v in scalars.items()
+        },
+    }
+    if dataset is not None:
+        meta["dataset"] = dataset
+    if synthetic_rows is not None:
+        meta["synthetic_rows"] = synthetic_rows
+    if drop_binned is not None:
+        meta["drop_binned"] = drop_binned
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f)
+    pipe_path = os.path.join(path, _PIPELINE)
+    if pipeline is not None:
+        save_pipeline_model(pipe_path, pipeline)
+    elif os.path.exists(pipe_path):
+        # re-saving a pipeline-less model into an existing dir must not
+        # leave a stale vocabulary for evaluate_checkpoint to trust
+        os.remove(pipe_path)
+    return path
+
+
+def load_classical_model(path: str):
+    path = _abspath(path)
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    if meta.get("format") != "classical":
+        raise ValueError(
+            f"{path} is not a classical-model checkpoint "
+            f"(format={meta.get('format')!r}); use load_model"
+        )
+    registry = _classical_registry()
+    kind = meta["kind"]
+    if kind not in registry:
+        raise ValueError(f"unknown classical model kind {kind!r}")
+    with np.load(os.path.join(path, _ARRAYS)) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+    return registry[kind][2](arrays, meta["scalars"])
+
+
+def save_pipeline_model(path: str, pipeline) -> str:
+    """Fitted feature pipeline → JSON (vocabularies, cardinalities, layout)."""
+    from har_tpu.features.assembler import VectorAssembler
+    from har_tpu.features.one_hot import OneHotEncoderModel
+    from har_tpu.features.string_indexer import StringIndexerModel
+
+    stages = []
+    for stage in pipeline.stages:
+        if isinstance(stage, StringIndexerModel):
+            stages.append({
+                "kind": "StringIndexerModel",
+                "input_col": stage.input_col,
+                "output_col": stage.output_col,
+                "vocab": list(stage.vocab),
+                "handle_invalid": stage.handle_invalid,
+            })
+        elif isinstance(stage, OneHotEncoderModel):
+            stages.append({
+                "kind": "OneHotEncoderModel",
+                "input_col": stage.input_col,
+                "output_col": stage.output_col,
+                "cardinality": stage.cardinality,
+                "drop_last": stage.drop_last,
+            })
+        elif isinstance(stage, VectorAssembler):
+            stages.append({
+                "kind": "VectorAssembler",
+                "input_cols": list(stage.input_cols),
+                "output_col": stage.output_col,
+            })
+        else:
+            raise TypeError(
+                f"cannot serialize pipeline stage {type(stage).__name__}"
+            )
+    path = _abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"stages": stages}, f)
+    return path
+
+
+def load_pipeline_model(path: str):
+    from har_tpu.features.assembler import VectorAssembler
+    from har_tpu.features.one_hot import OneHotEncoderModel
+    from har_tpu.features.pipeline import PipelineModel
+    from har_tpu.features.string_indexer import StringIndexerModel
+
+    with open(_abspath(path)) as f:
+        spec = json.load(f)
+    stages = []
+    for s in spec["stages"]:
+        kind = s["kind"]
+        if kind == "StringIndexerModel":
+            stages.append(
+                StringIndexerModel(
+                    s["input_col"], s["output_col"], tuple(s["vocab"]),
+                    s["handle_invalid"],
+                )
+            )
+        elif kind == "OneHotEncoderModel":
+            stages.append(
+                OneHotEncoderModel(
+                    s["input_col"], s["output_col"], s["cardinality"],
+                    s["drop_last"],
+                )
+            )
+        elif kind == "VectorAssembler":
+            stages.append(VectorAssembler(s["input_cols"], s["output_col"]))
+        else:
+            raise ValueError(f"unknown pipeline stage kind {kind!r}")
+    return PipelineModel(stages)
+
+
 @dataclasses.dataclass
 class TrainCheckpointer:
     """Mid-training snapshots: (params, opt_state, epoch) for resume."""
@@ -163,9 +423,10 @@ def evaluate_checkpoint(
     from har_tpu.ops.metrics import evaluate
     from har_tpu.runner import featurize, load_dataset
 
-    model = load_model(path)
     with open(os.path.join(_abspath(path), _META)) as f:
         meta = json.load(f)
+    is_classical = meta.get("format") == "classical"
+    model = load_classical_model(path) if is_classical else load_model(path)
     model_name = meta["model_name"]
     saved_dataset = meta.get("dataset")
     if dataset is None:
@@ -192,10 +453,25 @@ def evaluate_checkpoint(
             train_fraction=train_fraction,
             seed=seed,
             synthetic_rows=synthetic_rows,
+            drop_binned=meta.get("drop_binned", True),
         ),
         model=ModelConfig(name=model_name),
     )
-    _, test, _ = featurize(config, load_dataset(config))
+    table = load_dataset(config)
+    pipe_path = os.path.join(_abspath(path), _PIPELINE)
+    if is_classical and os.path.exists(pipe_path):
+        # featurize through the checkpoint's own saved vocabularies — no
+        # refit; new rows with unseen categories fail or bucket per the
+        # indexer's handle_invalid, exactly as the training-time pipeline
+        from har_tpu.features.wisdm_pipeline import make_feature_set
+
+        pipe = load_pipeline_model(pipe_path)
+        full = make_feature_set(pipe.transform(table))
+        _, test = full.split(
+            [train_fraction, 1.0 - train_fraction], seed=seed
+        )
+    else:
+        _, test, _ = featurize(config, table)
     preds = model.transform(test)
     rep = evaluate(test.label, preds.raw, model.num_classes)
     return {

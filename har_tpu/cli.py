@@ -45,6 +45,11 @@ def _parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-dir", default=None,
                    help="snapshot (params, opt_state) here during neural "
                         "training and auto-resume from the newest one")
+    t.add_argument("--save-models-dir", default=None,
+                   help="persist every fitted model (classical + neural, "
+                        "plain + CV-best) under this directory; classical "
+                        "checkpoints bundle the fitted pipeline "
+                        "vocabularies, `evaluate` scores either kind")
     t.add_argument("--save-every-epochs", type=int, default=None)
     t.add_argument("--keep-binned", action="store_true",
                    help="keep the 30 histogram-bin columns X0..Z9 the "
@@ -157,7 +162,8 @@ def main(argv=None) -> int:
 
     with trace(args.trace_dir):
         outcome = run(
-            config, models=models, with_cv=not args.no_cv, with_eda=args.eda
+            config, models=models, with_cv=not args.no_cv, with_eda=args.eda,
+            save_models_dir=args.save_models_dir,
         )
     print(json.dumps({"accuracies": outcome.accuracies,
                       "artifacts": outcome.report_paths}))

@@ -56,6 +56,14 @@ _NEURAL = ("mlp", "cnn1d", "bilstm", "transformer")
 # models that consume (n, T, 3) raw windows, not tabular feature vectors
 _RAW_MODELS = ("cnn1d", "bilstm", "transformer")
 
+
+def effective_synthetic_rows(data) -> int:
+    """Row count a synthetic fallback actually generates for this config —
+    the single source of truth shared by load_dataset and checkpoint
+    provenance metadata."""
+    defaults = {"wisdm_raw": 4000, "ucihar": 2000}
+    return data.synthetic_rows or defaults.get(data.dataset, 5418)
+
 def _neural_model_fields(name: str) -> set[str]:
     """Attribute names of a neural family's Flax module (they are
     dataclasses), minus flax-internal fields."""
@@ -164,18 +172,18 @@ def load_dataset(config: RunConfig):
             # parser's first-appearance ids + names from stream_windows
             return ds
         return synthetic_raw_stream(
-            n_windows=config.data.synthetic_rows or 4000,
+            n_windows=effective_synthetic_rows(config.data),
             seed=config.data.seed,
         )
     if config.data.dataset == "synthetic":
         return synthetic_wisdm(
-            n_rows=config.data.synthetic_rows or 5418,
+            n_rows=effective_synthetic_rows(config.data),
             seed=config.data.seed,
         )
     if config.data.dataset == "wisdm":
         if path is None:  # reference mount absent → same-shape synthetic
             return synthetic_wisdm(
-                n_rows=config.data.synthetic_rows or 5418,
+                n_rows=effective_synthetic_rows(config.data),
                 seed=config.data.seed,
             )
         return load_wisdm(path, drop_binned=config.data.drop_binned)
@@ -184,7 +192,7 @@ def load_dataset(config: RunConfig):
 
         if path is None:
             return synthetic_ucihar(
-                n_rows=config.data.synthetic_rows or 2000,
+                n_rows=effective_synthetic_rows(config.data),
                 seed=config.data.seed,
             )
         return load_ucihar(path)
@@ -271,9 +279,9 @@ def _views_for(models, config: RunConfig, table, timer=None):
 
     Raises before any featurization if some model can't run on this
     dataset.  Returns ``(modes, view_cache)`` — ``view_cache[mode]`` is
-    the (train, test) pair every model with that mode trains on.
-    Shared by run() and sweep() so the two entry points can never drift
-    onto different views for the same model.
+    the (train, test, fitted_pipeline_or_None) triple every model with
+    that mode trains on.  Shared by run() and sweep() so the two entry
+    points can never drift onto different views for the same model.
     """
     model_cfgs = {
         name: dataclasses.replace(
@@ -287,9 +295,9 @@ def _views_for(models, config: RunConfig, table, timer=None):
         if modes[name] not in view_cache:
             if timer is not None:
                 with timer("featurize"):
-                    view = featurize(model_cfgs[name], table)[:2]
+                    view = featurize(model_cfgs[name], table)
             else:
-                view = featurize(model_cfgs[name], table)[:2]
+                view = featurize(model_cfgs[name], table)
             view_cache[modes[name]] = view
     return modes, view_cache
 
@@ -325,7 +333,7 @@ def _fit_eval(est, name, train, test, report, is_cv=False, timer=None):
         is_cv=is_cv,
     )
     report.model_block(result)
-    return result
+    return result, model
 
 
 def sweep(
@@ -372,7 +380,7 @@ def sweep(
         modes, view_cache = _views_for(models, cfg, table)
         split_name = f"{round(frac * 100)}-{round((1 - frac) * 100)}"
         for name in models:
-            train, test = view_cache[modes[name]]
+            train, test = view_cache[modes[name]][:2]
             est = build_estimator(name, config.model.params)
             jobs = [(name, est)]
             if with_cv and name in REFERENCE_GRIDS:
@@ -428,7 +436,51 @@ def sweep(
     return rows
 
 
-def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutcome:
+def _save_fitted(
+    base_dir: str, job_name: str, model, est, config: RunConfig, pipe_model
+):
+    """Persist one fitted model under ``base_dir/job_name``.
+
+    Neural models go through the orbax path; classical families are
+    npz+JSON, bundling the fitted one-hot pipeline's vocabularies when the
+    model was trained on it (so the artifact featurizes raw tables).
+    """
+    from har_tpu.checkpoint import save_classical_model, save_model
+    from har_tpu.models.neural_classifier import NeuralClassifierModel
+
+    path = os.path.join(base_dir, job_name)
+    synthetic_rows = None
+    if config.data.resolved_path() is None:
+        # record the EFFECTIVE row count (load_dataset's defaults), so
+        # evaluate_checkpoint's provenance guard fires even for runs that
+        # never set synthetic_rows explicitly
+        synthetic_rows = effective_synthetic_rows(config.data)
+    if isinstance(model, NeuralClassifierModel):
+        return save_model(
+            path,
+            model,
+            est.model_name,
+            dict(est.model_kwargs),
+            dataset=config.data.dataset,
+            synthetic_rows=synthetic_rows,
+        )
+    return save_classical_model(
+        path,
+        model,
+        dataset=config.data.dataset,
+        synthetic_rows=synthetic_rows,
+        drop_binned=config.data.drop_binned,
+        pipeline=pipe_model,
+    )
+
+
+def run(
+    config: RunConfig,
+    models=None,
+    with_cv=True,
+    with_eda=False,
+    save_models_dir: str | None = None,
+) -> RunOutcome:
     """The whole reference pipeline: EDA → features → models → artifacts."""
     from har_tpu.utils.profiling import StepTimer, write_timing_csv
 
@@ -466,16 +518,19 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
     # resolve every model's view up front (raises before any training if
     # a model can't run on this dataset), featurizing each view once
     modes, view_cache = _views_for(models, config, table, timer=timer)
-    first_train, first_test = view_cache[modes[models[0]]]
+    first_train, first_test = view_cache[modes[models[0]]][:2]
     report.split_counts(len(first_train), len(first_test))
 
     results = []
     for name in models:
-        train, test = view_cache[modes[name]]
+        train, test, pipe_model = view_cache[modes[name]]
         est = build_estimator(name, config.model.params)
-        results.append(
-            _fit_eval(est, name, train, test, report, timer=timer)
-        )
+        result, model = _fit_eval(est, name, train, test, report, timer=timer)
+        results.append(result)
+        if save_models_dir:
+            _save_fitted(
+                save_models_dir, name, model, est, config, pipe_model
+            )
         if with_cv:
             tuning = config.tuning
             grid_spec = (
@@ -491,12 +546,24 @@ def run(config: RunConfig, models=None, with_cv=True, with_eda=False) -> RunOutc
                 selection_metric=metric,
                 seed=config.data.seed,
             )
-            results.append(
-                _fit_eval(
-                    cv, f"{name}_cv", train, test, report,
-                    is_cv=True, timer=timer,
-                )
+            cv_result, cv_model = _fit_eval(
+                cv, f"{name}_cv", train, test, report,
+                is_cv=True, timer=timer,
             )
+            results.append(cv_result)
+            if save_models_dir:
+                # the refit-best model is of the same family as the plain
+                # fit; save with the TUNED estimator so neural metadata
+                # (model_kwargs) describes the refit architecture
+                tuned = (
+                    est.copy_with(**cv_model.best_params)
+                    if cv_model.best_params
+                    else est
+                )
+                _save_fitted(
+                    save_models_dir, f"{name}_cv", cv_model.best_model,
+                    tuned, config, pipe_model,
+                )
 
     if with_eda and not is_raw:
         from har_tpu.reporting.eda import save_eda_plots

@@ -304,21 +304,22 @@ def test_negative_save_every_rejected():
 
 
 def test_evaluate_checkpoint_synthetic_rows_enforced(tmp_path):
+    """The guard fires before any data loads — a tiny tabular fit suffices."""
     from har_tpu.checkpoint import evaluate_checkpoint, save_model
     from har_tpu.config import DataConfig, ModelConfig, RunConfig
     from har_tpu.runner import build_estimator, featurize, load_dataset
 
     cfg = RunConfig(
-        data=DataConfig(dataset="wisdm_raw", seed=5, synthetic_rows=600),
-        model=ModelConfig(name="cnn1d"),
+        data=DataConfig(dataset="synthetic", seed=5, synthetic_rows=200),
+        model=ModelConfig(name="mlp"),
     )
     train, _, _ = featurize(cfg, load_dataset(cfg))
-    model = build_estimator("cnn1d", {"epochs": 1, "batch_size": 64}).fit(
-        train
-    )
+    model = build_estimator(
+        "mlp", {"epochs": 1, "batch_size": 64, "hidden": (8,)}
+    ).fit(train)
     path = save_model(
-        str(tmp_path / "ck"), model, "cnn1d",
-        dataset="wisdm_raw", synthetic_rows=600,
+        str(tmp_path / "ck"), model, "mlp", {"hidden": (8,)},
+        dataset="synthetic", synthetic_rows=200,
     )
-    with pytest.raises(ValueError, match="synthetic_rows=600"):
-        evaluate_checkpoint(path, seed=5, synthetic_rows=4000)
+    with pytest.raises(ValueError, match="synthetic_rows=200"):
+        evaluate_checkpoint(path, seed=5, synthetic_rows=999)

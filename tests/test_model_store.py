@@ -1,0 +1,156 @@
+"""Classical-model + pipeline persistence round-trips.
+
+The reference never saves models (SURVEY §5.4); the framework persists
+every family.  These tests cover the classical (npz+JSON) path: exact
+prediction round-trips, pipeline vocabulary bundling, and the CLI
+evaluate backend scoring classical checkpoints.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from har_tpu.checkpoint import (
+    evaluate_checkpoint,
+    load_classical_model,
+    load_pipeline_model,
+    save_classical_model,
+    save_pipeline_model,
+)
+from har_tpu.config import DataConfig, ModelConfig, RunConfig
+from har_tpu.data.synthetic import synthetic_wisdm
+from har_tpu.features.wisdm_pipeline import build_wisdm_pipeline, make_feature_set
+from har_tpu.runner import build_estimator, featurize, load_dataset
+
+N_ROWS = 400
+SEED = 2018
+
+
+def _view(model_name: str):
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=N_ROWS, seed=SEED),
+        model=ModelConfig(name=model_name),
+    )
+    train, test, pipe = featurize(cfg, load_dataset(cfg))
+    return train, test, pipe
+
+
+@pytest.mark.parametrize(
+    "name,params",
+    [
+        ("logistic_regression", {"max_iter": 5}),
+        ("decision_tree", {"max_depth": 3}),
+        ("random_forest", {"num_trees": 10, "max_depth": 3}),
+        ("gbdt", {"num_rounds": 10, "max_depth": 3}),
+    ],
+)
+def test_classical_roundtrip_exact_predictions(tmp_path, name, params):
+    train, test, _ = _view(name)
+    model = build_estimator(name, params).fit(train)
+    path = save_classical_model(str(tmp_path / name), model)
+    restored = load_classical_model(path)
+    p1, p2 = model.transform(test), restored.transform(test)
+    np.testing.assert_array_equal(
+        np.asarray(p1.raw), np.asarray(p2.raw)
+    )
+    assert restored.num_classes == model.num_classes
+
+
+def test_pipeline_vocab_roundtrip(tmp_path):
+    table = synthetic_wisdm(n_rows=N_ROWS, seed=SEED)
+    pm = build_wisdm_pipeline().fit(table)
+    path = save_pipeline_model(str(tmp_path / "pipe.json"), pm)
+    restored = load_pipeline_model(path)
+    f1 = make_feature_set(pm.transform(table))
+    f2 = make_feature_set(restored.transform(table))
+    np.testing.assert_array_equal(f1.features, f2.features)
+    np.testing.assert_array_equal(f1.label, f2.label)
+    # vocabularies survive exactly (frequency-descending order included)
+    vocabs1 = [s.vocab for s in pm.stages if hasattr(s, "vocab")]
+    vocabs2 = [s.vocab for s in restored.stages if hasattr(s, "vocab")]
+    assert vocabs1 == vocabs2 and vocabs1
+
+
+def test_evaluate_checkpoint_classical(tmp_path):
+    from har_tpu.ops.metrics import evaluate
+
+    train, test, pipe = _view("logistic_regression")
+    model = build_estimator("logistic_regression", {"max_iter": 5}).fit(train)
+    path = save_classical_model(
+        str(tmp_path / "lr"), model,
+        dataset="synthetic", synthetic_rows=N_ROWS, pipeline=pipe,
+    )
+    assert os.path.exists(os.path.join(path, "pipeline.json"))
+    rep = evaluate_checkpoint(path, seed=SEED)
+    direct = evaluate(test.label, model.transform(test).raw, model.num_classes)
+    assert rep["accuracy"] == pytest.approx(float(direct["accuracy"]))
+    assert rep["n_test"] == len(test)
+
+
+def test_evaluate_checkpoint_classical_dataset_enforced(tmp_path):
+    train, _, pipe = _view("logistic_regression")
+    model = build_estimator("logistic_regression", {"max_iter": 2}).fit(train)
+    path = save_classical_model(
+        str(tmp_path / "lr"), model,
+        dataset="synthetic", synthetic_rows=N_ROWS, pipeline=pipe,
+    )
+    with pytest.raises(ValueError, match="trained on dataset 'synthetic'"):
+        evaluate_checkpoint(path, dataset="ucihar", seed=SEED)
+
+
+def test_load_classical_refuses_neural_checkpoint(tmp_path):
+    train, _, _ = _view("mlp")
+    from har_tpu.checkpoint import save_model
+
+    est = build_estimator("mlp", {"epochs": 1, "batch_size": 64})
+    model = est.fit(train)
+    path = save_model(str(tmp_path / "mlp"), model, "mlp")
+    with pytest.raises(ValueError, match="not a classical-model checkpoint"):
+        load_classical_model(path)
+
+
+def test_save_fitted_records_effective_synthetic_rows(tmp_path):
+    """Default-row synthetic runs still record provenance (the effective
+    count load_dataset would use), so the evaluate guard can fire."""
+    import json
+
+    from har_tpu.runner import _save_fitted
+
+    train, _, pipe = _view("logistic_regression")
+    est = build_estimator("logistic_regression", {"max_iter": 2})
+    model = est.fit(train)
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=None, seed=SEED),
+        model=ModelConfig(name="logistic_regression"),
+    )
+    path = _save_fitted(str(tmp_path), "lr", model, est, cfg, pipe)
+    with open(os.path.join(path, "har_meta.json")) as f:
+        meta = json.load(f)
+    assert meta["synthetic_rows"] == 5418  # load_dataset's tabular default
+
+
+def test_run_save_models_dir(tmp_path):
+    """run(save_models_dir=...) persists plain + CV-best of every family."""
+    from har_tpu.runner import run
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=N_ROWS, seed=SEED),
+        model=ModelConfig(params={"max_iter": 2, "num_trees": 4,
+                                  "max_depth": 2}),
+        output_dir=str(tmp_path / "out"),
+    )
+    models_dir = str(tmp_path / "models")
+    run(
+        cfg,
+        models=["logistic_regression", "decision_tree"],
+        with_cv=True,
+        save_models_dir=models_dir,
+    )
+    for job in (
+        "logistic_regression", "logistic_regression_cv",
+        "decision_tree", "decision_tree_cv",
+    ):
+        rep = evaluate_checkpoint(os.path.join(models_dir, job), seed=SEED)
+        assert 0.0 <= rep["accuracy"] <= 1.0
+        assert rep["n_test"] > 0
