@@ -208,8 +208,8 @@ def _classical_registry():
     }
 
 
-def _classical_arrays_scalars(model) -> tuple[dict, dict, str]:
-    """Split a classical model into (arrays, scalars, kind)."""
+def _classical_arrays_scalars(model) -> tuple[dict, dict, str, str]:
+    """Split a classical model into (arrays, scalars, kind, model_name)."""
     kind = type(model).__name__
     registry = _classical_registry()
     if kind not in registry:
@@ -217,8 +217,9 @@ def _classical_arrays_scalars(model) -> tuple[dict, dict, str]:
             f"{kind} is not a persistable classical model "
             f"(expected one of {sorted(registry)})"
         )
-    arrays, scalars = registry[kind][1](model)
-    return arrays, scalars, kind
+    model_name, extract, _ = registry[kind]
+    arrays, scalars = extract(model)
+    return arrays, scalars, kind, model_name
 
 
 def save_classical_model(
@@ -238,9 +239,8 @@ def save_classical_model(
     """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
-    arrays, scalars, kind = _classical_arrays_scalars(model)
+    arrays, scalars, kind, model_name = _classical_arrays_scalars(model)
     np.savez_compressed(os.path.join(path, _ARRAYS), **arrays)
-    model_name = _classical_registry()[kind][0]
     meta: dict[str, Any] = {
         "format": "classical",
         "kind": kind,
@@ -467,9 +467,7 @@ def evaluate_checkpoint(
 
         pipe = load_pipeline_model(pipe_path)
         full = make_feature_set(pipe.transform(table))
-        _, test = full.split(
-            [train_fraction, 1.0 - train_fraction], seed=seed
-        )
+        _, test = full.train_test(train_fraction, seed)
     else:
         _, test, _ = featurize(config, table)
     preds = model.transform(test)

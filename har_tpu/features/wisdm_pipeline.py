@@ -53,6 +53,17 @@ class FeatureSet:
             for idx in split_indices(len(self), fractions, seed)
         ]
 
+    def train_test(
+        self, train_fraction: float, seed: int
+    ) -> tuple["FeatureSet", "FeatureSet"]:
+        """THE train/test split convention: every evaluation path (runner
+        featurize, checkpoint evaluate) must derive the test partition
+        through this one method or risk scoring on different rows."""
+        train, test = self.split(
+            [train_fraction, 1.0 - train_fraction], seed=seed
+        )
+        return train, test
+
 
 def build_wisdm_pipeline(
     categorical: tuple[str, ...] = WISDM_CATEGORICAL_COLUMNS,

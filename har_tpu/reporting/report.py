@@ -146,7 +146,53 @@ class ReportWriter:
         self.line(f"Test Dataset Count     : {n_test}")
         self.line()
 
-    def model_block(self, result: ModelResult) -> None:
+    def prediction_sample(
+        self, test, preds, class_id: int | None = None, n: int = 5
+    ) -> str:
+        """The reference's top-n predicted-class sample (Main/main.py:127-130):
+        rows predicted as ``class_id`` (default: the last class, as the LR
+        block filters prediction==5), ordered by descending probability,
+        rendered as the Spark ``show()`` table in result.txt:144-153.
+        Returns the table text for model_block to place after the timings.
+        """
+        import numpy as np
+
+        from har_tpu.reporting.ascii_table import show
+
+        probs = np.asarray(preds.probability)
+        pred = np.asarray(preds.prediction)
+        k = int(probs.shape[1] - 1 if class_id is None else class_id)
+        idx = np.nonzero(pred == k)[0]
+        if idx.size == 0:  # class never predicted: fall back to all rows
+            idx = np.arange(len(pred))
+        truncated = idx.size > n
+        order = idx[np.argsort(-probs[idx].max(axis=1))][:n]
+        uid = getattr(test, "uid", None)
+        rows = []
+        for i in order:
+            vec = "[" + ",".join(repr(float(v)) for v in probs[i]) + "]"
+            rows.append(
+                [
+                    int(uid[i]) if uid is not None else int(i),
+                    vec,
+                    f"{float(test.label[i]):.1f}",
+                    f"{float(pred[i]):.1f}",
+                ]
+            )
+        table = show(
+            ["UID", "probability", "label", "prediction"],
+            rows,
+            max_rows=None,
+            truncate=30,
+        )
+        # Spark's show() prints the footer only when rows were cut off
+        if truncated:
+            table += f"only showing top {n} rows\n"
+        return table
+
+    def model_block(
+        self, result: ModelResult, sample_text: str | None = None
+    ) -> None:
         """One CLASSIFICATION AND EVALUATION block (result.txt LR block)."""
         if not self.results:
             self.banner("CLASSIFICATION AND EVALUATION")
@@ -155,6 +201,8 @@ class ReportWriter:
         self.line(result.name)
         self.line(f"Classifier trained in {result.train_time_s:.3f} seconds")
         self.line(f"Prediction made in {result.test_time_s:.3f} seconds")
+        if sample_text is not None:
+            self._buf.write(sample_text)
         self.line()
         self.line("-----------Binary Classification Evaluator-------------")
         self.line()

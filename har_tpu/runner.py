@@ -231,8 +231,9 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         from har_tpu.data.ucihar import ucihar_feature_set
 
         full = ucihar_feature_set(table)
-        frac = config.data.train_fraction
-        train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
+        train, test = full.train_test(
+            config.data.train_fraction, config.data.seed
+        )
         return train, test, None
     if mode in ("raw", "raw_features"):
         # table is a WindowedDataset here (load_dataset, wisdm_raw)
@@ -243,8 +244,9 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
 
             x = np.asarray(extract_features(table.windows), np.float32)
         full = FeatureSet(features=x, label=np.asarray(table.labels, np.int32))
-        frac = config.data.train_fraction
-        train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
+        train, test = full.train_test(
+            config.data.train_fraction, config.data.seed
+        )
         return train, test, None
     if mode == "numeric":
         from har_tpu.data.wisdm import BINNED_COLUMNS
@@ -263,14 +265,16 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
             .transform(table)["label"],
             np.int32,
         )
-        full = FeatureSet(features=x, label=y)
+        uid = table["UID"] if "UID" in table.column_names else None
+        full = FeatureSet(features=x, label=y, uid=uid)
         pipe_model = None
     else:
         pipeline = build_wisdm_pipeline()
         pipe_model = pipeline.fit(table)
         full = make_feature_set(pipe_model.transform(table))
-    frac = config.data.train_fraction
-    train, test = full.split([frac, 1.0 - frac], seed=config.data.seed)
+    train, test = full.train_test(
+        config.data.train_fraction, config.data.seed
+    )
     return train, test, pipe_model
 
 
@@ -332,7 +336,9 @@ def _fit_eval(est, name, train, test, report, is_cv=False, timer=None):
         test_time_s=test_time,
         is_cv=is_cv,
     )
-    report.model_block(result)
+    report.model_block(
+        result, sample_text=report.prediction_sample(test, preds)
+    )
     return result, model
 
 

@@ -92,15 +92,26 @@ def make_optimizer(cfg: TrainerConfig, total_steps: int):
     return optax.adamw(schedule, weight_decay=cfg.weight_decay)
 
 
+def _is_single_device(mesh: Mesh) -> bool:
+    return int(np.prod(list(mesh.shape.values()))) == 1
+
+
 def make_train_step(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
 ) -> Callable:
-    """step(params, opt_state, rng, x, y, mask) -> (params, opt_state, loss)."""
+    """step(params, opt_state, rng, x, y, mask) -> (params, opt_state, loss).
+
+    On a 1-device mesh the body compiles under plain ``jit``: the psum and
+    axis_index are identities there, so the shard_map manual-sharding
+    partitioner adds nothing but compile-time work.
+    """
+    single = _is_single_device(mesh)
 
     def local_step(params, opt_state, rng, x, y, mask):
-        shard_rng = jax.random.fold_in(rng, jax.lax.axis_index(DP_AXIS))
+        shard = 0 if single else jax.lax.axis_index(DP_AXIS)
+        shard_rng = jax.random.fold_in(rng, shard)
 
         def local_sum(p):
             logits = apply_fn(
@@ -112,15 +123,18 @@ def make_train_step(
         (loss_sum, count), grads = jax.value_and_grad(
             local_sum, has_aux=True
         )(params)
-        loss_sum, count, grads = jax.lax.psum(
-            (loss_sum, count, grads), DP_AXIS
-        )
+        if not single:
+            loss_sum, count, grads = jax.lax.psum(
+                (loss_sum, count, grads), DP_AXIS
+            )
         count = jnp.maximum(count, 1.0)
         grads = jax.tree.map(lambda g: g / count, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss_sum / count
 
+    if single:
+        return jax.jit(local_step, donate_argnums=(0, 1))
     rep, bat = P(), P(DP_AXIS)
     step = jax.shard_map(
         local_step,
@@ -151,10 +165,14 @@ def make_scan_fit(
     x/y are replicated (the classical datasets are small); each shard
     gathers its slice of every batch — batch_idx has shape
     (total_steps, batch_size) and is sharded on its second axis.
+
+    On a 1-device mesh the whole run compiles under plain ``jit`` (the
+    psum/axis_index are identities there — see make_train_step).
     """
+    single = _is_single_device(mesh)
 
     def local_fit(params, opt_state, rng, x, y, batch_idx, step0):
-        shard = jax.lax.axis_index(DP_AXIS)
+        shard = 0 if single else jax.lax.axis_index(DP_AXIS)
 
         def step(carry, step_and_idx):
             params, opt_state = carry
@@ -177,9 +195,10 @@ def make_scan_fit(
             (loss_sum, count), grads = jax.value_and_grad(
                 local_sum, has_aux=True
             )(params)
-            loss_sum, count, grads = jax.lax.psum(
-                (loss_sum, count, grads), DP_AXIS
-            )
+            if not single:
+                loss_sum, count, grads = jax.lax.psum(
+                    (loss_sum, count, grads), DP_AXIS
+                )
             grads = jax.tree.map(lambda g: g / count, grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -194,6 +213,8 @@ def make_scan_fit(
         )
         return params, opt_state, losses
 
+    if single:
+        return jax.jit(local_fit, donate_argnums=(0, 1))
     rep = P()
     fit = jax.shard_map(
         local_fit,

@@ -1,0 +1,79 @@
+"""VotingClassifier / seed_ensemble tests."""
+
+import numpy as np
+import pytest
+
+from har_tpu.config import DataConfig, ModelConfig, RunConfig
+from har_tpu.models.ensemble import VotingClassifier, seed_ensemble
+from har_tpu.models.gbdt import GradientBoostedTreesClassifier
+from har_tpu.models.tree import DecisionTreeClassifier
+from har_tpu.ops.metrics import evaluate
+from har_tpu.runner import featurize, load_dataset
+
+
+def _data():
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=400, seed=2018),
+        model=ModelConfig(name="gbdt"),
+    )
+    return featurize(cfg, load_dataset(cfg))[:2]
+
+
+def test_seed_ensemble_votes_and_is_deterministic():
+    train, test = _data()
+    est = seed_ensemble(
+        GradientBoostedTreesClassifier(num_rounds=10, max_depth=3), n=3
+    )
+    assert [e.seed for e in est.estimators] == [0, 1, 2]
+    p1 = est.fit(train).transform(test)
+    p2 = est.fit(train).transform(test)
+    np.testing.assert_array_equal(p1.probability, p2.probability)
+    rep = evaluate(test.label, p1.raw, 6)
+    assert rep["accuracy"] > 0.5
+    # probabilities are a proper distribution
+    np.testing.assert_allclose(p1.probability.sum(1), 1.0, rtol=1e-5)
+
+
+def test_voting_single_member_equals_member():
+    train, test = _data()
+    member = DecisionTreeClassifier(max_depth=3)
+    solo = member.fit(train).transform(test)
+    voted = VotingClassifier((member,)).fit(train).transform(test)
+    np.testing.assert_allclose(
+        voted.probability, solo.probability, rtol=1e-6
+    )
+    np.testing.assert_array_equal(voted.prediction, solo.prediction)
+
+
+def test_voting_weights():
+    train, test = _data()
+    a = DecisionTreeClassifier(max_depth=2)
+    b = DecisionTreeClassifier(max_depth=4)
+    # all weight on b == b alone
+    voted = (
+        VotingClassifier((a, b), weights=(0.0, 1.0)).fit(train).transform(test)
+    )
+    solo = b.fit(train).transform(test)
+    np.testing.assert_allclose(voted.probability, solo.probability, rtol=1e-6)
+
+
+def test_voting_validation():
+    dt = DecisionTreeClassifier()
+    with pytest.raises(ValueError, match="at least one"):
+        VotingClassifier(())
+    with pytest.raises(ValueError, match="weights"):
+        VotingClassifier((dt, dt), weights=(1.0,))
+    with pytest.raises(ValueError, match="positive"):
+        VotingClassifier((dt,), weights=(0.0,))
+    with pytest.raises(ValueError, match="n >= 1"):
+        seed_ensemble(dt, 0)
+
+
+def test_copy_with_broadcasts_member_params():
+    est = seed_ensemble(
+        GradientBoostedTreesClassifier(num_rounds=10), n=2
+    )
+    tuned = est.copy_with(max_depth=2)
+    assert all(e.max_depth == 2 for e in tuned.estimators)
+    # seeds survive the broadcast
+    assert [e.seed for e in tuned.estimators] == [0, 1]

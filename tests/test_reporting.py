@@ -90,6 +90,46 @@ def test_runner_end_to_end_synthetic(tmp_path):
     assert outcome.accuracies["logistic_regression"] > 0.8
     assert os.path.exists(outcome.report_paths["result"])
     assert os.path.exists(outcome.report_paths["csv"])
+    # the reference's top-5 predicted-class sample table (result.txt:144-153)
+    text = open(outcome.report_paths["result"]).read()
+    assert "probability" in text
+    assert "only showing top 5 rows" in text
+
+
+def test_prediction_sample_block():
+    """Top-5 sample: filters the target class, sorts by probability desc,
+    shows Spark-style truncated vectors and UID/label/prediction columns."""
+    import numpy as np
+
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.base import Predictions
+
+    n, c = 120, 6
+    rng = np.random.default_rng(0)
+    raw = rng.normal(size=(n, c)).astype(np.float32)
+    probs = np.exp(raw) / np.exp(raw).sum(1, keepdims=True)
+    preds = Predictions.from_raw(raw, probs)
+    test = FeatureSet(
+        features=np.zeros((n, 3), np.float32),
+        label=rng.integers(0, c, n).astype(np.int32),
+        uid=np.arange(100, 100 + n),
+    )
+    w = ReportWriter("unused")
+    text = w.prediction_sample(test, preds, class_id=None, n=5)
+    assert "probability" in text and "prediction" in text
+    # 120 random rows → far more than 5 in the target class → truncated
+    assert "only showing top 5 rows" in text
+    # every shown row was predicted as the last class (reference filters
+    # prediction==5) unless that class never occurs
+    shown = [l for l in text.splitlines() if l.startswith("|") and "UID" not in l]
+    body = [l for l in shown if not set(l) <= {"|", "-", "+"}]
+    assert body and all(l.rstrip("|").endswith("5.0") for l in body)
+    # Spark fidelity: no truncation footer when everything fits — take
+    # exactly 3 rows predicted as the target class
+    k_rows = np.nonzero(np.asarray(preds.prediction) == c - 1)[0][:3]
+    few = Predictions.from_raw(raw[k_rows], probs[k_rows])
+    small = w.prediction_sample(test.take(k_rows), few, n=5)
+    assert "only showing" not in small
 
 
 def test_cli_train_synthetic(tmp_path, capsys):
