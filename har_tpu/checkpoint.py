@@ -401,26 +401,22 @@ class TrainCheckpointer:
         self._mgr.close()
 
 
-def evaluate_checkpoint(
+def _load_checkpoint_for_scoring(
     path: str,
-    data_path: str | None = None,
-    dataset: str | None = None,
-    train_fraction: float = 0.7,
-    seed: int = 2018,
-    synthetic_rows: int | None = None,
-) -> dict:
-    """CLI `evaluate` backend: load a checkpoint, score it on held-out data.
+    data_path: str | None,
+    dataset: str | None,
+    train_fraction: float,
+    seed: int,
+    synthetic_rows: int | None,
+):
+    """Load a checkpoint (either format) + the data it should be scored on.
 
-    ``train_fraction``/``seed`` must match the values the checkpoint was
-    trained with — the test partition is re-derived from them, so a
-    mismatch would leak training rows into the score.  The feature view
-    is re-derived from the checkpoint's saved model name + dataset
-    through the same runner logic that trained it; ``dataset=None``
-    uses the recorded one, and an explicit value that contradicts the
-    recording is refused (the features would not match the params).
+    Returns (model, test FeatureSet).  Shared by the evaluate and predict
+    backends so both load identically and derive the identical test
+    partition — through the checkpoint's bundled pipeline vocabularies
+    when present, through runner.featurize otherwise.
     """
     from har_tpu.config import DataConfig, ModelConfig, RunConfig
-    from har_tpu.ops.metrics import evaluate
     from har_tpu.runner import featurize, load_dataset
 
     with open(os.path.join(_abspath(path), _META)) as f:
@@ -470,6 +466,75 @@ def evaluate_checkpoint(
         _, test = full.train_test(train_fraction, seed)
     else:
         _, test, _ = featurize(config, table)
+    return model, test
+
+
+def predict_checkpoint(
+    path: str,
+    output_csv: str,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float = 0.7,
+    seed: int = 2018,
+    synthetic_rows: int | None = None,
+) -> dict:
+    """CLI `predict` backend: batch inference from a saved checkpoint.
+
+    Scores the held-out partition (same derivation as `evaluate`) and
+    writes one CSV row per window: UID (when the view carries one), the
+    true label, the predicted class, and per-class probabilities.
+    """
+    import csv
+
+    model, test = _load_checkpoint_for_scoring(
+        path, data_path, dataset, train_fraction, seed, synthetic_rows
+    )
+    preds = model.transform(test)
+    probs = np.asarray(preds.probability)
+    output_csv = _abspath(output_csv)
+    parent = os.path.dirname(output_csv)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(output_csv, "w", newline="") as f:
+        w = csv.writer(f)
+        prob_cols = [f"prob_{k}" for k in range(probs.shape[1])]
+        w.writerow(["UID", "label", "prediction"] + prob_cols)
+        for i in range(len(preds)):
+            uid = int(test.uid[i]) if test.uid is not None else i
+            w.writerow(
+                [uid, int(test.label[i]), int(preds.prediction[i])]
+                + [f"{p:.6g}" for p in probs[i]]
+            )
+    return {
+        "output": output_csv,
+        "n_rows": int(len(preds)),
+        "num_classes": int(probs.shape[1]),
+    }
+
+
+def evaluate_checkpoint(
+    path: str,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float = 0.7,
+    seed: int = 2018,
+    synthetic_rows: int | None = None,
+) -> dict:
+    """CLI `evaluate` backend: load a checkpoint, score it on held-out data.
+
+    ``train_fraction``/``seed`` must match the values the checkpoint was
+    trained with — the test partition is re-derived from them, so a
+    mismatch would leak training rows into the score.  The feature view
+    is re-derived from the checkpoint's saved model name + dataset
+    through the same runner logic that trained it; ``dataset=None``
+    uses the recorded one, and an explicit value that contradicts the
+    recording is refused (the features would not match the params).
+    """
+    from har_tpu.ops.metrics import evaluate
+
+    model, test = _load_checkpoint_for_scoring(
+        path, data_path, dataset, train_fraction, seed, synthetic_rows
+    )
     preds = model.transform(test)
     rep = evaluate(test.label, preds.raw, model.num_classes)
     return {

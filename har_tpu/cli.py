@@ -1,12 +1,14 @@
 """`har` command-line interface.
 
 Replaces the reference's spark-submit entrypoint (README.md:5-8) with a
-real CLI: train/evaluate/benchmark subcommands over a dataclass config
-(the reference hardcodes every knob in the script — SURVEY §5.6).
+real CLI: train/evaluate/predict/sweep/bench subcommands over a dataclass
+config (the reference hardcodes every knob in the script — SURVEY §5.6).
 
 Usage:
-  python -m har_tpu.cli train  --models lr dt rf --output-dir main_result
-  python -m har_tpu.cli train  --models mlp --epochs 150
+  python -m har_tpu.cli train    --models lr dt rf --output-dir main_result
+  python -m har_tpu.cli train    --models mlp --epochs 150
+  python -m har_tpu.cli evaluate --checkpoint models/lr
+  python -m har_tpu.cli predict  --checkpoint models/lr --output preds.csv
   python -m har_tpu.cli bench
 """
 
@@ -74,6 +76,18 @@ def _parser() -> argparse.ArgumentParser:
     e.add_argument("--seed", type=int, default=2018,
                    help="must match the training run")
 
+    pr = sub.add_parser(
+        "predict",
+        help="batch inference from a saved checkpoint → predictions CSV",
+    )
+    pr.add_argument("--checkpoint", required=True)
+    pr.add_argument("--output", default="predictions.csv")
+    pr.add_argument("--dataset", default=None,
+                    choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
+    pr.add_argument("--data-path", default=None)
+    pr.add_argument("--train-fraction", type=float, default=0.7)
+    pr.add_argument("--seed", type=int, default=2018)
+
     s = sub.add_parser(
         "sweep",
         help="split-ratio sweep (the paper's Table 1/2 experiment): "
@@ -116,6 +130,23 @@ def main(argv=None) -> int:
             models=args.models,  # runner canonicalizes lr/dt/rf/gbt
             fractions=tuple(args.fractions),
             with_cv=not args.no_cv,
+        )
+        return 0
+
+    if args.command == "predict":
+        from har_tpu.checkpoint import predict_checkpoint
+
+        print(
+            json.dumps(
+                predict_checkpoint(
+                    args.checkpoint,
+                    args.output,
+                    args.data_path,
+                    dataset=args.dataset,
+                    train_fraction=args.train_fraction,
+                    seed=args.seed,
+                )
+            )
         )
         return 0
 

@@ -155,10 +155,6 @@ class ReportWriter:
         rendered as the Spark ``show()`` table in result.txt:144-153.
         Returns the table text for model_block to place after the timings.
         """
-        import numpy as np
-
-        from har_tpu.reporting.ascii_table import show
-
         probs = np.asarray(preds.probability)
         pred = np.asarray(preds.prediction)
         k = int(probs.shape[1] - 1 if class_id is None else class_id)

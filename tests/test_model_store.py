@@ -130,6 +130,36 @@ def test_save_fitted_records_effective_synthetic_rows(tmp_path):
     assert meta["synthetic_rows"] == 5418  # load_dataset's tabular default
 
 
+def test_predict_checkpoint_writes_csv(tmp_path):
+    """predict backend: per-row CSV whose argmax column matches evaluate."""
+    import csv
+
+    from har_tpu.checkpoint import predict_checkpoint
+
+    train, test, pipe = _view("logistic_regression")
+    model = build_estimator("logistic_regression", {"max_iter": 5}).fit(train)
+    path = save_classical_model(
+        str(tmp_path / "lr"), model,
+        dataset="synthetic", synthetic_rows=N_ROWS, pipeline=pipe,
+    )
+    out = str(tmp_path / "preds.csv")
+    rep = predict_checkpoint(path, out, seed=SEED)
+    assert rep["n_rows"] == len(test)
+    rows = list(csv.reader(open(out)))
+    assert rows[0][:3] == ["UID", "label", "prediction"]
+    assert len(rows) == len(test) + 1
+    # prediction column == argmax of the probability columns
+    for r in rows[1 : 20]:
+        probs = [float(p) for p in r[3:]]
+        assert int(r[2]) == probs.index(max(probs))
+    # accuracy derived from the CSV matches a direct evaluation
+    correct = sum(int(r[1]) == int(r[2]) for r in rows[1:])
+    direct = model.transform(test)
+    assert correct == int(
+        (np.asarray(direct.prediction) == test.label).sum()
+    )
+
+
 def test_run_save_models_dir(tmp_path):
     """run(save_models_dir=...) persists plain + CV-best of every family."""
     from har_tpu.runner import run
