@@ -459,6 +459,8 @@ class Trainer:
                 )
             step_idx = (cfg.epochs - start_epoch) * steps_per_epoch
         else:
+            from har_tpu.data.prefetch import prefetch_to_device
+
             step = make_train_step(self.module.apply, optimizer, mesh)
             x_shard = batch_sharding(mesh, x.ndim)
             y_shard = batch_sharding(mesh, 1)
@@ -467,9 +469,17 @@ class Trainer:
             )
             step_idx = 0
             for epoch in range(cfg.epochs):
-                for idx in batch_iterator(n, cfg.batch_size, host_rng):
-                    xb = jax.device_put(x[idx], x_shard)
-                    yb = jax.device_put(y[idx], y_shard)
+                # double-buffered host→device feed: the next batch's
+                # transfer overlaps the current step's compute
+                batches = prefetch_to_device(
+                    batch_iterator(n, cfg.batch_size, host_rng),
+                    size=2,
+                    transfer=lambda idx: (
+                        jax.device_put(x[idx], x_shard),
+                        jax.device_put(y[idx], y_shard),
+                    ),
+                )
+                for xb, yb in batches:
                     rng = jax.random.fold_in(step_root, step_idx)
                     params, opt_state, loss = step(
                         params, opt_state, rng, xb, yb, mask

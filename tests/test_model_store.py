@@ -148,10 +148,12 @@ def test_predict_checkpoint_writes_csv(tmp_path):
     rows = list(csv.reader(open(out)))
     assert rows[0][:3] == ["UID", "label", "prediction"]
     assert len(rows) == len(test) + 1
-    # prediction column == argmax of the probability columns
+    # prediction column is an argmax of the probability columns (ties in
+    # the 6-sig-fig serialization make "the" argmax ambiguous, so only
+    # membership in the max set is asserted)
     for r in rows[1 : 20]:
         probs = [float(p) for p in r[3:]]
-        assert int(r[2]) == probs.index(max(probs))
+        assert probs[int(r[2])] == max(probs)
     # accuracy derived from the CSV matches a direct evaluation
     correct = sum(int(r[1]) == int(r[2]) for r in rows[1:])
     direct = model.transform(test)
