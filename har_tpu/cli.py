@@ -62,6 +62,13 @@ def _parser() -> argparse.ArgumentParser:
     t.add_argument("--trace-dir", default=None,
                    help="write a TensorBoard-loadable jax.profiler trace "
                         "of the whole run to this directory")
+    t.add_argument("--dp", type=int, default=1,
+                   help="data-parallel mesh axis for neural training "
+                        "(-1 = all devices; batch is sharded over dp, "
+                        "gradients psum over ICI)")
+    t.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel mesh axis (Megatron-style GSPMD "
+                        "shardings over hidden dims)")
     t.add_argument("--output-dir", default="main_result")
 
     e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
@@ -101,6 +108,10 @@ def _parser() -> argparse.ArgumentParser:
                    default=[0.7, 0.8, 0.9])
     s.add_argument("--seed", type=int, default=2018)
     s.add_argument("--no-cv", action="store_true")
+    s.add_argument("--dp", type=int, default=1,
+                   help="data-parallel mesh axis for neural models "
+                        "(-1 = all devices)")
+    s.add_argument("--tp", type=int, default=1)
     s.add_argument("--output-dir", default="main_result")
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
@@ -117,12 +128,14 @@ def main(argv=None) -> int:
         return 0
 
     if args.command == "sweep":
+        from har_tpu.config import MeshConfig
         from har_tpu.runner import sweep
 
         config = RunConfig(
             data=DataConfig(
                 dataset=args.dataset, path=args.data_path, seed=args.seed
             ),
+            mesh=MeshConfig(dp=args.dp, tp=args.tp),
             output_dir=args.output_dir,
         )
         sweep(
@@ -167,6 +180,7 @@ def main(argv=None) -> int:
         return 0
 
     # train
+    from har_tpu.config import MeshConfig
     from har_tpu.runner import canonical_model_name
 
     models = [canonical_model_name(m) for m in args.models]
@@ -185,6 +199,7 @@ def main(argv=None) -> int:
             seed=args.seed,
         ),
         model=ModelConfig(name=models[0], params=neural_params),
+        mesh=MeshConfig(dp=args.dp, tp=args.tp),
         tuning=TuningConfig(selection_metric=args.cv_metric),
         output_dir=args.output_dir,
     )

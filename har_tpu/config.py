@@ -81,13 +81,21 @@ class MeshConfig:
 
     Axis names follow the scaling-book convention: `dp` shards the batch,
     `tp` shards model (feature/hidden) dimensions.  The classical workloads
-    use pure DP; neural configs may use both.
+    use pure DP; neural configs may use both.  Default is single-device;
+    pass dp=-1 (or `har train --dp -1`) to spread over all devices.
     """
 
-    dp: int = -1  # -1 → all available devices
+    dp: int = 1  # -1 → all available devices
     tp: int = 1
 
     def shape(self, n_devices: int) -> tuple[int, int]:
+        if self.dp == 0 or self.dp < -1:
+            raise ValueError(
+                f"dp={self.dp} is invalid: use a positive device count or "
+                "-1 for all available devices"
+            )
+        if self.tp < 1:
+            raise ValueError(f"tp={self.tp} must be >= 1")
         dp = self.dp if self.dp > 0 else max(1, n_devices // self.tp)
         return dp, self.tp
 

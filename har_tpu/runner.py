@@ -375,6 +375,7 @@ def sweep(
     if not models or not fractions:
         raise ValueError("sweep needs at least one model and one fraction")
     table = load_dataset(config)
+    mesh = _mesh_from_config(config)
     rows: list[dict] = []
     for frac in fractions:
         cfg = dataclasses.replace(
@@ -387,7 +388,7 @@ def sweep(
         split_name = f"{round(frac * 100)}-{round((1 - frac) * 100)}"
         for name in models:
             train, test = view_cache[modes[name]][:2]
-            est = build_estimator(name, config.model.params)
+            est = build_estimator(name, config.model.params, mesh=mesh)
             jobs = [(name, est)]
             if with_cv and name in REFERENCE_GRIDS:
                 jobs.append(
@@ -440,6 +441,24 @@ def sweep(
         f.write(txt)
     print(txt, end="")
     return rows
+
+
+def _mesh_from_config(config: RunConfig):
+    """Build the SPMD mesh the config asks for (None → single device).
+
+    MeshConfig.dp = -1 means "all available devices"; dp×tp == 1 returns
+    None so single-chip runs skip the sharding machinery entirely.
+    Classical estimators ignore the mesh (their fits are single compiled
+    programs); neural trainers shard batches over dp and params over tp.
+    """
+    import jax
+
+    dp, tp = config.mesh.shape(len(jax.devices()))
+    if dp * tp == 1:
+        return None
+    from har_tpu.parallel import create_mesh
+
+    return create_mesh(dp=dp, tp=tp)
 
 
 def _save_fitted(
@@ -527,10 +546,11 @@ def run(
     first_train, first_test = view_cache[modes[models[0]]][:2]
     report.split_counts(len(first_train), len(first_test))
 
+    mesh = _mesh_from_config(config)
     results = []
     for name in models:
         train, test, pipe_model = view_cache[modes[name]]
-        est = build_estimator(name, config.model.params)
+        est = build_estimator(name, config.model.params, mesh=mesh)
         result, model = _fit_eval(est, name, train, test, report, timer=timer)
         results.append(result)
         if save_models_dir:

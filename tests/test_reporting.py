@@ -96,6 +96,26 @@ def test_runner_end_to_end_synthetic(tmp_path):
     assert "only showing top 5 rows" in text
 
 
+def test_run_with_mesh_config(tmp_path):
+    """run() honors MeshConfig: neural training shards over the dp axis
+    (8-device CPU mesh in tests) and still produces a sound report."""
+    from har_tpu.config import MeshConfig
+    from har_tpu.runner import run
+
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=400, seed=2018),
+        model=ModelConfig(
+            name="mlp",
+            params={"epochs": 2, "batch_size": 64, "hidden": (16,)},
+        ),
+        mesh=MeshConfig(dp=-1),  # all 8 virtual devices
+        output_dir=str(tmp_path),
+    )
+    outcome = run(config, models=["mlp"], with_cv=False)
+    assert 0.0 <= outcome.accuracies["mlp"] <= 1.0
+    assert os.path.exists(outcome.report_paths["result"])
+
+
 def test_prediction_sample_block():
     """Top-5 sample: filters the target class, sorts by probability desc,
     shows Spark-style truncated vectors and UID/label/prediction columns."""
@@ -162,3 +182,17 @@ def test_eda_plots(tmp_path):
     assert len(paths) == 7
     assert all(os.path.exists(p) for p in paths)
     assert os.path.exists(os.path.join(str(tmp_path), "Fig %s_%s.png" % (cols[0], cols[1])))
+
+
+def test_mesh_config_validation():
+    from har_tpu.config import MeshConfig
+    import pytest
+
+    assert MeshConfig(dp=-1, tp=2).shape(8) == (4, 2)
+    assert MeshConfig(dp=2, tp=1).shape(8) == (2, 1)
+    with pytest.raises(ValueError, match="dp=0"):
+        MeshConfig(dp=0).shape(8)
+    with pytest.raises(ValueError, match="dp=-2"):
+        MeshConfig(dp=-2).shape(8)
+    with pytest.raises(ValueError, match="tp=0"):
+        MeshConfig(tp=0).shape(8)
