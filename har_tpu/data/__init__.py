@@ -5,9 +5,11 @@ from har_tpu.data.split import random_split
 from har_tpu.data.wisdm import load_wisdm, WISDM_NUMERIC_COLUMNS, WISDM_CATEGORICAL_COLUMNS
 from har_tpu.data.synthetic import synthetic_wisdm
 from har_tpu.data.raw_loader import RawStream, load_raw_stream, stream_windows
+from har_tpu.data.prefetch import prefetch_to_device
 
 __all__ = [
     "RawStream",
+    "prefetch_to_device",
     "load_raw_stream",
     "stream_windows",
     "ColumnType",

@@ -7,6 +7,17 @@ from har_tpu.models.logistic_regression import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from har_tpu.models.tree import DecisionTreeClassifier, DecisionTreeModel
+from har_tpu.models.forest import RandomForestClassifier, RandomForestModel
+from har_tpu.models.neural_classifier import (
+    NeuralClassifier,
+    NeuralClassifierModel,
+)
+from har_tpu.models.ensemble import (
+    VotingClassifier,
+    VotingModel,
+    seed_ensemble,
+)
 
 __all__ = [
     "Predictions",
@@ -16,4 +27,13 @@ __all__ = [
     "GradientBoostedTreesModel",
     "LogisticRegression",
     "LogisticRegressionModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeModel",
+    "RandomForestClassifier",
+    "RandomForestModel",
+    "NeuralClassifier",
+    "NeuralClassifierModel",
+    "VotingClassifier",
+    "VotingModel",
+    "seed_ensemble",
 ]

@@ -242,7 +242,44 @@ class ReportWriter:
         self.line(f"Wrong Ratio          = {wrong / max(total, 1):.6g}")
         self.line(f"Right Ratio          = {correct / max(total, 1):.6g}")
         self.line()
+        self._per_class_block(m)
         self.line("*" * 57)
+        self.line()
+
+    def _per_class_block(self, m: Mapping[str, Any]) -> None:
+        """Per-class precision/recall/F1 + the confusion matrix — a
+        framework extra beyond the reference's aggregate-only battery
+        (its evaluators never expose per-class numbers)."""
+        if "precision_per_class" not in m or "confusion_matrix" not in m:
+            return
+        cm = np.asarray(m["confusion_matrix"])
+        k = len(cm)
+        self.line("------------------Per-Class Metrics---------------------")
+        self.line()
+        rows = [
+            [
+                c,
+                int(cm[c].sum()),
+                f"{m['precision_per_class'][c]:.4f}",
+                f"{m['recall_per_class'][c]:.4f}",
+                f"{m['f1_per_class'][c]:.4f}",
+            ]
+            for c in range(k)
+        ]
+        self._buf.write(
+            show(
+                ["class", "support", "precision", "recall", "f1"],
+                rows,
+                max_rows=None,
+            )
+        )
+        self._buf.write(
+            show(
+                ["true\\pred"] + [str(c) for c in range(k)],
+                [[c] + [int(v) for v in cm[c]] for c in range(k)],
+                max_rows=None,
+            )
+        )
         self.line()
 
     # --- artifacts -------------------------------------------------------

@@ -453,12 +453,20 @@ def _mesh_from_config(config: RunConfig):
     """
     import jax
 
-    dp, tp = config.mesh.shape(len(jax.devices()))
+    devices = jax.devices()
+    dp, tp = config.mesh.shape(len(devices))
     if dp * tp == 1:
         return None
+    if dp * tp > len(devices):
+        raise ValueError(
+            f"mesh dp={dp} x tp={tp} needs {dp * tp} devices but only "
+            f"{len(devices)} are available"
+        )
     from har_tpu.parallel import create_mesh
 
-    return create_mesh(dp=dp, tp=tp)
+    # an explicit dp/tp smaller than the host's device count uses the
+    # first dp*tp devices
+    return create_mesh(dp=dp, tp=tp, devices=devices[: dp * tp])
 
 
 def _save_fitted(

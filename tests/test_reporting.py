@@ -196,3 +196,21 @@ def test_mesh_config_validation():
         MeshConfig(dp=-2).shape(8)
     with pytest.raises(ValueError, match="tp=0"):
         MeshConfig(tp=0).shape(8)
+
+
+def test_run_with_partial_device_mesh(tmp_path):
+    """An explicit dp smaller than the host's device count uses a subset
+    (regression: create_mesh used to require dp*tp == all devices)."""
+    from har_tpu.config import MeshConfig
+    from har_tpu.runner import run
+
+    config = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=200, seed=2018),
+        model=ModelConfig(
+            name="mlp", params={"epochs": 1, "batch_size": 32, "hidden": (8,)}
+        ),
+        mesh=MeshConfig(dp=2),  # 2 of the 8 virtual devices
+        output_dir=str(tmp_path),
+    )
+    outcome = run(config, models=["mlp"], with_cv=False)
+    assert 0.0 <= outcome.accuracies["mlp"] <= 1.0
