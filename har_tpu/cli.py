@@ -53,6 +53,11 @@ def _parser() -> argparse.ArgumentParser:
                         "checkpoints bundle the fitted pipeline "
                         "vocabularies, `evaluate` scores either kind")
     t.add_argument("--save-every-epochs", type=int, default=None)
+    t.add_argument("--early-stop-patience", type=int, default=None,
+                   help="stop neural training after N epochs without "
+                        "val-accuracy improvement, keep the best epoch")
+    t.add_argument("--validation-fraction", type=float, default=None,
+                   help="rows carved out of training for early stopping")
     t.add_argument("--keep-binned", action="store_true",
                    help="keep the 30 histogram-bin columns X0..Z9 the "
                         "reference drops (Main/main.py:22-26); gbt's "
@@ -180,13 +185,19 @@ def main(argv=None) -> int:
         return 0
 
     # train
+    if args.validation_fraction is not None and not args.early_stop_patience:
+        raise SystemExit(
+            "--validation-fraction only takes effect with "
+            "--early-stop-patience; set both or neither"
+        )
     from har_tpu.config import MeshConfig
     from har_tpu.runner import canonical_model_name
 
     models = [canonical_model_name(m) for m in args.models]
     neural_params = {}
     for k in ("epochs", "batch_size", "learning_rate",
-              "checkpoint_dir", "save_every_epochs"):
+              "checkpoint_dir", "save_every_epochs",
+              "early_stop_patience", "validation_fraction"):
         v = getattr(args, k)
         if v is not None:
             neural_params[k] = v

@@ -464,8 +464,18 @@ def _mesh_from_config(config: RunConfig):
         )
     from har_tpu.parallel import create_mesh
 
-    # an explicit dp/tp smaller than the host's device count uses the
-    # first dp*tp devices
+    if dp * tp < len(devices) and jax.process_count() > 1:
+        # a subset of global devices can exclude another process's chips
+        # entirely — its dispatches would have nothing to run on; multi-
+        # host meshes must span every device
+        raise ValueError(
+            f"mesh dp={dp} x tp={tp} covers {dp * tp} of "
+            f"{len(devices)} global devices; in a multi-host run the "
+            "mesh must use all of them (set dp=-1 or dp*tp == device "
+            "count)"
+        )
+    # single-process: an explicit dp/tp smaller than the host's device
+    # count uses the first dp*tp devices
     return create_mesh(dp=dp, tp=tp, devices=devices[: dp * tp])
 
 

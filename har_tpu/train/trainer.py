@@ -52,6 +52,13 @@ class TrainerConfig:
     # save_every_epochs=0 with a checkpoint_dir means every epoch.
     checkpoint_dir: str | None = None
     save_every_epochs: int = 0
+    # early stopping (scan path): carve validation_fraction of the rows
+    # out of training, evaluate after every epoch (one scanned dispatch
+    # per epoch), stop after early_stop_patience epochs without a val-
+    # accuracy improvement, and return the best epoch's parameters.
+    # 0 → off.
+    early_stop_patience: int = 0
+    validation_fraction: float = 0.1
 
 
 def _run_fingerprint(
@@ -300,6 +307,40 @@ class Trainer:
         x = np.asarray(x, np.float32)
         y = np.asarray(y, np.int32)
 
+        x_val = y_val = None
+        if cfg.early_stop_patience < 0:
+            raise ValueError(
+                f"early_stop_patience must be >= 0 "
+                f"(got {cfg.early_stop_patience})"
+            )
+        if cfg.early_stop_patience:
+            if not 0.0 < cfg.validation_fraction < 1.0:
+                raise ValueError(
+                    "early stopping needs 0 < validation_fraction < 1 "
+                    f"(got {cfg.validation_fraction})"
+                )
+            if cfg.checkpoint_dir:
+                raise ValueError(
+                    "early stopping and mid-training checkpointing are "
+                    "not supported together yet"
+                )
+            if not self.scan:
+                raise ValueError(
+                    "early stopping is implemented for the scanned path "
+                    "(scan=True)"
+                )
+            val_n = max(1, int(round(n * cfg.validation_fraction)))
+            if val_n >= n:
+                raise ValueError(
+                    f"validation_fraction={cfg.validation_fraction} leaves "
+                    f"no training rows (n={n})"
+                )
+            perm = np.random.default_rng(cfg.seed).permutation(n)
+            val_rows, train_rows = perm[:val_n], perm[val_n:]
+            x_val, y_val = x[val_rows], y[val_rows]
+            x, y = x[train_rows], y[train_rows]
+            n = len(x)
+
         dp = mesh.shape[DP_AXIS]
         if cfg.batch_size % dp:
             raise ValueError(
@@ -367,6 +408,7 @@ class Trainer:
                 fit = make_scan_fit(self.module.apply, optimizer, mesh)
             x_dev, y_dev = jnp.asarray(x), jnp.asarray(y)
             start_epoch = 0
+            epochs_run = cfg.epochs  # branches override when they differ
             if cfg.checkpoint_dir:
                 # fault tolerance: run in save_every_epochs chunks — one
                 # dispatch each — snapshotting (params, opt_state) after
@@ -436,6 +478,7 @@ class Trainer:
                     else np.zeros((0,), np.float32)
                 )
                 history["resumed_from_epoch"] = start_epoch
+                epochs_run = cfg.epochs - start_epoch
                 history["loss"] = (
                     list(
                         losses.reshape(-1, steps_per_epoch)[:, -1]
@@ -443,6 +486,53 @@ class Trainer:
                     if len(losses)
                     else []
                 )
+            elif cfg.early_stop_patience:
+                # per-epoch dispatches: train one epoch's scan, score the
+                # held-out rows, keep the best epoch's parameters, stop
+                # after `patience` epochs without improvement
+                x_val_dev, y_val_np = jnp.asarray(x_val), np.asarray(y_val)
+                predict = jax.jit(
+                    lambda p, xv: jnp.argmax(
+                        self.module.apply({"params": p}, xv), -1
+                    )
+                )
+                best_params, best_acc, best_epoch = None, -1.0, 0
+                val_accs: list[float] = []
+                chunk_losses = []
+                bad = 0
+                epoch = 0
+                while epoch < cfg.epochs:
+                    lo = epoch * steps_per_epoch
+                    hi = lo + steps_per_epoch
+                    params, opt_state, losses = fit(
+                        params, opt_state, step_root, x_dev, y_dev,
+                        jnp.asarray(batch_idx[lo:hi]),
+                        jnp.asarray(lo, jnp.int32),
+                    )
+                    chunk_losses.append(np.asarray(losses))
+                    acc = float(
+                        (np.asarray(predict(params, x_val_dev)) == y_val_np)
+                        .mean()
+                    )
+                    val_accs.append(acc)
+                    epoch += 1
+                    if acc > best_acc:
+                        best_acc, best_epoch = acc, epoch
+                        best_params = jax.device_get(params)
+                        bad = 0
+                    else:
+                        bad += 1
+                        if bad >= cfg.early_stop_patience:
+                            break
+                params = best_params
+                losses = np.concatenate(chunk_losses)
+                history["loss"] = list(
+                    losses.reshape(-1, steps_per_epoch)[:, -1]
+                )
+                history["val_accuracy"] = val_accs
+                history["best_epoch"] = best_epoch
+                history["stopped_epoch"] = epoch
+                epochs_run = epoch
             else:
                 params, opt_state, losses = fit(
                     params,
@@ -457,7 +547,7 @@ class Trainer:
                 history["loss"] = list(
                     losses.reshape(cfg.epochs, steps_per_epoch)[:, -1]
                 )
-            step_idx = (cfg.epochs - start_epoch) * steps_per_epoch
+            step_idx = epochs_run * steps_per_epoch
         else:
             from har_tpu.data.prefetch import prefetch_to_device
 

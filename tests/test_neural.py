@@ -120,3 +120,71 @@ def test_dp_training_matches_single_device(raw_data):
     np.testing.assert_allclose(
         m8.history["loss"], m1.history["loss"], rtol=3e-4
     )
+
+
+def test_early_stopping_stops_and_restores_best():
+    """Patience-based stop: training halts before cfg.epochs once val
+    accuracy plateaus, and the returned params are the best epoch's."""
+    import numpy as np
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4))
+    y = (x @ w).argmax(1).astype(np.int32)
+    model = Trainer(
+        MLP(num_classes=4, hidden=(32,), dropout_rate=0.0),
+        TrainerConfig(
+            batch_size=64, epochs=60, learning_rate=1e-2, seed=5,
+            early_stop_patience=3, validation_fraction=0.2,
+        ),
+    ).fit(x, y)
+    h = model.history
+    assert h["stopped_epoch"] < 60
+    assert len(h["val_accuracy"]) == h["stopped_epoch"]
+    assert h["best_epoch"] <= h["stopped_epoch"]
+    # returned params reproduce the best recorded validation accuracy
+    perm = np.random.default_rng(5).permutation(len(x))
+    val_rows = perm[: int(round(len(x) * 0.2))]
+    preds = model.transform(x[val_rows]).prediction
+    acc = float((preds == y[val_rows]).mean())
+    assert acc == max(h["val_accuracy"])
+
+
+def test_early_stopping_validation():
+    import numpy as np
+    import pytest
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x = np.zeros((32, 4), np.float32)
+    y = np.zeros((32,), np.int32)
+    mk = lambda **kw: Trainer(
+        MLP(num_classes=2), TrainerConfig(early_stop_patience=2, **kw)
+    )
+    with pytest.raises(ValueError, match="validation_fraction"):
+        mk(validation_fraction=0.0).fit(x, y)
+    with pytest.raises(ValueError, match="not supported together"):
+        mk(checkpoint_dir="/tmp/nope").fit(x, y)
+    with pytest.raises(ValueError, match="scanned path"):
+        Trainer(
+            MLP(num_classes=2),
+            TrainerConfig(early_stop_patience=2),
+            scan=False,
+        ).fit(x, y)
+
+
+def test_negative_patience_rejected():
+    import numpy as np
+    import pytest
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    with pytest.raises(ValueError, match="early_stop_patience"):
+        Trainer(
+            MLP(num_classes=2), TrainerConfig(early_stop_patience=-3)
+        ).fit(np.zeros((16, 4), np.float32), np.zeros((16,), np.int32))
