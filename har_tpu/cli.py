@@ -53,6 +53,11 @@ def _parser() -> argparse.ArgumentParser:
                         "checkpoints bundle the fitted pipeline "
                         "vocabularies, `evaluate` scores either kind")
     t.add_argument("--save-every-epochs", type=int, default=None)
+    t.add_argument("--augment", default=None,
+                   choices=["raw_windows", "none"],
+                   help="on-device augmentation inside the train step "
+                        "(raw (T,3) window models): jitter, per-axis "
+                        "scale, 3-D rotation, time masking")
     t.add_argument("--early-stop-patience", type=int, default=None,
                    help="stop neural training after N epochs without "
                         "val-accuracy improvement, keep the best epoch")
@@ -197,7 +202,7 @@ def main(argv=None) -> int:
     neural_params = {}
     for k in ("epochs", "batch_size", "learning_rate",
               "checkpoint_dir", "save_every_epochs",
-              "early_stop_patience", "validation_fraction"):
+              "early_stop_patience", "validation_fraction", "augment"):
         v = getattr(args, k)
         if v is not None:
             neural_params[k] = v

@@ -1,0 +1,102 @@
+"""On-device augmentation for raw (B, T, 3) tri-axial accelerometer windows.
+
+Standard HAR augmentations (jitter, per-axis scaling, 3-D rotation, time
+masking), written as pure-JAX transforms so they run INSIDE the compiled
+training step — no host round-trip per batch, fused with the forward pass
+by XLA.  The reference has no augmentation (its windows are pre-collapsed
+to summary features, SURVEY §2 S); this exists for the raw-window neural
+configs (BASELINE.json 3/5) where generalization comes from exactly these
+invariances: sensor noise (jitter), device placement/orientation
+(rotation), per-device gain (scaling), and dropout-like occlusion (time
+masking).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _random_rotations(key: jax.Array, n: int, max_angle: float, dtype):
+    """(n, 3, 3) rotation matrices: uniform random axis, angle ~ U(0, max)."""
+    k_axis, k_angle = jax.random.split(key)
+    axis = jax.random.normal(k_axis, (n, 3), dtype)
+    axis = axis / jnp.maximum(
+        jnp.linalg.norm(axis, axis=-1, keepdims=True), 1e-8
+    )
+    angle = jax.random.uniform(
+        k_angle, (n,), dtype, minval=0.0, maxval=max_angle
+    )
+    c, s = jnp.cos(angle), jnp.sin(angle)
+    x, y, z = axis[:, 0], axis[:, 1], axis[:, 2]
+    # Rodrigues' rotation formula, batched
+    zero = jnp.zeros_like(x)
+    k_cross = jnp.stack(
+        [
+            jnp.stack([zero, -z, y], -1),
+            jnp.stack([z, zero, -x], -1),
+            jnp.stack([-y, x, zero], -1),
+        ],
+        -2,
+    )  # (n, 3, 3)
+    eye = jnp.eye(3, dtype=dtype)
+    outer = axis[:, :, None] * axis[:, None, :]
+    return (
+        c[:, None, None] * eye
+        + s[:, None, None] * k_cross
+        + (1 - c)[:, None, None] * outer
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowAugment:
+    """Composable augmentation policy; call as ``aug(key, x)`` per batch.
+
+    Every transform is applied per window with independent randomness;
+    zero-valued knobs disable their transform, so the default is a
+    moderate policy and ``WindowAugment(0, 0, 0, 0)`` is the identity.
+    """
+
+    jitter_std: float = 0.03
+    scale_std: float = 0.05
+    max_rotation: float = 0.2  # radians
+    time_mask_fraction: float = 0.1
+
+    def __call__(self, key: jax.Array, x: jax.Array) -> jax.Array:
+        if x.ndim != 3:
+            raise ValueError(
+                "window augmentation expects (batch, time, channels) "
+                f"windows, got shape {tuple(x.shape)} — tabular feature "
+                "models (e.g. mlp) cannot train with --augment"
+            )
+        b, t, c = x.shape
+        kj, ks, kr, km = jax.random.split(key, 4)
+        if self.jitter_std > 0:
+            x = x + self.jitter_std * jax.random.normal(kj, x.shape, x.dtype)
+        if self.scale_std > 0:
+            scale = 1.0 + self.scale_std * jax.random.normal(
+                ks, (b, 1, c), x.dtype
+            )
+            x = x * scale
+        if self.max_rotation > 0 and c == 3:
+            rot = _random_rotations(kr, b, self.max_rotation, x.dtype)
+            x = jnp.einsum("btc,bdc->btd", x, rot)
+        if self.time_mask_fraction > 0:
+            span = max(1, int(round(t * self.time_mask_fraction)))
+            start = jax.random.randint(km, (b, 1), 0, t - span + 1)
+            pos = jnp.arange(t)[None, :]
+            mask = (pos >= start) & (pos < start + span)
+            x = jnp.where(mask[:, :, None], 0.0, x)
+        return x
+
+
+def build_augment(name: str | None) -> Callable | None:
+    """Config-string → augmentation policy (None / "none" → no-op)."""
+    if name is None or name == "none":
+        return None
+    if name == "raw_windows":
+        return WindowAugment()
+    raise ValueError(f"unknown augmentation policy {name!r}")

@@ -28,6 +28,10 @@ class NeuralClassifier:
     standardize: bool = True
     num_classes: int | None = None
     mesh: Any = None
+    # augmentation policy name (har_tpu.data.augment.build_augment);
+    # "raw_windows" enables jitter/scale/rotation/time-mask inside the
+    # compiled train step — raw (B, T, 3) window models only
+    augment: str | None = None
 
     def copy_with(self, **params) -> "NeuralClassifier":
         known = {f.name for f in dataclasses.fields(self)}
@@ -44,12 +48,15 @@ class NeuralClassifier:
         scaler = StandardScaler().fit(x) if self.standardize else None
         if scaler is not None:
             x = scaler.transform(x)
+        from har_tpu.data.augment import build_augment
+
         module = build_model(
             self.model_name, num_classes=num_classes, **self.model_kwargs
         )
-        trained = Trainer(module, self.config, mesh=self.mesh).fit(
-            x, y, num_classes=num_classes
-        )
+        trained = Trainer(
+            module, self.config, mesh=self.mesh,
+            augment=build_augment(self.augment),
+        ).fit(x, y, num_classes=num_classes)
         return NeuralClassifierModel(
             inner=trained, scaler=scaler, num_classes=num_classes
         )

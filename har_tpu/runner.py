@@ -90,7 +90,7 @@ def _known_params() -> set[str]:
         f.name
         for cls in _CLASSICAL.values()
         for f in dataclasses.fields(cls)
-    } | {f.name for f in dataclasses.fields(TrainerConfig)}
+    } | {f.name for f in dataclasses.fields(TrainerConfig)} | {"augment"}
     for name in _NEURAL:
         known |= _neural_model_fields(name)
     return known
@@ -122,6 +122,7 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
         cfg = TrainerConfig(
             **{k: params.pop(k) for k in list(params) if k in train_keys}
         )
+        augment = params.pop("augment", None)
         # cross-model keys (other estimators' knobs) fall away here just
         # like in the classical branch
         fields = _neural_model_fields(name)
@@ -130,6 +131,7 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
             config=cfg,
             model_kwargs={k: v for k, v in params.items() if k in fields},
             mesh=mesh,
+            augment=augment,
         )
     raise ValueError(f"unknown model {name!r}")
 

@@ -62,7 +62,7 @@ class TrainerConfig:
 
 
 def _run_fingerprint(
-    cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module
+    cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module, augment=None
 ) -> str:
     """Stable id for (model, data, schedule): the checkpoint-slot key.
 
@@ -86,6 +86,10 @@ def _run_fingerprint(
             )
         ).encode()
     )
+    if augment is not None:
+        # augmentation changes the run; None is not hashed so slots from
+        # before augmentation existed keep resuming
+        h.update(repr(augment).encode())
     return h.hexdigest()[:16]
 
 
@@ -157,6 +161,7 @@ def make_scan_fit(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    augment: Callable | None = None,
 ) -> Callable:
     """fit(params, opt_state, rng, x, y, batch_idx, step0) -> (params, opt_state, losses).
 
@@ -188,6 +193,10 @@ def make_scan_fit(
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(rng, step_i), shard
             )
+            if augment is not None:
+                # augmentation runs inside the compiled step (fused by
+                # XLA); its randomness is decorrelated from dropout's
+                xb = augment(jax.random.fold_in(step_rng, 1), xb)
 
             def local_sum(p):
                 logits = apply_fn(
@@ -286,6 +295,7 @@ class Trainer:
         config: TrainerConfig | None = None,
         mesh: Mesh | None = None,
         scan: bool = True,
+        augment: Callable | None = None,
     ):
         self.module = module
         self.config = config or TrainerConfig()
@@ -293,6 +303,9 @@ class Trainer:
         # scan=True compiles the whole run into one program (fast, data
         # must fit on device); scan=False streams batches from host.
         self.scan = scan
+        # augment(key, xb) -> xb, applied inside the compiled train step
+        # (scan path); see har_tpu.data.augment
+        self.augment = augment
 
     def fit(
         self,
@@ -367,6 +380,16 @@ class Trainer:
                 "tensor parallelism (tp>1 mesh) requires scan=True — the "
                 "streaming path would silently train replicated params"
             )
+        if self.augment is not None and not self.scan:
+            raise ValueError(
+                "augmentation is implemented for the scanned path "
+                "(scan=True)"
+            )
+        if self.augment is not None and tp > 1:
+            raise ValueError(
+                "augmentation is not wired into the tensor-parallel "
+                "(tp>1) trainer yet"
+            )
         if cfg.save_every_epochs < 0:
             raise ValueError("save_every_epochs must be >= 0")
         if cfg.save_every_epochs and not cfg.checkpoint_dir:
@@ -405,7 +428,10 @@ class Trainer:
                     self.module.apply, optimizer, mesh
                 )
             else:
-                fit = make_scan_fit(self.module.apply, optimizer, mesh)
+                fit = make_scan_fit(
+                    self.module.apply, optimizer, mesh,
+                    augment=self.augment,
+                )
             x_dev, y_dev = jnp.asarray(x), jnp.asarray(y)
             start_epoch = 0
             epochs_run = cfg.epochs  # branches override when they differ
@@ -425,7 +451,9 @@ class Trainer:
                 ckpt_every = cfg.save_every_epochs or 1
                 slot = os.path.join(
                     cfg.checkpoint_dir,
-                    _run_fingerprint(cfg, x, y, self.module),
+                    _run_fingerprint(
+                        cfg, x, y, self.module, augment=self.augment
+                    ),
                 )
                 ckptr = TrainCheckpointer(slot)
                 try:

@@ -1,0 +1,120 @@
+"""On-device window augmentation tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from har_tpu.data.augment import WindowAugment, _random_rotations, build_augment
+
+
+def _x(b=8, t=32, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(b, t, c)), jnp.float32)
+
+
+def test_identity_policy_is_identity():
+    aug = WindowAugment(0.0, 0.0, 0.0, 0.0)
+    x = _x()
+    np.testing.assert_array_equal(
+        np.asarray(aug(jax.random.PRNGKey(0), x)), np.asarray(x)
+    )
+
+
+def test_deterministic_per_key_and_shape_preserving():
+    aug = WindowAugment()
+    x = _x()
+    a = aug(jax.random.PRNGKey(1), x)
+    b = aug(jax.random.PRNGKey(1), x)
+    c = aug(jax.random.PRNGKey(2), x)
+    assert a.shape == x.shape and a.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 0
+
+
+def test_rotations_are_orthonormal():
+    rot = np.asarray(
+        _random_rotations(jax.random.PRNGKey(0), 16, 0.5, jnp.float32)
+    )
+    eye = np.eye(3, dtype=np.float32)
+    for r in rot:
+        np.testing.assert_allclose(r @ r.T, eye, atol=1e-5)
+        assert np.linalg.det(r) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pure_rotation_preserves_norms():
+    aug = WindowAugment(0.0, 0.0, max_rotation=0.5, time_mask_fraction=0.0)
+    x = _x()
+    out = np.asarray(aug(jax.random.PRNGKey(3), x))
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-4,
+    )
+
+
+def test_time_mask_zeroes_contiguous_span():
+    aug = WindowAugment(0.0, 0.0, 0.0, time_mask_fraction=0.25)
+    x = jnp.ones((4, 32, 3), jnp.float32)
+    out = np.asarray(aug(jax.random.PRNGKey(4), x))
+    for w in out:
+        zero_rows = np.nonzero((w == 0).all(axis=-1))[0]
+        assert len(zero_rows) == 8  # 25% of 32
+        assert (np.diff(zero_rows) == 1).all()  # contiguous
+
+
+def test_build_augment_registry():
+    assert build_augment(None) is None
+    assert build_augment("none") is None
+    assert isinstance(build_augment("raw_windows"), WindowAugment)
+    with pytest.raises(ValueError, match="unknown augmentation"):
+        build_augment("mixup")
+
+
+def test_training_with_augment_runs():
+    """End-to-end: NeuralClassifier with augment='raw_windows' trains a
+    CNN on synthetic raw windows and still fits the clean data."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=256, seed=0, window=32)
+    data = FeatureSet(
+        features=np.asarray(raw.windows, np.float32),
+        label=raw.labels.astype(np.int32),
+    )
+    est = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=6, learning_rate=2e-3),
+        model_kwargs={"channels": (16, 16, 16)},
+        augment="raw_windows",
+    )
+    model = est.fit(data)
+    preds = model.transform(data)
+    acc = float((preds.prediction == data.label).mean())
+    # heavy augmentation on a 6-epoch toy run won't reach clean-data
+    # accuracy; the assertions are that it learns (above the 1/6 chance
+    # level) and the loss trajectory is sound and decreasing
+    assert acc > 0.25
+    losses = np.asarray(model.history["loss"])
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_augment_rejected_off_scan_and_on_tabular():
+    import pytest
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    x2d = np.zeros((32, 8), np.float32)
+    y = np.zeros((32,), np.int32)
+    aug = WindowAugment()
+    with pytest.raises(ValueError, match="scanned path"):
+        Trainer(
+            MLP(num_classes=2), TrainerConfig(), scan=False, augment=aug
+        ).fit(x2d, y)
+    with pytest.raises(ValueError, match="batch, time, channels"):
+        aug(jax.random.PRNGKey(0), jnp.asarray(x2d))

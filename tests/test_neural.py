@@ -150,7 +150,9 @@ def test_early_stopping_stops_and_restores_best():
     val_rows = perm[: int(round(len(x) * 0.2))]
     preds = model.transform(x[val_rows]).prediction
     acc = float((preds == y[val_rows]).mean())
-    assert acc == max(h["val_accuracy"])
+    # the trainer's fused predict and NeuralModel's separately-compiled
+    # one can flip a near-tied argmax; allow one flipped row
+    assert acc >= max(h["val_accuracy"]) - 1.5 / len(val_rows)
 
 
 def test_early_stopping_validation():
