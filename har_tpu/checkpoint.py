@@ -540,5 +540,9 @@ def evaluate_checkpoint(
     return {
         "accuracy": rep["accuracy"],
         "f1": rep["f1"],
+        "weightedPrecision": rep["weightedPrecision"],
+        "weightedRecall": rep["weightedRecall"],
+        "count_correct": int(rep["count_correct"]),
+        "count_wrong": int(rep["count_wrong"]),
         "n_test": int(len(test)),
     }

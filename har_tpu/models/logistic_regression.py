@@ -90,16 +90,38 @@ def _train_core(
         value_and_grad = optax.value_and_grad_from_state(smooth_loss)
 
         def step(carry, _):
-            params, st = carry
+            params, st, best = carry
             value, grad = value_and_grad(params, state=st)
+            # L-BFGS line searches can transiently overshoot (observed:
+            # a mid-trajectory loss spike that later self-corrects);
+            # carrying the best-seen iterate makes any max_iter cutoff
+            # land on the best point of the trajectory, not a spike
+            best_loss, best_params = best
+            improved = value < best_loss
+            best = (
+                jnp.where(improved, value, best_loss),
+                jax.tree.map(
+                    lambda new, old: jnp.where(improved, new, old),
+                    params,
+                    best_params,
+                ),
+            )
             updates, st = opt.update(
                 grad, st, params, value=value, grad=grad, value_fn=smooth_loss
             )
             params = optax.apply_updates(params, updates)
-            return (params, st), value
+            return (params, st, best), value
 
-        (params, _), losses = jax.lax.scan(
-            step, ((w0, b0), state), length=max_iter
+        best0 = (jnp.asarray(jnp.inf, x.dtype), (w0, b0))
+        (params, _, best), losses = jax.lax.scan(
+            step, ((w0, b0), state, best0), length=max_iter
+        )
+        # final iterate vs best-seen: keep whichever scores lower
+        final_loss = smooth_loss(params)
+        best_loss, best_params = best
+        take_final = final_loss <= best_loss
+        params = jax.tree.map(
+            lambda f, b: jnp.where(take_final, f, b), params, best_params
         )
     else:
         # FISTA: accelerated proximal gradient with soft-threshold prox.
@@ -344,6 +366,9 @@ class LogisticRegressionModel:
     coefficients: np.ndarray  # (d, C)
     intercept: np.ndarray  # (C,)
     num_classes: int
+    # full per-iteration loss trajectory; the returned coefficients are
+    # the BEST iterate of that trajectory (see _train_core), so
+    # losses[-1] is the last step's loss, min(losses) the model's
     losses: np.ndarray | None = None
 
     def transform(self, data: FeatureSet) -> Predictions:

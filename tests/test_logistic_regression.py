@@ -75,3 +75,26 @@ class TestWisdmParity:
         rep = evaluate(test.label, preds.raw, num_classes=6)
         assert rep["accuracy"] > 0.6148
         assert rep["f1"] > 0.5630
+
+
+def test_lbfgs_cutoff_lands_on_best_iterate():
+    """A max_iter cutoff must never return a transient line-search spike:
+    accuracy at any cutoff is monotone-ish — never catastrophically below
+    a longer run's (regression: iter=50 used to land on a loss spike)."""
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+
+    rng = np.random.default_rng(0)
+    n, d, c = 512, 64, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y = (x @ w + rng.normal(size=(n, c))).argmax(1).astype(np.int32)
+    data = FeatureSet(features=x, label=y)
+    accs = []
+    for it in (10, 25, 50, 100):
+        m = LogisticRegression(max_iter=it, reg_param=0.1).fit(data)
+        rep = evaluate(y, m.transform(data).raw, c)
+        accs.append(rep["accuracy"])
+        losses = np.asarray(m.losses)
+        assert np.isfinite(losses).all()
+    # later cutoffs never collapse below the 10-iteration baseline
+    assert min(accs[1:]) >= accs[0] - 0.02
