@@ -132,8 +132,14 @@ def main(argv=None) -> int:
     args = _parser().parse_args(argv)
 
     if args.command == "bench":
-        import bench
-
+        try:
+            import bench
+        except ImportError:
+            raise SystemExit(
+                "the benchmark script bench.py lives at the repository "
+                "root (it is not part of the installed package); run "
+                "`python bench.py` from a checkout"
+            )
         bench.main()
         return 0
 

@@ -84,6 +84,24 @@ def _train_core(
     w0 = jnp.zeros((d, num_classes), x.dtype)
     b0 = jnp.zeros((num_classes,), x.dtype)
 
+    # Both solvers are non-monotone (L-BFGS line searches can overshoot,
+    # FISTA momentum oscillates), so each carries its best-seen iterate
+    # and returns it at cutoff rather than whatever the last step left.
+    def best_init():
+        return jnp.asarray(jnp.inf, x.dtype), (w0, b0)
+
+    def best_update(best, value, params):
+        best_loss, best_params = best
+        improved = value < best_loss
+        return (
+            jnp.where(improved, value, best_loss),
+            jax.tree.map(
+                lambda new, old: jnp.where(improved, new, old),
+                params,
+                best_params,
+            ),
+        )
+
     if elastic_net_param == 0.0:  # static → no L1 term, smooth solver
         opt = optax.lbfgs()
         state = opt.init((w0, b0))
@@ -92,29 +110,15 @@ def _train_core(
         def step(carry, _):
             params, st, best = carry
             value, grad = value_and_grad(params, state=st)
-            # L-BFGS line searches can transiently overshoot (observed:
-            # a mid-trajectory loss spike that later self-corrects);
-            # carrying the best-seen iterate makes any max_iter cutoff
-            # land on the best point of the trajectory, not a spike
-            best_loss, best_params = best
-            improved = value < best_loss
-            best = (
-                jnp.where(improved, value, best_loss),
-                jax.tree.map(
-                    lambda new, old: jnp.where(improved, new, old),
-                    params,
-                    best_params,
-                ),
-            )
+            best = best_update(best, value, params)
             updates, st = opt.update(
                 grad, st, params, value=value, grad=grad, value_fn=smooth_loss
             )
             params = optax.apply_updates(params, updates)
             return (params, st, best), value
 
-        best0 = (jnp.asarray(jnp.inf, x.dtype), (w0, b0))
         (params, _, best), losses = jax.lax.scan(
-            step, ((w0, b0), state, best0), length=max_iter
+            step, ((w0, b0), state, best_init()), length=max_iter
         )
         # final iterate vs best-seen: keep whichever scores lower
         final_loss = smooth_loss(params)
@@ -135,7 +139,7 @@ def _train_core(
             return jnp.sign(w) * jnp.maximum(jnp.abs(w) - lr * l1, 0.0)
 
         def step(carry, t):
-            (w, b), (zw, zb), t_prev = carry
+            (w, b), (zw, zb), t_prev, best = carry
             g_w, g_b = jax.grad(smooth_loss)((zw, zb))
             w_new = prox(zw - lr * g_w)
             b_new = zb - lr * g_b
@@ -143,14 +147,19 @@ def _train_core(
             beta = (t_prev - 1.0) / t_new
             zw_new = w_new + beta * (w_new - w)
             zb_new = b_new + beta * (b_new - b)
-            return ((w_new, b_new), (zw_new, zb_new), t_new), smooth_loss(
-                (w_new, b_new)
-            ) + l1 * jnp.sum(jnp.abs(w_new))
+            value = smooth_loss((w_new, b_new)) + l1 * jnp.sum(
+                jnp.abs(w_new)
+            )
+            best = best_update(best, value, (w_new, b_new))
+            return ((w_new, b_new), (zw_new, zb_new), t_new, best), value
 
-        init = ((w0, b0), (w0, b0), jnp.array(1.0, x.dtype))
-        (params, _, _), losses = jax.lax.scan(
+        init = ((w0, b0), (w0, b0), jnp.array(1.0, x.dtype), best_init())
+        (params, _, _, best), losses = jax.lax.scan(
             step, init, jnp.arange(max_iter)
         )
+        # the best carry already includes every iterate (value computed
+        # at the accepted point), so just take it
+        params = best[1]
 
     w, b = params
     if not fit_intercept:
@@ -366,9 +375,12 @@ class LogisticRegressionModel:
     coefficients: np.ndarray  # (d, C)
     intercept: np.ndarray  # (C,)
     num_classes: int
-    # full per-iteration loss trajectory; the returned coefficients are
-    # the BEST iterate of that trajectory (see _train_core), so
-    # losses[-1] is the last step's loss, min(losses) the model's
+    # per-iteration loss trajectory (each entry is the loss at that
+    # step's accepted point for FISTA / pre-update point for L-BFGS).
+    # The returned coefficients are the best point seen — the final
+    # iterate when it is at least as good — so the model's own loss can
+    # sit at or below min(losses); use the trajectory for convergence
+    # shape, not as the trained model's exact loss.
     losses: np.ndarray | None = None
 
     def transform(self, data: FeatureSet) -> Predictions:
