@@ -74,8 +74,11 @@ class ModelResult:
 class ReportWriter:
     """Accumulates the run log in memory; `save()` writes the artifacts."""
 
-    def __init__(self, output_dir: str):
+    def __init__(
+        self, output_dir: str, class_names: Sequence[str] | None = None
+    ):
         self.output_dir = output_dir
+        self.class_names = list(class_names) if class_names else None
         self._buf = io.StringIO()
         self.results: list[ModelResult] = []
 
@@ -256,9 +259,14 @@ class ReportWriter:
         k = len(cm)
         self.line("------------------Per-Class Metrics---------------------")
         self.line()
+        names = (
+            self.class_names
+            if self.class_names and len(self.class_names) == k
+            else [str(c) for c in range(k)]
+        )
         rows = [
             [
-                c,
+                names[c],
                 int(cm[c].sum()),
                 f"{m['precision_per_class'][c]:.4f}",
                 f"{m['recall_per_class'][c]:.4f}",
@@ -275,8 +283,8 @@ class ReportWriter:
         )
         self._buf.write(
             show(
-                ["true\\pred"] + [str(c) for c in range(k)],
-                [[c] + [int(v) for v in cm[c]] for c in range(k)],
+                ["true\\pred"] + list(names),
+                [[names[c]] + [int(v) for v in cm[c]] for c in range(k)],
                 max_rows=None,
             )
         )

@@ -530,17 +530,29 @@ def run(
     from har_tpu.utils.profiling import StepTimer, write_timing_csv
 
     timer = StepTimer()
-    report = ReportWriter(config.output_dir)
-    report.line("Loading Data Set...")
     with timer("load"):
         table = load_dataset(config)
     is_raw = not hasattr(table, "column_names")  # WindowedDataset
+    # class names for the per-class metric tables: frequency-descending
+    # label order for tabular WISDM (the StringIndexer convention —
+    # featurize() fits the same indexer on the same full table, so the
+    # ids line up), the stream's names for raw windows
+    if is_raw:
+        class_names = table.class_names or None
+    elif "ACTIVITY" in table.column_names:
+        from har_tpu.features.string_indexer import StringIndexer
+
+        class_names = StringIndexer("ACTIVITY", "label").fit(table).vocab
+    else:
+        class_names = None
+    report = ReportWriter(config.output_dir, class_names=class_names)
+    report.line("Loading Data Set...")
     if is_raw:
         report.line(
             f"Raw windows: {tuple(table.windows.shape)} "
             f"({table.windows.shape[1]} steps, tri-axial)"
         )
-        names = table.class_names or tuple(
+        names = report.class_names or tuple(
             str(i) for i in range(int(table.labels.max()) + 1)
         )
         report.class_counts(
