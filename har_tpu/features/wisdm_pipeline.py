@@ -30,6 +30,10 @@ class FeatureSet:
     features: np.ndarray  # (n, d) float32
     label: np.ndarray  # (n,) int32
     uid: np.ndarray | None = None
+    # label id -> display name, from the SAME indexer fit that produced
+    # `label` (so reports can never mislabel classes); None when the
+    # source has no name vocabulary
+    class_names: tuple[str, ...] | None = None
 
     def __len__(self) -> int:
         return len(self.features)
@@ -43,6 +47,7 @@ class FeatureSet:
             features=self.features[indices],
             label=self.label[indices],
             uid=None if self.uid is None else self.uid[indices],
+            class_names=self.class_names,
         )
 
     def split(self, fractions, seed: int) -> list["FeatureSet"]:
@@ -81,11 +86,14 @@ def build_wisdm_pipeline(
     return Pipeline(stages)
 
 
-def make_feature_set(columns: ColumnSpace) -> FeatureSet:
+def make_feature_set(
+    columns: ColumnSpace, class_names: tuple[str, ...] | None = None
+) -> FeatureSet:
     return FeatureSet(
         features=np.ascontiguousarray(columns["features"], dtype=np.float32),
         label=columns["label"].astype(np.int32),
         uid=columns.get("UID"),
+        class_names=class_names,
     )
 
 

@@ -46,7 +46,9 @@ from har_tpu.models.base import Predictions
 def _train_core(
     x: jax.Array,
     y: jax.Array,
-    row_w: jax.Array,  # (n,) 1.0 real rows / 0.0 padding
+    row_w: jax.Array,  # (n,) per-row weights: 0.0 = padding; CV fold
+    # masks are 1/0, class_weight="balanced" passes arbitrary positive
+    # weights (which also enter the standardization statistics)
     num_classes: int,
     max_iter: int,
     reg_param: jax.Array,  # traced → one compilation serves a whole grid
@@ -61,7 +63,8 @@ def _train_core(
 
     if standardize:
         # weighted mean/var with Bessel correction — equals np.std(ddof=1)
-        # on unit weights, and ignores zero-weight padding rows
+        # on unit weights, ignores zero-weight padding rows, and under
+        # class weighting computes class-balanced statistics
         mean = (x * row_w[:, None]).sum(0) / n_eff
         var = ((x - mean) ** 2 * row_w[:, None]).sum(0) / jnp.maximum(
             n_eff - 1.0, 1.0
@@ -179,9 +182,10 @@ def _train_core(
         "standardize",
     ),
 )
-def _train(
+def _train_weighted(
     x: jax.Array,
     y: jax.Array,
+    row_w: jax.Array,
     num_classes: int,
     max_iter: int,
     reg_param: float,
@@ -192,7 +196,7 @@ def _train(
     return _train_core(
         x,
         y,
-        jnp.ones((x.shape[0],), x.dtype),
+        row_w,
         num_classes,
         max_iter,
         jnp.asarray(reg_param, x.dtype),
@@ -299,6 +303,10 @@ class LogisticRegression:
     elastic_net_param: float = 0.0
     fit_intercept: bool = True
     standardize: bool = True
+    # None → every row weighs 1 (MLlib default); "balanced" reweighs
+    # rows by n / (num_classes * count(class)) so minority activities
+    # (WISDM: Standing 246 vs Walking 2081) pull equally on the loss
+    class_weight: str | None = None
     num_classes: int | None = None  # inferred from labels when None
 
     def copy_with(self, **params) -> "LogisticRegression":
@@ -312,8 +320,13 @@ class LogisticRegression:
         fit-per-cell path.
         """
         allowed = {"reg_param", "elastic_net_param"}
-        if metric not in _CV_METRICS or any(
-            set(g) - allowed for g in grid
+        if (
+            metric not in _CV_METRICS
+            or any(set(g) - allowed for g in grid)
+            # the vectorized sweep weighs rows only with fold padding
+            # masks; class-weighted selection must use the generic
+            # fit-per-cell path so every CV fit matches fit()'s objective
+            or self.class_weight is not None
         ):
             return None
         num_classes = self.num_classes or int(data.label.max()) + 1
@@ -351,10 +364,27 @@ class LogisticRegression:
         return scores
 
     def fit(self, data: FeatureSet) -> "LogisticRegressionModel":
+        if self.class_weight not in (None, "balanced"):
+            raise ValueError(
+                f"class_weight={self.class_weight!r}; use None or "
+                "'balanced'"
+            )
         num_classes = self.num_classes or int(data.label.max()) + 1
-        w, b, losses = _train(
+        y_np = np.asarray(data.label)
+        if self.class_weight == "balanced":
+            counts = np.bincount(y_np, minlength=num_classes).astype(
+                np.float32
+            )
+            per_class = len(y_np) / (
+                num_classes * np.maximum(counts, 1.0)
+            )
+            row_w = jnp.asarray(per_class[y_np])
+        else:
+            row_w = jnp.ones((len(y_np),), jnp.float32)
+        w, b, losses = _train_weighted(
             jnp.asarray(data.features, dtype=jnp.float32),
             jnp.asarray(data.label),
+            row_w,
             num_classes=num_classes,
             max_iter=self.max_iter,
             reg_param=float(self.reg_param),

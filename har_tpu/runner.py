@@ -245,7 +245,13 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
             from har_tpu.features.raw_features import extract_features
 
             x = np.asarray(extract_features(table.windows), np.float32)
-        full = FeatureSet(features=x, label=np.asarray(table.labels, np.int32))
+        full = FeatureSet(
+            features=x,
+            label=np.asarray(table.labels, np.int32),
+            class_names=(
+                tuple(table.class_names) if table.class_names else None
+            ),
+        )
         train, test = full.train_test(
             config.data.train_fraction, config.data.seed
         )
@@ -261,19 +267,27 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
             c in table.column_names for c in BINNED_COLUMNS
         )
         x, _ = numeric_feature_view(table, include_binned=has_bins)
-        y = np.asarray(
-            StringIndexer("ACTIVITY", "label")
-            .fit(table)
-            .transform(table)["label"],
-            np.int32,
-        )
+        indexer = StringIndexer("ACTIVITY", "label").fit(table)
+        y = np.asarray(indexer.transform(table)["label"], np.int32)
         uid = table["UID"] if "UID" in table.column_names else None
-        full = FeatureSet(features=x, label=y, uid=uid)
+        full = FeatureSet(
+            features=x, label=y, uid=uid, class_names=indexer.vocab
+        )
         pipe_model = None
     else:
         pipeline = build_wisdm_pipeline()
         pipe_model = pipeline.fit(table)
-        full = make_feature_set(pipe_model.transform(table))
+        label_vocab = next(
+            (
+                s.vocab
+                for s in pipe_model.stages
+                if getattr(s, "output_col", None) == "label"
+            ),
+            None,
+        )
+        full = make_feature_set(
+            pipe_model.transform(table), class_names=label_vocab
+        )
     train, test = full.train_test(
         config.data.train_fraction, config.data.seed
     )
@@ -533,26 +547,14 @@ def run(
     with timer("load"):
         table = load_dataset(config)
     is_raw = not hasattr(table, "column_names")  # WindowedDataset
-    # class names for the per-class metric tables: frequency-descending
-    # label order for tabular WISDM (the StringIndexer convention —
-    # featurize() fits the same indexer on the same full table, so the
-    # ids line up), the stream's names for raw windows
-    if is_raw:
-        class_names = table.class_names or None
-    elif "ACTIVITY" in table.column_names:
-        from har_tpu.features.string_indexer import StringIndexer
-
-        class_names = StringIndexer("ACTIVITY", "label").fit(table).vocab
-    else:
-        class_names = None
-    report = ReportWriter(config.output_dir, class_names=class_names)
+    report = ReportWriter(config.output_dir)
     report.line("Loading Data Set...")
     if is_raw:
         report.line(
             f"Raw windows: {tuple(table.windows.shape)} "
             f"({table.windows.shape[1]} steps, tri-axial)"
         )
-        names = report.class_names or tuple(
+        names = table.class_names or tuple(
             str(i) for i in range(int(table.labels.max()) + 1)
         )
         report.class_counts(
@@ -576,6 +578,12 @@ def run(
     # a model can't run on this dataset), featurizing each view once
     modes, view_cache = _views_for(models, config, table, timer=timer)
     first_train, first_test = view_cache[modes[models[0]]][:2]
+    # per-class display names come from the SAME indexer fit that
+    # produced the labels (carried on the FeatureSet), so the report can
+    # never mislabel classes
+    report.class_names = (
+        list(first_train.class_names) if first_train.class_names else None
+    )
     report.split_counts(len(first_train), len(first_test))
 
     mesh = _mesh_from_config(config)

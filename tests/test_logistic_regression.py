@@ -1,8 +1,10 @@
 """LR model tests: learnability, regularization behavior, WISDM parity."""
 
 import numpy as np
+import pytest
 
 from har_tpu.data import load_wisdm, synthetic_wisdm
+from har_tpu.features.wisdm_pipeline import FeatureSet
 from har_tpu.features import build_wisdm_pipeline, make_feature_set
 from har_tpu.models import LogisticRegression
 from har_tpu.ops.metrics import evaluate
@@ -81,8 +83,6 @@ def test_lbfgs_cutoff_lands_on_best_iterate():
     """A max_iter cutoff must never return a transient line-search spike:
     accuracy at any cutoff is monotone-ish — never catastrophically below
     a longer run's (regression: iter=50 used to land on a loss spike)."""
-    from har_tpu.features.wisdm_pipeline import FeatureSet
-
     rng = np.random.default_rng(0)
     n, d, c = 512, 64, 6
     x = rng.normal(size=(n, d)).astype(np.float32)
@@ -98,3 +98,30 @@ def test_lbfgs_cutoff_lands_on_best_iterate():
         assert np.isfinite(losses).all()
     # later cutoffs never collapse below the 10-iteration baseline
     assert min(accs[1:]) >= accs[0] - 0.02
+
+
+def test_class_weight_balanced():
+    """Balanced reweighing lifts minority-class recall on skewed data."""
+    rng = np.random.default_rng(1)
+    n, d = 600, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, 2))
+    margin = x @ w
+    y = (margin[:, 1] - margin[:, 0] > 3.0).astype(np.int32)  # rare class 1
+    data = FeatureSet(features=x, label=y)
+    assert 0 < y.sum() < n // 4  # genuinely imbalanced
+
+    plain = LogisticRegression(max_iter=50, reg_param=0.1).fit(data)
+    balanced = LogisticRegression(
+        max_iter=50, reg_param=0.1, class_weight="balanced"
+    ).fit(data)
+
+    def recall_minority(m):
+        pred = np.asarray(m.transform(data).prediction)
+        return float(((pred == 1) & (y == 1)).sum() / max(y.sum(), 1))
+
+    # strictly greater on this seeded fixture — an accidental no-op
+    # (weights regressing to ones) would make them equal and fail
+    assert recall_minority(balanced) > recall_minority(plain)
+    with pytest.raises(ValueError, match="class_weight"):
+        LogisticRegression(class_weight="nope").fit(data)
