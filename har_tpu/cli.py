@@ -58,6 +58,10 @@ def _parser() -> argparse.ArgumentParser:
                    help="on-device augmentation inside the train step "
                         "(raw (T,3) window models): jitter, per-axis "
                         "scale, 3-D rotation, time masking")
+    t.add_argument("--class-weight", default=None,
+                   choices=["balanced"],
+                   help="reweigh the neural loss by inverse class "
+                        "frequency (minority activities pull equally)")
     t.add_argument("--early-stop-patience", type=int, default=None,
                    help="stop neural training after N epochs without "
                         "val-accuracy improvement, keep the best epoch")
@@ -208,7 +212,8 @@ def main(argv=None) -> int:
     neural_params = {}
     for k in ("epochs", "batch_size", "learning_rate",
               "checkpoint_dir", "save_every_epochs",
-              "early_stop_patience", "validation_fraction", "augment"):
+              "early_stop_patience", "validation_fraction", "augment",
+              "class_weight"):
         v = getattr(args, k)
         if v is not None:
             neural_params[k] = v

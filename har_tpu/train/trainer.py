@@ -59,6 +59,9 @@ class TrainerConfig:
     # 0 → off.
     early_stop_patience: int = 0
     validation_fraction: float = 0.1
+    # None → every row weighs 1; "balanced" reweighs the loss by
+    # n / (num_classes * count(class)) so minority classes pull equally
+    class_weight: str | None = None
 
 
 def _run_fingerprint(
@@ -90,6 +93,8 @@ def _run_fingerprint(
         # augmentation changes the run; None is not hashed so slots from
         # before augmentation existed keep resuming
         h.update(repr(augment).encode())
+    if cfg.class_weight is not None:
+        h.update(repr(cfg.class_weight).encode())
     return h.hexdigest()[:16]
 
 
@@ -162,6 +167,7 @@ def make_scan_fit(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     augment: Callable | None = None,
+    class_weights: jax.Array | None = None,  # (C,) per-class loss weights
 ) -> Callable:
     """fit(params, opt_state, rng, x, y, batch_idx, step0) -> (params, opt_state, losses).
 
@@ -198,6 +204,11 @@ def make_scan_fit(
                 # XLA); its randomness is decorrelated from dropout's
                 xb = augment(jax.random.fold_in(step_rng, 1), xb)
 
+            if class_weights is not None:
+                wb = class_weights[yb]
+            else:
+                wb = jnp.ones((yb.shape[0],), jnp.float32)
+
             def local_sum(p):
                 logits = apply_fn(
                     {"params": p}, xb, train=True,
@@ -206,7 +217,7 @@ def make_scan_fit(
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, yb
                 )
-                return jnp.sum(ce), jnp.asarray(yb.shape[0], jnp.float32)
+                return jnp.sum(ce * wb), jnp.sum(wb)
 
             (loss_sum, count), grads = jax.value_and_grad(
                 local_sum, has_aux=True
@@ -390,6 +401,24 @@ class Trainer:
                 "augmentation is not wired into the tensor-parallel "
                 "(tp>1) trainer yet"
             )
+        if cfg.class_weight not in (None, "balanced"):
+            raise ValueError(
+                f"class_weight={cfg.class_weight!r}; use None or "
+                "'balanced'"
+            )
+        if cfg.class_weight is not None and tp > 1:
+            raise ValueError(
+                "class weighting is not wired into the tensor-parallel "
+                "(tp>1) trainer yet"
+            )
+        class_weights = None
+        if cfg.class_weight == "balanced":
+            counts = np.bincount(y, minlength=num_classes).astype(
+                np.float32
+            )
+            class_weights = jnp.asarray(
+                n / (num_classes * np.maximum(counts, 1.0))
+            )
         if cfg.save_every_epochs < 0:
             raise ValueError("save_every_epochs must be >= 0")
         if cfg.save_every_epochs and not cfg.checkpoint_dir:
@@ -431,6 +460,7 @@ class Trainer:
                 fit = make_scan_fit(
                     self.module.apply, optimizer, mesh,
                     augment=self.augment,
+                    class_weights=class_weights,
                 )
             x_dev, y_dev = jnp.asarray(x), jnp.asarray(y)
             start_epoch = 0
@@ -582,25 +612,33 @@ class Trainer:
             step = make_train_step(self.module.apply, optimizer, mesh)
             x_shard = batch_sharding(mesh, x.ndim)
             y_shard = batch_sharding(mesh, 1)
-            mask = jax.device_put(
-                np.ones(cfg.batch_size, np.float32), y_shard
+            cw_np = (
+                np.asarray(class_weights) if class_weights is not None
+                else None
             )
             step_idx = 0
             for epoch in range(cfg.epochs):
                 # double-buffered host→device feed: the next batch's
-                # transfer overlaps the current step's compute
+                # transfer overlaps the current step's compute; class
+                # weights ride the existing per-row mask
                 batches = prefetch_to_device(
                     batch_iterator(n, cfg.batch_size, host_rng),
                     size=2,
                     transfer=lambda idx: (
                         jax.device_put(x[idx], x_shard),
                         jax.device_put(y[idx], y_shard),
+                        jax.device_put(
+                            np.ones(len(idx), np.float32)
+                            if cw_np is None
+                            else cw_np[y[idx]],
+                            y_shard,
+                        ),
                     ),
                 )
-                for xb, yb in batches:
+                for xb, yb, mb in batches:
                     rng = jax.random.fold_in(step_root, step_idx)
                     params, opt_state, loss = step(
-                        params, opt_state, rng, xb, yb, mask
+                        params, opt_state, rng, xb, yb, mb
                     )
                     step_idx += 1
                 history["loss"].append(float(loss))
