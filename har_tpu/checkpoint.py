@@ -44,12 +44,16 @@ def _abspath(path: str) -> str:
 def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                model_kwargs: dict | None = None,
                dataset: str | None = None,
-               synthetic_rows: int | None = None) -> str:
+               synthetic_rows: int | None = None,
+               drop_binned: bool | None = None,
+               split_method: str | None = None) -> str:
     """Persist a trained neural classifier (params + scaler + config).
 
-    ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks) records
-    what the model was trained on, so `evaluate_checkpoint` can re-derive
-    the matching test features without the caller re-stating it.
+    ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks,
+    ``drop_binned`` for the feature-view width, ``split_method`` for the
+    train/test draw) records what the model was trained on, so
+    `evaluate_checkpoint` can re-derive the matching test features without
+    the caller re-stating it.
     """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -68,6 +72,10 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
         meta["dataset"] = dataset
     if synthetic_rows is not None:
         meta["synthetic_rows"] = synthetic_rows
+    if drop_binned is not None:
+        meta["drop_binned"] = drop_binned
+    if split_method is not None:
+        meta["split_method"] = split_method
     if model.scaler is not None:
         meta["scaler"] = {
             "mean": np.asarray(model.scaler.mean).tolist(),
@@ -228,6 +236,7 @@ def save_classical_model(
     dataset: str | None = None,
     synthetic_rows: int | None = None,
     drop_binned: bool | None = None,
+    split_method: str | None = None,
     pipeline=None,
 ) -> str:
     """Persist a classical model (and optionally its feature pipeline).
@@ -256,6 +265,8 @@ def save_classical_model(
         meta["synthetic_rows"] = synthetic_rows
     if drop_binned is not None:
         meta["drop_binned"] = drop_binned
+    if split_method is not None:
+        meta["split_method"] = split_method
     with open(os.path.join(path, _META), "w") as f:
         json.dump(meta, f)
     pipe_path = os.path.join(path, _PIPELINE)
@@ -450,6 +461,9 @@ def _load_checkpoint_for_scoring(
             seed=seed,
             synthetic_rows=synthetic_rows,
             drop_binned=meta.get("drop_binned", True),
+            # checkpoints predating the spark-exact split were held out
+            # under the bernoulli draw; honor their provenance
+            split_method=meta.get("split_method", "bernoulli"),
         ),
         model=ModelConfig(name=model_name),
     )
@@ -460,10 +474,11 @@ def _load_checkpoint_for_scoring(
         # refit; new rows with unseen categories fail or bucket per the
         # indexer's handle_invalid, exactly as the training-time pipeline
         from har_tpu.features.wisdm_pipeline import make_feature_set
+        from har_tpu.runner import derive_split
 
         pipe = load_pipeline_model(pipe_path)
         full = make_feature_set(pipe.transform(table))
-        _, test = full.train_test(train_fraction, seed)
+        _, test = derive_split(full, table, config.data)
     else:
         _, test, _ = featurize(config, table)
     return model, test

@@ -36,6 +36,11 @@ def _parser() -> argparse.ArgumentParser:
                    help="lr dt rf gbt mlp cnn1d bilstm transformer")
     t.add_argument("--train-fraction", type=float, default=0.7)
     t.add_argument("--seed", type=int, default=2018)
+    t.add_argument("--split-method", default="auto",
+                   choices=["auto", "spark", "bernoulli"],
+                   help="train/test draw: spark replays the reference's "
+                        "randomSplit row-for-row (WISDM only); auto picks "
+                        "it for the wisdm dataset")
     t.add_argument("--no-cv", action="store_true",
                    help="skip the 5-fold CrossValidator pass")
     t.add_argument("--cv-metric", default="accuracy",
@@ -76,6 +81,17 @@ def _parser() -> argparse.ArgumentParser:
     t.add_argument("--trace-dir", default=None,
                    help="write a TensorBoard-loadable jax.profiler trace "
                         "of the whole run to this directory")
+    t.add_argument("--distributed", action="store_true",
+                   help="multi-host SPMD: call jax.distributed.initialize "
+                        "before any device use (every host runs the same "
+                        "command; coordinator/count/id autodetect on Cloud "
+                        "TPU pods, or set the flags below)")
+    t.add_argument("--coordinator", default=None,
+                   help="coordinator host:port (with --distributed)")
+    t.add_argument("--num-processes", type=int, default=None,
+                   help="total process count (with --distributed)")
+    t.add_argument("--process-id", type=int, default=None,
+                   help="this host's rank (with --distributed)")
     t.add_argument("--dp", type=int, default=1,
                    help="data-parallel mesh axis for neural training "
                         "(-1 = all devices; batch is sharded over dp, "
@@ -136,14 +152,19 @@ def main(argv=None) -> int:
     args = _parser().parse_args(argv)
 
     if args.command == "bench":
-        try:
-            import bench
-        except ImportError:
+        import importlib.util
+
+        # probe for the module itself first: an ImportError raised by
+        # bench.py's OWN imports is a real dependency problem and must
+        # surface as-is, not as "bench.py not found"
+        if importlib.util.find_spec("bench") is None:
             raise SystemExit(
                 "the benchmark script bench.py lives at the repository "
                 "root (it is not part of the installed package); run "
                 "`python bench.py` from a checkout"
             )
+        import bench
+
         bench.main()
         return 0
 
@@ -208,6 +229,24 @@ def main(argv=None) -> int:
     from har_tpu.config import MeshConfig
     from har_tpu.runner import canonical_model_name
 
+    if getattr(args, "distributed", False):
+        # must run before the first jax device query on every host
+        from har_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    elif any(
+        getattr(args, k) is not None
+        for k in ("coordinator", "num_processes", "process_id")
+    ):
+        raise SystemExit(
+            "--coordinator/--num-processes/--process-id require "
+            "--distributed"
+        )
+
     models = [canonical_model_name(m) for m in args.models]
     neural_params = {}
     for k in ("epochs", "batch_size", "learning_rate",
@@ -224,6 +263,7 @@ def main(argv=None) -> int:
             drop_binned=not args.keep_binned,
             train_fraction=args.train_fraction,
             seed=args.seed,
+            split_method=args.split_method,
         ),
         model=ModelConfig(name=models[0], params=neural_params),
         mesh=MeshConfig(dp=args.dp, tp=args.tp),

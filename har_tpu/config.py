@@ -36,6 +36,12 @@ class DataConfig:
     drop_binned: bool = True
     train_fraction: float = 0.7
     seed: int = 2018
+    # How train/test membership is drawn.  "spark" replays the reference's
+    # randomSplit bit-for-bit (XORShiftRandom + vector-struct sort; see
+    # har_tpu.data.spark_split) — 3,793/1,625 rows for seed 2018, row-exact
+    # vs result.txt:105-131.  "bernoulli" is the plain NumPy draw.  "auto"
+    # picks spark for the tabular WISDM dataset, bernoulli elsewhere.
+    split_method: str = "auto"  # auto | spark | bernoulli
     # Row count for synthetic fallbacks (None → dataset-matching defaults:
     # 5418 tabular rows / 4000 raw windows / 2000 UCI rows); tests shrink
     # it to keep CPU runs fast.

@@ -65,9 +65,20 @@ def read_csv(
                 return read_csv_native(path)
             if engine == "native":
                 raise RuntimeError("native CSV engine unavailable")
-        except Exception:
+        except Exception as exc:
             if engine == "native":
                 raise
+            # engine="auto": fall back to the Python parser, but never
+            # silently — a native-parser regression must stay visible
+            import warnings
+
+            warnings.warn(
+                "native CSV loader failed "
+                f"({type(exc).__name__}: {exc}); falling back to the "
+                "Python parser",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     with open(path, newline="") as f:
         reader = _csv.reader(f)
         rows = list(reader)

@@ -34,6 +34,10 @@ class FeatureSet:
     # `label` (so reports can never mislabel classes); None when the
     # source has no name vocabulary
     class_names: tuple[str, ...] | None = None
+    # original-table row indices this set was carved from (set by the
+    # split paths, in sampled-stream order) — lets the report render the
+    # reference's train/test show(5) tables; None once re-indexed
+    rows: np.ndarray | None = None
 
     def __len__(self) -> int:
         return len(self.features)
@@ -54,7 +58,7 @@ class FeatureSet:
         from har_tpu.data.split import split_indices
 
         return [
-            self.take(idx)
+            dataclasses.replace(self.take(idx), rows=idx)
             for idx in split_indices(len(self), fractions, seed)
         ]
 
@@ -78,7 +82,15 @@ def build_wisdm_pipeline(
     stages: list = []
     assembled: list[str] = []
     for col in categorical:
-        stages.append(StringIndexer(col, f"{col}_index", handle_invalid="keep"))
+        # spark_hash tie-break: equal-count vocabulary entries keep
+        # MLlib's order, so one-hot indices equal the reference's
+        # feature vectors bit-for-bit (result.txt:110-137)
+        stages.append(
+            StringIndexer(
+                col, f"{col}_index",
+                handle_invalid="keep", tie_break="spark_hash",
+            )
+        )
         stages.append(OneHotEncoder(f"{col}_index", f"{col}_vec"))
         assembled.append(f"{col}_vec")
     stages.append(StringIndexer(label, "label"))

@@ -85,7 +85,16 @@ def _train_core(
         return (ce * row_w).sum() / n_eff + 0.5 * l2 * jnp.sum(w * w)
 
     w0 = jnp.zeros((d, num_classes), x.dtype)
-    b0 = jnp.zeros((num_classes,), x.dtype)
+    # MLlib starts the intercepts at the log of the class priors
+    # (LogisticRegression.scala "initialCoefWithInterceptMatrix": the
+    # optimal intercept for zero coefficients); zeros otherwise.  This
+    # shapes the early L-BFGS trajectory the reference's maxIter=20
+    # numbers were captured on.
+    if fit_intercept:
+        prior = (y1h * row_w[:, None]).sum(0) / n_eff
+        b0 = jnp.log(jnp.maximum(prior, 1e-12))
+    else:
+        b0 = jnp.zeros((num_classes,), x.dtype)
 
     # Both solvers are non-monotone (L-BFGS line searches can overshoot,
     # FISTA momentum oscillates), so each carries its best-seen iterate

@@ -44,12 +44,73 @@ def quantile_thresholds(
 ) -> jax.Array:
     """(d, max_bins-1) per-feature candidate split thresholds.
 
-    Evenly spaced quantiles of each feature (MLlib's approxQuantile
-    analogue).  Repeated thresholds are harmless: they yield empty bins
-    and zero-gain splits.
+    Evenly spaced quantiles of each feature.  Repeated thresholds are
+    harmless: they yield empty bins and zero-gain splits.  The parity
+    default is :func:`mllib_split_candidates`; this stays as the cheap
+    on-device alternative for wide synthetic sweeps.
     """
     qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
     return jnp.quantile(x, qs, axis=0).T  # (d, B-1)
+
+
+def mllib_split_candidates(x: np.ndarray, max_bins: int) -> np.ndarray:
+    """(d, max_bins-1) thresholds, faithful to MLlib's findSplits.
+
+    Spark's ``RandomForest.findSplitsForContinuousFeature``: when a feature
+    has ``<= max_bins`` distinct values the candidates are the midpoints
+    between every pair of adjacent distinct values (exact for the 3,090
+    one-hot dims — a single 0.5 threshold); otherwise a stride walk over
+    the distinct-value histogram places ``max_bins - 1`` thresholds at
+    (approximately) equal-count boundaries, each again a midpoint of
+    adjacent distinct values.  This is the split-candidate set the
+    reference's DT/RF searched (Main/main.py:297,478), so gains — and
+    trees — line up with the captured run.
+
+    Unused candidate slots are padded with ``+inf``: their "splits" route
+    every row left and are rejected by the min-instances guard.
+    """
+    x = np.asarray(x, np.float64)
+    n, d = x.shape
+    num_splits = max_bins - 1
+    out = np.full((d, num_splits), np.inf, np.float64)
+    # vectorized fast path: {0,1}-valued columns (the one-hot block)
+    is01 = ((x == 0.0) | (x == 1.0)).all(axis=0)
+    binary = is01 & (x == 0.0).any(axis=0) & (x == 1.0).any(axis=0)
+    out[binary, 0] = 0.5
+    for j in np.nonzero(~binary)[0]:
+        vals, counts = np.unique(x[:, j], return_counts=True)
+        possible = len(vals) - 1
+        if possible == 0:
+            continue  # constant feature: no candidates
+        mids = (vals[:-1] + vals[1:]) / 2.0
+        if possible <= num_splits:
+            out[j, :possible] = mids
+            continue
+        stride = n / (num_splits + 1)
+        chosen: list[float] = []
+        current = int(counts[0])
+        target = stride
+        for idx in range(1, len(vals)):
+            prev = current
+            current += int(counts[idx])
+            if abs(prev - target) < abs(current - target):
+                chosen.append(mids[idx - 1])
+                target += stride
+        out[j, : len(chosen)] = chosen[:num_splits]
+    return out.astype(np.float32)
+
+
+def split_thresholds(
+    features: np.ndarray, max_bins: int, method: str
+) -> jax.Array:
+    """Resolve a split-candidate method name to a (d, B-1) threshold array."""
+    if method == "mllib":
+        return jnp.asarray(mllib_split_candidates(features, max_bins))
+    if method == "quantile":
+        return quantile_thresholds(
+            jnp.asarray(features, jnp.float32), max_bins
+        )
+    raise ValueError(f"unknown split_candidates method {method!r}")
 
 
 def binize(x: jax.Array, thresholds: jax.Array) -> jax.Array:
@@ -287,6 +348,9 @@ class DecisionTreeClassifier:
     max_bins: int = 32
     min_instances_per_node: int = 1
     num_classes: int | None = None
+    # mllib: exact MLlib split-candidate set (parity default);
+    # quantile: evenly spaced on-device quantiles
+    split_candidates: str = "mllib"
     # None = auto: the fused Pallas histogram on TPU (no HBM one-hot
     # indicator), the XLA one-hot matmul elsewhere (the kernel would run
     # in slow interpret mode off-TPU)
@@ -306,7 +370,9 @@ class DecisionTreeClassifier:
             if sample_weight is None
             else jnp.asarray(sample_weight, jnp.float32)
         )
-        thresholds = quantile_thresholds(x, self.max_bins)
+        thresholds = split_thresholds(
+            data.features, self.max_bins, self.split_candidates
+        )
         bins = binize(x, thresholds)
         feature, threshold, leaf_class, leaf_probs = _grow_tree(
             bins,

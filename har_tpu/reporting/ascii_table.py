@@ -10,13 +10,15 @@ from __future__ import annotations
 
 from typing import Iterable, Sequence
 
+import numpy as np
+
 
 def _fmt(v) -> str:
-    if isinstance(v, float):
-        # Spark prints doubles with full precision but trims trailing zeros;
-        # the reference data shows values like 8.4, 11.96, 0.598788
-        s = f"{v:.10g}"
-        return s
+    if isinstance(v, (float, np.floating)):
+        # Java Double.toString keeps a trailing .0 on whole doubles
+        # ("0.0", "2.0" in result.txt:121-125); Python's float repr does
+        # the same shortest-round-trip formatting
+        return repr(float(v))
     return str(v)
 
 

@@ -116,6 +116,24 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
     if name in _CLASSICAL:
         cls = _CLASSICAL[name]
         fields = {f.name for f in dataclasses.fields(cls)}
+        if params.get("class_weight") is not None and (
+            "class_weight" not in fields
+        ):
+            # shared-knob leniency must not silently train an UNWEIGHTED
+            # model when the user asked for weighting (only LR and the
+            # neural trainers support it).  Warn rather than raise: one
+            # params dict serves every model in a mixed --models run, so
+            # aborting here would make `--models mlp dt --class-weight
+            # balanced` unreachable.
+            import warnings
+
+            warnings.warn(
+                f"class_weight is ignored by {name} (supported by "
+                "logistic_regression and the neural families); "
+                f"{name} trains unweighted",
+                UserWarning,
+                stacklevel=2,
+            )
         return cls(**{k: v for k, v in params.items() if k in fields})
     if name in _NEURAL:
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
@@ -222,6 +240,52 @@ def _feature_mode(config: RunConfig) -> str:
     )
 
 
+def resolve_split_method(data) -> str:
+    """Which split implementation a DataConfig gets.
+
+    "auto" replays the reference's randomSplit bit-for-bit on the tabular
+    WISDM dataset (har_tpu.data.spark_split; 3,793/1,625 for seed 2018) and
+    falls back to the plain Bernoulli draw for datasets whose rows don't
+    carry the WISDM sort columns.
+    """
+    method = getattr(data, "split_method", "auto")
+    if method == "auto":
+        return "spark" if data.dataset == "wisdm" else "bernoulli"
+    if method not in ("spark", "bernoulli"):
+        raise ValueError(f"unknown split_method {method!r}")
+    if method == "spark" and data.dataset != "wisdm":
+        raise ValueError(
+            "split_method='spark' replays the reference's WISDM randomSplit "
+            f"and needs the WISDM sort columns; dataset {data.dataset!r} "
+            "doesn't carry them"
+        )
+    return method
+
+
+def derive_split(
+    full: FeatureSet, table, data
+) -> tuple[FeatureSet, FeatureSet]:
+    """THE train/test derivation for tabular WISDM views.
+
+    Every path that scores a model (run, sweep, checkpoint evaluate and
+    predict) must go through here or FeatureSet.train_test, or risk
+    scoring on different rows than training held out.
+    """
+    if resolve_split_method(data) == "spark":
+        from har_tpu.data.spark_split import spark_split_indices
+
+        train_idx, test_idx = spark_split_indices(
+            table,
+            [data.train_fraction, 1.0 - data.train_fraction],
+            data.seed,
+        )
+        return (
+            dataclasses.replace(full.take(train_idx), rows=train_idx),
+            dataclasses.replace(full.take(test_idx), rows=test_idx),
+        )
+    return full.train_test(data.train_fraction, data.seed)
+
+
 def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
     """Fit the one-hot pipeline (reference parity) or the numeric view.
 
@@ -288,9 +352,7 @@ def featurize(config: RunConfig, table) -> tuple[FeatureSet, FeatureSet, Any]:
         full = make_feature_set(
             pipe_model.transform(table), class_names=label_vocab
         )
-    train, test = full.train_test(
-        config.data.train_fraction, config.data.seed
-    )
+    train, test = derive_split(full, table, config.data)
     return train, test, pipe_model
 
 
@@ -334,6 +396,47 @@ class RunOutcome:
         }
 
 
+# (estimator class, pretty name) per classical family, for the report's
+# Spark-style model lines (result.txt:141,186,231,276)
+_SPARK_NAMES = {
+    "logistic_regression": ("LogisticRegression", "Logistic Regression"),
+    "decision_tree": ("DecisionTreeClassifier", "Decision Tree"),
+    "random_forest": ("RandomForestClassifier", "Random Forest"),
+    "gbdt": ("GBTClassifier", "Gradient Boosted Trees"),
+}
+
+
+def _spark_display_name(name: str, model, is_cv: bool) -> str | None:
+    """The model line Spark prints atop each block: estimator uid for LR,
+    fitted-model reprs for trees (result.txt:141,231,276), and
+    "CrossValidatorModel_<uid> for <family>" for CV (result.txt:186).
+    Spark's uid suffix is 20 random hex chars; ours is a deterministic
+    hash of the job name.  Neural families keep their own names."""
+    import hashlib
+
+    base = name[: -len("_cv")] if name.endswith("_cv") else name
+    entry = _SPARK_NAMES.get(base)
+    if entry is None:
+        return None
+    est_cls, pretty = entry
+    uid = hashlib.sha1(name.encode()).hexdigest()[:20]
+    if is_cv:
+        return f"CrossValidatorModel_{uid} for {pretty}"
+    if base == "decision_tree":
+        return (
+            f"DecisionTreeClassificationModel (uid={est_cls}_{uid}) of "
+            f"depth {model.tree.max_depth} with {model.num_nodes} nodes"
+        )
+    if base == "random_forest":
+        return (
+            f"RandomForestClassificationModel (uid={est_cls}_{uid}) "
+            f"with {model.num_trees} trees"
+        )
+    if base == "gbdt":
+        return f"GBTClassificationModel (uid={est_cls}_{uid})"
+    return f"{est_cls}_{uid}"
+
+
 def _fit_eval(est, name, train, test, report, is_cv=False, timer=None):
     from har_tpu.utils.profiling import StepTimer
 
@@ -351,6 +454,7 @@ def _fit_eval(est, name, train, test, report, is_cv=False, timer=None):
         train_time_s=train_time,
         test_time_s=test_time,
         is_cv=is_cv,
+        display_name=_spark_display_name(name, model, is_cv),
     )
     report.model_block(
         result, sample_text=report.prediction_sample(test, preds)
@@ -514,6 +618,7 @@ def _save_fitted(
         # evaluate_checkpoint's provenance guard fires even for runs that
         # never set synthetic_rows explicitly
         synthetic_rows = effective_synthetic_rows(config.data)
+    split_method = resolve_split_method(config.data)
     if isinstance(model, NeuralClassifierModel):
         return save_model(
             path,
@@ -522,6 +627,8 @@ def _save_fitted(
             dict(est.model_kwargs),
             dataset=config.data.dataset,
             synthetic_rows=synthetic_rows,
+            drop_binned=config.data.drop_binned,
+            split_method=split_method,
         )
     return save_classical_model(
         path,
@@ -529,6 +636,7 @@ def _save_fitted(
         dataset=config.data.dataset,
         synthetic_rows=synthetic_rows,
         drop_binned=config.data.drop_binned,
+        split_method=split_method,
         pipeline=pipe_model,
     )
 
@@ -584,7 +692,33 @@ def run(
     report.class_names = (
         list(first_train.class_names) if first_train.class_names else None
     )
+    # MODELING PIPELINE + sample/table blocks (result.txt:59-138) — the
+    # one-hot view's transformed frame; with the spark-exact split the
+    # shown train/test rows equal the reference's.  The split sets carry
+    # their original-row provenance, so the full design matrix is
+    # reassembled from them (no second pipeline transform).
+    oh_feats = oh_labels = None
+    if not is_raw and "onehot" in view_cache:
+        oh_train, oh_test, oh_pipe = view_cache["onehot"]
+        if (
+            oh_pipe is not None
+            and oh_train.rows is not None
+            and oh_test.rows is not None
+        ):
+            report.pipeline_schema(table)
+            n_rows = len(table)
+            d = oh_train.num_features
+            oh_feats = np.empty((n_rows, d), np.float32)
+            oh_labels = np.empty((n_rows,), np.float64)
+            for part in (oh_train, oh_test):
+                oh_feats[part.rows] = part.features
+                oh_labels[part.rows] = part.label
+            report.sample_feature_data(table, oh_labels, oh_feats)
     report.split_counts(len(first_train), len(first_test))
+    if oh_feats is not None:
+        report.split_sample_tables(
+            table, oh_feats, oh_labels, oh_train.rows, oh_test.rows
+        )
 
     mesh = _mesh_from_config(config)
     results = []

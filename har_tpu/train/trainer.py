@@ -62,6 +62,11 @@ class TrainerConfig:
     # None → every row weighs 1; "balanced" reweighs the loss by
     # n / (num_classes * count(class)) so minority classes pull equally
     class_weight: str | None = None
+    # record the compiled training program's XLA flop count in
+    # history["program_flops"] (simple scan path only) — the bench derives
+    # achieved FLOP/s and MFU from it.  Off by default: the explicit
+    # lower/compile adds a retrace to every fit
+    compute_flops: bool = False
 
 
 def _run_fingerprint(
@@ -592,7 +597,7 @@ class Trainer:
                 history["stopped_epoch"] = epoch
                 epochs_run = epoch
             else:
-                params, opt_state, losses = fit(
+                args = (
                     params,
                     opt_state,
                     step_root,
@@ -601,6 +606,26 @@ class Trainer:
                     jnp.asarray(batch_idx),
                     jnp.asarray(0, jnp.int32),
                 )
+                if cfg.compute_flops:
+                    compiled = fit.lower(*args).compile()
+                    try:
+                        ca = compiled.cost_analysis()
+                    except Exception:  # some PJRT plugins: UNIMPLEMENTED
+                        ca = None
+                    if isinstance(ca, (list, tuple)):  # older jax returns
+                        ca = ca[0] if ca else None  # one dict per device
+                    # XLA's cost analysis counts a while-loop (scan) body
+                    # ONCE regardless of trip count (verified: flops are
+                    # identical for length 1/10/100 scans), so scale by
+                    # the step count; the once-counted non-loop prologue
+                    # is negligible against any real training run.
+                    # mfu_fields treats 0.0 as "unavailable".
+                    history["program_flops"] = float(
+                        (ca or {}).get("flops", 0.0)
+                    ) * int(args[5].shape[0])
+                    params, opt_state, losses = compiled(*args)
+                else:
+                    params, opt_state, losses = fit(*args)
                 losses = np.asarray(losses)  # blocks until the run ends
                 history["loss"] = list(
                     losses.reshape(cfg.epochs, steps_per_epoch)[:, -1]

@@ -72,6 +72,7 @@ def test_build_augment_registry():
         build_augment("mixup")
 
 
+@pytest.mark.slow
 def test_training_with_augment_runs():
     """End-to-end: NeuralClassifier with augment='raw_windows' trains a
     CNN on synthetic raw windows and still fits the clean data."""

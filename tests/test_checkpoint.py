@@ -63,6 +63,7 @@ def test_train_checkpointer_resume(tmp_path):
         ck.close()
 
 
+@pytest.mark.slow
 def test_evaluate_checkpoint_raw_model(tmp_path):
     """Save a raw-window model, re-score it via the evaluate backend."""
     from har_tpu.checkpoint import evaluate_checkpoint, save_model
@@ -86,6 +87,7 @@ def test_evaluate_checkpoint_raw_model(tmp_path):
     assert rep["n_test"] > 0
 
 
+@pytest.mark.slow
 def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
     from har_tpu.checkpoint import evaluate_checkpoint, save_model
     from har_tpu.config import DataConfig, ModelConfig, RunConfig

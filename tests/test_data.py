@@ -46,6 +46,30 @@ class TestCsv(object):
         np.testing.assert_allclose(t["b"], [2.5, 3.5])
 
 
+class TestNativeFallback:
+    def test_broken_native_loader_warns_and_falls_back(
+        self, tmp_path, monkeypatch
+    ):
+        """engine='auto' must not swallow a native-parser failure
+        silently: it warns and the Python path still serves the read."""
+        import har_tpu.data.native_loader as nl
+
+        monkeypatch.setattr(nl, "native_available", lambda: True)
+
+        def broken(path):
+            raise RuntimeError("deliberately broken .so")
+
+        monkeypatch.setattr(nl, "read_csv_native", broken)
+        p = tmp_path / "t.csv"
+        p.write_text("a,b\n1,x\n2,y\n")
+        with pytest.warns(RuntimeWarning, match="deliberately broken"):
+            t = read_csv(str(p), engine="auto")
+        assert t.num_rows == 2
+        # engine='native' keeps raising
+        with pytest.raises(RuntimeError, match="deliberately broken"):
+            read_csv(str(p), engine="native")
+
+
 class TestSplit:
     def test_deterministic_and_exhaustive(self):
         a = split_indices(10000, [0.7, 0.3], seed=2018)

@@ -19,6 +19,7 @@ def _data():
     return featurize(cfg, load_dataset(cfg))[:2]
 
 
+@pytest.mark.slow
 def test_seed_ensemble_votes_and_is_deterministic():
     train, test = _data()
     est = seed_ensemble(

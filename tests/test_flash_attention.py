@@ -92,6 +92,7 @@ def test_chunked_backward_matches_reference():
         )
 
 
+@pytest.mark.slow
 def test_auto_flash_requires_tpu():
     """use_flash=None must not pick the (interpret-mode) kernel off-TPU."""
     import flax.linen as nn
@@ -135,6 +136,7 @@ def test_non_dividing_block_raises():
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
+@pytest.mark.slow
 def test_transformer_flash_matches_xla_path():
     from har_tpu.models.transformer import Transformer1D
 

@@ -26,6 +26,7 @@ def test_gbdt_fits_separable_blobs():
     assert acc > 0.95
 
 
+@pytest.mark.slow
 def test_gbdt_probabilities_normalized():
     data = _blobs(n=100)
     model = GradientBoostedTreesClassifier(

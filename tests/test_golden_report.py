@@ -1,0 +1,170 @@
+"""Golden structural diff against the reference's captured result.txt.
+
+The reference's only "test" is its committed run artifact (SURVEY §4.1:
+Main/wisdm_main_ver_0.0/main_result/result.txt).  These tests pin our
+report contract against it:
+
+- the whole pre-model prefix (lines 1-139: schema, sample, class counts,
+  describe summary, MODELING PIPELINE block, split counts, train/test/
+  test_data sample tables) is required to be BYTE-IDENTICAL — the exact
+  split, spark-hash vocabularies, Catalyst-order describe statistics and
+  show() rendering all feed into it;
+- each model block's line *shape* (labels, separators, blank structure)
+  matches the reference block, with the DT block's deterministic metric
+  lines byte-equal.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+REFERENCE_RESULT = (
+    "/root/reference/Main/wisdm_main_ver_0.0/main_result/result.txt"
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(REFERENCE_RESULT),
+    reason="reference result.txt not mounted",
+)
+
+
+def _reference_lines():
+    with open(REFERENCE_RESULT) as f:
+        return f.read().splitlines()
+
+
+@pytest.fixture(scope="module")
+def prefix_report(wisdm_csv_path):
+    """Build the pre-model report exactly as run() does."""
+    from har_tpu.config import DataConfig, RunConfig
+    from har_tpu.data.wisdm import load_wisdm
+    from har_tpu.features.wisdm_pipeline import build_wisdm_pipeline
+    from har_tpu.reporting import ReportWriter
+    from har_tpu.runner import derive_split, featurize
+
+    config = RunConfig(data=DataConfig(dataset="wisdm"))
+    table = load_wisdm(wisdm_csv_path)
+    train, test, pipe = featurize(config, table)
+    report = ReportWriter("unused")
+    report.line("Loading Data Set...")
+    report.schema(table)
+    report.sample(table)
+    report.class_counts(table["ACTIVITY"])
+    report.summary(table)
+    report.pipeline_schema(table)
+    cols = pipe.transform(table)
+    feats = np.asarray(cols["features"], np.float32)
+    labels = np.asarray(cols["label"], np.float64)
+    report.sample_feature_data(table, labels, feats)
+    report.split_counts(len(train), len(test))
+    report.split_sample_tables(
+        table, feats, labels, train.rows, test.rows
+    )
+    return report.text().splitlines()
+
+
+def test_prefix_byte_identical(prefix_report):
+    """Lines 1-139 of result.txt, byte for byte."""
+    ref = _reference_lines()[:139]
+    ours = prefix_report[:139]
+    for i, (a, b) in enumerate(zip(ours, ref), start=1):
+        assert a == b, f"line {i} differs:\n ours: {a!r}\n  ref: {b!r}"
+    assert len(ours) >= 139
+
+
+def _block_shape(lines):
+    """Normalize a model block to its structural shape: numbers masked,
+    table rows collapsed to their column signature."""
+    out = []
+    for line in lines:
+        if re.fullmatch(r"\+[-+]+\+", line):
+            out.append("<sep>")
+        elif line.startswith("|"):
+            out.append(f"<row:{line.count('|')}>")
+        else:
+            line = re.sub(r"_[0-9a-f]{20}\b", "_<uid>", line)
+            out.append(re.sub(r"-?\d+(\.\d+)?([eE]-?\d+)?", "<n>", line))
+    return out
+
+
+def _find_block(lines, start_marker):
+    """Lines of one model block: from its name line to the *** separator."""
+    for i, line in enumerate(lines):
+        if line.startswith(start_marker):
+            for j in range(i, len(lines)):
+                if set(lines[j]) == {"*"}:
+                    return lines[i : j + 1]
+    raise AssertionError(f"no block starting {start_marker!r}")
+
+
+@pytest.mark.slow
+def test_dt_block_structure_and_metrics(wisdm_csv_path, tmp_path):
+    """A DT-only run's block has the reference DT block's exact shape,
+    and — the induction being deterministic on the exact split — its
+    metric lines are byte-equal (result.txt:231-273)."""
+    from har_tpu.config import DataConfig, ModelConfig, RunConfig
+    from har_tpu.runner import run
+
+    config = RunConfig(
+        data=DataConfig(dataset="wisdm", path=wisdm_csv_path),
+        model=ModelConfig(name="decision_tree"),
+        output_dir=str(tmp_path),
+    )
+    run(config, models=["decision_tree"], with_cv=False)
+    ours = open(tmp_path / "result.txt").read().splitlines()
+    ref = _reference_lines()
+
+    ours_block = _find_block(ours, "DecisionTreeClassificationModel")
+    ref_block = _find_block(ref, "DecisionTreeClassificationModel")
+    # identical structure (our block additionally carries the per-class
+    # extras AFTER the reference's *** terminator, so the slices align)
+    assert _block_shape(ours_block) == _block_shape(ref_block)
+
+    # deterministic metric lines, byte-equal (the known reference MSE
+    # bug — it prints rmse under the MSE label — is intentionally NOT
+    # replicated, so that line is excluded)
+    for text in [
+        "MultiClass F1 -------------------------------: 0.679556",
+        "MultiClass Weighted Precision ---------------: 0.644884",
+        "MultiClass Weighted Recall ------------------: 0.730462",
+        "MultiClass Accuracy -------------------------: 0.730462",
+        "Root Mean Squared Error (RMSE) on test data -: 0.977595",
+        "R^2 metric on test data ---------------------: 0.536009",
+        "Mean Absolute Error on test data ------------: 0.464615",
+        "Total Count          = 1625",
+        "Total Correct        = 1187",
+        "Total Wrong          = 438",
+        "Wrong Ratio          = 0.269538",
+        "Right Ratio          = 0.730462",
+        "of depth 3 with 15 nodes",
+    ]:
+        assert any(text in line for line in ours_block), text
+        assert any(text in line for line in ref_block), text
+
+
+def test_section_sequence(prefix_report):
+    """Banner/section order equals the reference's (SURVEY §1 layers)."""
+    def sections(lines):
+        out = []
+        for line in lines:
+            m = re.match(r"^=+([A-Z ]+)=+$", line)
+            if m:
+                out.append(m.group(1))
+            elif re.match(r"^[A-Za-z ]+-{20,}$", line):
+                out.append(line.rstrip("-"))
+        return out
+
+    ref_sections = sections(_reference_lines()[:139])
+    assert sections(prefix_report[:139]) == ref_sections
+    assert ref_sections == [
+        "Data Schema",
+        "Sample Data",
+        "Activity Count",
+        "Summary",
+        "MODELING PIPELINE",
+        "Model Pipeline Schema",
+        "Sample Feature Data",
+        "TRAINING AND TESTING",
+    ]

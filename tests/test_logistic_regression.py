@@ -56,6 +56,7 @@ class TestWisdmParity:
     """Beat-or-match the reference LR numbers (BASELINE.md: accuracy 0.6148,
     F1 0.5630 with maxIter=20, regParam=0.3)."""
 
+    @pytest.mark.slow
     def test_reference_hyperparams_match_accuracy(self, wisdm_csv_path):
         table = load_wisdm(wisdm_csv_path)
         train, test = _feature_sets(table)
@@ -67,6 +68,7 @@ class TestWisdmParity:
         assert rep["accuracy"] >= 0.60
         assert rep["f1"] >= 0.54
 
+    @pytest.mark.slow
     def test_beats_reference_accuracy_and_f1(self, wisdm_csv_path):
         # moderate L2 beats the reference on both headline metrics
         # (unregularized overfits the 3,100 one-hot dims)

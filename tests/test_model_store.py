@@ -45,6 +45,7 @@ def _view(model_name: str):
         ("gbdt", {"num_rounds": 10, "max_depth": 3}),
     ],
 )
+@pytest.mark.slow
 def test_classical_roundtrip_exact_predictions(tmp_path, name, params):
     train, test, _ = _view(name)
     model = build_estimator(name, params).fit(train)
@@ -162,6 +163,7 @@ def test_predict_checkpoint_writes_csv(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_run_save_models_dir(tmp_path):
     """run(save_models_dir=...) persists plain + CV-best of every family."""
     from har_tpu.runner import run

@@ -1,0 +1,122 @@
+"""Multi-host bootstrap and guard paths (SURVEY §5.8; VERDICT r1 weak #5).
+
+The reference delegates cluster formation to the Spark master URL
+(reference Main/main.py:8); here it's `jax.distributed.initialize` via
+har_tpu.parallel.mesh.initialize_distributed + a mesh over the global
+device set.  Real pods aren't available in CI, so these tests drive the
+same code paths with (a) a mocked process_count for the runner guards and
+(b) two real local processes forming a loopback CPU "pod".
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+
+class TestMultiprocessGuards:
+    def test_partial_mesh_rejected_multihost(self, monkeypatch):
+        """runner._mesh_from_config must refuse a mesh that covers only a
+        subset of global devices when more than one process is attached
+        (the excluded process's dispatches would have nothing to run)."""
+        from har_tpu.config import DataConfig, MeshConfig, RunConfig
+        from har_tpu.runner import _mesh_from_config
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        n = len(jax.devices())
+        assert n >= 2  # conftest forces the 8-device CPU mesh
+        config = RunConfig(
+            data=DataConfig(dataset="synthetic"),
+            mesh=MeshConfig(dp=n // 2, tp=1),
+        )
+        with pytest.raises(ValueError, match="multi-host"):
+            _mesh_from_config(config)
+
+    def test_full_mesh_allowed_multihost(self, monkeypatch):
+        from har_tpu.config import DataConfig, MeshConfig, RunConfig
+        from har_tpu.runner import _mesh_from_config
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        config = RunConfig(
+            data=DataConfig(dataset="synthetic"), mesh=MeshConfig(dp=-1)
+        )
+        mesh = _mesh_from_config(config)
+        assert mesh.shape["dp"] == len(jax.devices())
+
+    def test_cli_distributed_flag_validation(self):
+        from har_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="--distributed"):
+            main(
+                [
+                    "train", "--dataset", "synthetic", "--models", "dt",
+                    "--coordinator", "localhost:1234",
+                ]
+            )
+
+
+_WORKER = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+coordinator, rank = sys.argv[1], int(sys.argv[2])
+from har_tpu.parallel.mesh import initialize_distributed
+
+initialize_distributed(
+    coordinator_address=coordinator, num_processes=2, process_id=rank
+)
+assert jax.process_count() == 2, jax.process_count()
+local = len(jax.local_devices())
+total = len(jax.devices())
+assert total == 2 * local, (total, local)
+
+from har_tpu.parallel.mesh import create_mesh
+
+mesh = create_mesh(dp=-1)  # spans BOTH processes' devices
+assert mesh.shape["dp"] == total
+assert mesh.devices.size == total
+print(f"OK rank={rank} local={local} total={total}")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_loopback_pod(tmp_path):
+    """Two real processes form a CPU 'pod' through a loopback coordinator
+    and each builds a mesh spanning the global device set — the exact
+    bootstrap a multi-host TPU run performs (`har train --distributed`)."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coordinator = f"localhost:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(rank)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=repo_root,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"OK rank={rank} local=2 total=4" in out, out

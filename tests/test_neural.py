@@ -64,6 +64,7 @@ def test_unknown_model_name():
         build_model("transformer9000", num_classes=6)
 
 
+@pytest.mark.slow
 def test_cnn_trains_on_raw_windows(raw_data):
     train, test = raw_data.split([0.8, 0.2], seed=0)
     cfg = TrainerConfig(batch_size=128, epochs=15, learning_rate=3e-3, seed=0)
@@ -92,6 +93,7 @@ def test_mlp_trains_on_features(raw_data):
     assert acc > 0.8, f"MLP acc={acc}"
 
 
+@pytest.mark.slow
 def test_bilstm_forward_and_one_step(raw_data):
     # full BiLSTM training is slow on CPU; one step must run + reduce loss
     cfg = TrainerConfig(batch_size=64, epochs=1, learning_rate=1e-3)

@@ -1,6 +1,7 @@
 """Fused Pallas histogram kernel vs the XLA one-hot matmul, and its wiring
 into the tree builder. Interpret mode on the CPU test mesh; compiled on TPU."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +43,7 @@ def test_padding_rows_and_features():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_tree_pallas_hist_matches_xla_path():
     """_grow_tree with the fused kernel builds the identical tree."""
     from har_tpu.features.wisdm_pipeline import FeatureSet

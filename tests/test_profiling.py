@@ -1,5 +1,6 @@
 """Profiling utilities: step timing, timing.csv artifact, trace context."""
 
+import pytest
 import csv
 import os
 import time
@@ -38,6 +39,7 @@ def test_trace_disabled_is_noop():
     assert x == 2
 
 
+@pytest.mark.slow
 def test_trace_writes_profile(tmp_path):
     import jax
     import jax.numpy as jnp
@@ -52,6 +54,7 @@ def test_trace_writes_profile(tmp_path):
     assert found, "profiler produced no trace files"
 
 
+@pytest.mark.slow
 def test_runner_writes_timing_csv(tmp_path):
     from har_tpu.config import DataConfig, ModelConfig, RunConfig
     from har_tpu.runner import run

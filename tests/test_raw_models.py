@@ -31,6 +31,7 @@ def test_generator_split_decorrelated():
     np.testing.assert_allclose(tr, te, atol=0.12)
 
 
+@pytest.mark.slow
 def test_cnn1d_trains_on_raw_windows(tmp_path):
     out = run(
         _cfg("cnn1d", {"epochs": 2, "batch_size": 64}, tmp=str(tmp_path)),
@@ -75,6 +76,7 @@ def test_raw_path_uses_real_stream_format(tmp_path):
     assert set(np.unique(ds.labels)) <= {0, 1, 4}
 
 
+@pytest.mark.slow
 def test_mixed_raw_and_tabular_models_each_get_their_view(tmp_path):
     """cnn1d + lr in one run: windows for the CNN, 43 features for LR."""
     out = run(

@@ -152,6 +152,59 @@ def test_prediction_sample_block():
     assert "only showing" not in small
 
 
+def test_prediction_sample_lexicographic_order():
+    """Spark's orderBy(probability, desc) compares probability VECTORS
+    lexicographically — class-0 probability first (result.txt:147-151),
+    not the per-row max."""
+    import numpy as np
+
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.base import Predictions
+
+    # all rows predicted class 2; class-0 prob ordering differs from
+    # max-prob ordering
+    probs = np.array(
+        [
+            [0.30, 0.20, 0.50],  # uid 0: p0 .30, max .50
+            [0.40, 0.15, 0.45],  # uid 1: p0 .40, max .45
+            [0.10, 0.10, 0.80],  # uid 2: p0 .10, max .80 (max-first)
+        ],
+        np.float32,
+    )
+    preds = Predictions.from_raw(np.log(probs), probs)
+    test = FeatureSet(
+        features=np.zeros((3, 2), np.float32),
+        label=np.zeros(3, np.int32),
+        uid=np.arange(3),
+    )
+    text = ReportWriter("unused").prediction_sample(test, preds, n=3)
+    body = [
+        line.split("|")[1].strip()
+        for line in text.splitlines()
+        if line.startswith("|") and "UID" not in line
+        and not set(line) <= {"|", "-", "+"}
+    ]
+    assert body == ["1", "0", "2"]  # class-0 prob desc, NOT max desc
+
+
+def test_class_weight_warns_for_tree_families():
+    """Tree families don't support class weighting; a mixed --models run
+    shares one params dict, so the drop warns (visibly) instead of
+    aborting the whole run."""
+    import warnings
+
+    from har_tpu.runner import build_estimator
+
+    for name in ("random_forest", "decision_tree"):
+        with pytest.warns(UserWarning, match="class_weight is ignored"):
+            build_estimator(name, {"class_weight": "balanced"})
+    # supported families accept it silently
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        build_estimator("logistic_regression", {"class_weight": "balanced"})
+        build_estimator("mlp", {"class_weight": "balanced"})
+
+
 def test_cli_train_synthetic(tmp_path, capsys):
     from har_tpu.cli import main
 
@@ -170,6 +223,7 @@ def test_cli_train_synthetic(tmp_path, capsys):
     assert os.path.exists(os.path.join(str(tmp_path), "result.txt"))
 
 
+@pytest.mark.slow
 def test_eda_plots(tmp_path):
     pytest.importorskip("matplotlib")
     from har_tpu.data.wisdm import WISDM_NUMERIC_COLUMNS

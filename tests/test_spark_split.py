@@ -1,0 +1,151 @@
+"""Spark-exact randomSplit replica (har_tpu.data.spark_random/spark_split).
+
+Golden oracle: the reference's captured run (result.txt:105-131) — split
+counts 3,793/1,625, the five train and five test sample UIDs shown by
+``show(5)``, and the prediction-sample UIDs, all produced by
+``df.randomSplit([0.7, 0.3], seed=2018)`` (reference Main/main.py:80).
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.data.spark_random import (
+    XORShiftRandom,
+    bernoulli_draws,
+    java_string_hash,
+    murmur3_bytes,
+    scala_hashmap_key,
+    xorshift_hash_seed,
+)
+from har_tpu.data.spark_split import (
+    mllib_vocab,
+    spark_sort_order,
+    spark_split_indices,
+)
+from har_tpu.data.wisdm import load_wisdm
+
+
+class TestJvmPrimitives:
+    def test_java_string_hash(self):
+        # java.lang.String.hashCode reference values
+        assert java_string_hash("") == 0
+        assert java_string_hash("a") == 97
+        assert java_string_hash("hello") == 99162322
+        assert java_string_hash("polygenelubricants") == -2147483648
+
+    def test_murmur3_empty(self):
+        # finalization-only path: avalanche(seed ^ 0)
+        assert murmur3_bytes(b"", 0) == 0
+
+    def test_hash_seed_is_64_byte_buffer(self):
+        # the Long.SIZE quirk: hashing 8 seed bytes alone gives a
+        # different value than the 64-byte buffer Spark actually hashes
+        buf8 = (2018).to_bytes(8, "big")
+        low8 = murmur3_bytes(buf8, 0x3C074A61)
+        assert (xorshift_hash_seed(2018) & 0xFFFFFFFF) != low8
+
+    def test_draw_stream_deterministic(self):
+        a = bernoulli_draws(100, 2018)
+        b = bernoulli_draws(100, 2018)
+        np.testing.assert_array_equal(a, b)
+        assert np.all((a >= 0) & (a < 1))
+        # partition index shifts the seed
+        c = bernoulli_draws(100, 2018, partition_index=1)
+        assert not np.array_equal(a, c)
+
+    def test_nextdouble_matches_java_construction(self):
+        rng1 = XORShiftRandom(7)
+        rng2 = XORShiftRandom(7)
+        hi = rng2.next(26)
+        lo = rng2.next(27)
+        assert rng1.next_double() == ((hi << 27) + lo) * (2.0 ** -53)
+
+
+class TestMllibVocab:
+    def test_frequency_desc(self):
+        v = mllib_vocab(["b", "b", "a", "c", "c", "c"])
+        assert v["c"] == 0 and v["b"] == 1 and v["a"] == 2
+
+    def test_tie_break_is_trie_order_not_lexicographic(self):
+        # equal counts keep scala HashMap trie iteration order
+        values = ["0.1", "0.2", "0.3", "0.4"]
+        v = mllib_vocab(values)
+        order = sorted(values, key=scala_hashmap_key)
+        assert [k for k, _ in sorted(v.items(), key=lambda kv: kv[1])] == order
+        assert order != sorted(values)  # the distinction is observable
+
+
+class TestGoldenSplit:
+    """Row-exact parity with the captured reference run."""
+
+    @pytest.fixture(scope="class")
+    def wisdm(self, wisdm_csv_path):
+        return load_wisdm(wisdm_csv_path)
+
+    @pytest.fixture(scope="class")
+    def split(self, wisdm):
+        return spark_split_indices(wisdm, [0.7, 0.3], seed=2018)
+
+    def test_counts_exact(self, split):
+        train, test = split
+        assert len(train) == 3793  # result.txt:105
+        assert len(test) == 1625  # result.txt:106
+        assert set(train).isdisjoint(test)
+        assert len(train) + len(test) == 5418
+
+    def test_train_sample_uids(self, wisdm, split):
+        # train.show(5) in result.txt:110-114
+        uids = wisdm["UID"][split[0][:5]]
+        np.testing.assert_array_equal(uids, [669, 357, 328, 156, 147])
+
+    def test_test_sample_uids(self, wisdm, split):
+        # test.show(5) in result.txt:121-125
+        uids = wisdm["UID"][split[1][:5]]
+        np.testing.assert_array_equal(uids, [482, 135, 142, 728, 481])
+
+    def test_prediction_sample_rows_in_test(self, wisdm, split):
+        # LR prediction sample (result.txt:147-151): (UID, label) pairs
+        # that must be test members
+        labels = {
+            "Walking": 0, "Jogging": 1, "Upstairs": 2,
+            "Downstairs": 3, "Sitting": 4, "Standing": 5,
+        }
+        test_pairs = {
+            (int(u), labels[str(a)])
+            for u, a in zip(
+                wisdm["UID"][split[1]], wisdm["ACTIVITY"][split[1]]
+            )
+        }
+        for pair in [(464, 5), (324, 5), (437, 4), (346, 5), (187, 5)]:
+            assert pair in test_pairs
+
+    def test_sort_order_is_permutation(self, wisdm):
+        order = spark_sort_order(wisdm)
+        assert sorted(order.tolist()) == list(range(5418))
+
+
+class TestRunnerIntegration:
+    def test_derive_split_spark(self, wisdm_csv_path):
+        from har_tpu.config import DataConfig
+        from har_tpu.runner import derive_split, resolve_split_method
+        from har_tpu.features.wisdm_pipeline import FeatureSet
+
+        data = DataConfig(dataset="wisdm", path=wisdm_csv_path)
+        assert resolve_split_method(data) == "spark"
+        table = load_wisdm(wisdm_csv_path)
+        full = FeatureSet(
+            features=np.zeros((len(table), 1), np.float32),
+            label=np.zeros(len(table), np.int32),
+            uid=table["UID"],
+        )
+        train, test = derive_split(full, table, data)
+        assert len(train) == 3793 and len(test) == 1625
+
+    def test_spark_method_rejected_off_wisdm(self):
+        from har_tpu.config import DataConfig
+        from har_tpu.runner import resolve_split_method
+
+        with pytest.raises(ValueError, match="spark"):
+            resolve_split_method(
+                DataConfig(dataset="ucihar", split_method="spark")
+            )

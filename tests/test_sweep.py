@@ -3,10 +3,13 @@
 import csv
 import os
 
+import pytest
+
 from har_tpu.config import DataConfig, ModelConfig, RunConfig
 from har_tpu.runner import sweep
 
 
+@pytest.mark.slow
 def test_sweep_rows_and_artifacts(tmp_path):
     config = RunConfig(
         data=DataConfig(dataset="synthetic", seed=7),
@@ -34,6 +37,7 @@ def test_sweep_rows_and_artifacts(tmp_path):
     assert txt.startswith("+") and "70-30" in txt
 
 
+@pytest.mark.slow
 def test_sweep_cv_rows_only_for_gridded_models(tmp_path):
     config = RunConfig(
         data=DataConfig(dataset="synthetic", seed=7),
@@ -56,6 +60,7 @@ def test_sweep_cv_rows_only_for_gridded_models(tmp_path):
     ]
 
 
+@pytest.mark.slow
 def test_sweep_aliases_and_per_model_views(tmp_path, monkeypatch):
     """'gbt' alias resolves, and each model gets its own feature view."""
     import har_tpu.runner as runner_mod

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from har_tpu.data.raw_windows import synthetic_raw_stream
@@ -29,6 +30,7 @@ def test_forward_shapes():
     assert out.shape == (3, 6)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_matches_single_device():
     x = jnp.asarray(
         np.random.default_rng(1).normal(size=(2, 64, 3)), jnp.float32
@@ -54,6 +56,7 @@ def test_sequence_parallel_matches_single_device():
     )
 
 
+@pytest.mark.slow
 def test_transformer_trains():
     raw = synthetic_raw_stream(n_windows=400, seed=2, window=64)
     train, test = raw.split([0.8, 0.2], seed=0)

@@ -1,6 +1,7 @@
 """CrossValidator / param grid tests."""
 
 import numpy as np
+import pytest
 
 from har_tpu.features.wisdm_pipeline import FeatureSet
 from har_tpu.models.logistic_regression import LogisticRegression
@@ -33,6 +34,7 @@ def _separable(n=300, d=6, c=3, seed=0):
     return FeatureSet(features=x, label=y)
 
 
+@pytest.mark.slow
 def test_cv_selects_low_regularization():
     data = _separable()
     cv = CrossValidator(
@@ -48,6 +50,7 @@ def test_cv_selects_low_regularization():
     assert evaluate(data.label, preds.raw, 3)["accuracy"] > 0.9
 
 
+@pytest.mark.slow
 def test_cv_mae_quirk_flips_direction():
     data = _separable()
     cv = CrossValidator(
@@ -62,6 +65,7 @@ def test_cv_mae_quirk_flips_direction():
     assert model.avg_metrics[0] == min(model.avg_metrics)
 
 
+@pytest.mark.slow
 def test_vectorized_cv_matches_generic_loop():
     """cv_scores (vmap sweep) must agree with fit-per-cell scores."""
     data = _separable(n=210)

@@ -1,5 +1,6 @@
 """UCI-HAR adapter tests (synthetic; the real dataset isn't shipped)."""
 
+import pytest
 import numpy as np
 
 from har_tpu.data.ucihar import (
@@ -33,6 +34,7 @@ def test_load_ucihar_directory_layout(tmp_path):
     assert len(train) == 20
 
 
+@pytest.mark.slow
 def test_pipeline_runs_on_ucihar_shape():
     table = synthetic_ucihar(n_rows=600, seed=1)
     data = ucihar_feature_set(table)
