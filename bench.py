@@ -291,7 +291,7 @@ def main() -> None:
     cv_acc = evaluate(lr_test.label, cv_preds.raw, 6)["accuracy"]
 
     best_acc = max(acc, gb_acc)
-    best_wps = max(windows_per_sec, cnn_wps)
+    best_wps = max(windows_per_sec, cnn_wps, bilstm_wps, tfm_wps)
     extra = {
         "mlp_train_time_s": round(train_time, 4),
         "mlp_epochs": epochs,

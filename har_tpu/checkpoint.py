@@ -385,18 +385,23 @@ class TrainCheckpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=self.keep),
         )
 
-    def save(self, epoch: int, params, opt_state) -> None:
+    def save(self, epoch: int, params, opt_state, extra=None) -> None:
+        """``extra``: optional pytree snapshotted alongside (the early-
+        stopping loop stores its best-iterate state there)."""
         state = {
             "params": jax.device_get(params),
             "opt_state": jax.device_get(opt_state),
         }
+        if extra is not None:
+            state["extra"] = jax.device_get(extra)
         self._mgr.save(epoch, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
 
     def latest_epoch(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, epoch: int | None = None, template=None):
+    def restore(self, epoch: int | None = None, template=None,
+                with_extra: bool = False):
         epoch = epoch if epoch is not None else self.latest_epoch()
         if epoch is None:
             return None
@@ -406,6 +411,13 @@ class TrainCheckpointer:
             )
         else:
             restored = self._mgr.restore(epoch)
+        if with_extra:
+            return (
+                epoch,
+                restored["params"],
+                restored["opt_state"],
+                restored.get("extra"),
+            )
         return epoch, restored["params"], restored["opt_state"]
 
     def close(self) -> None:

@@ -3,17 +3,28 @@
 The reference paper's second benchmark (paper §4: "KAGGLE Data-set" —
 UCI-HAR smartphones, 561 precomputed features, 6 classes; BASELINE.md:
 LR+CV reaches 91.9% accuracy there).  The repo itself ships only WISDM;
-this adapter accepts the standard UCI-HAR layout so the same pipeline
-runs both benchmarks:
+this adapter accepts the published "UCI HAR Dataset" layout so the same
+pipeline runs both benchmarks:
 
-  <root>/train/X_train.txt   whitespace-separated 561-feature rows
-  <root>/train/y_train.txt   labels 1..6
-  <root>/test/X_test.txt, <root>/test/y_test.txt
-  (or a single CSV with a 'label'/'Activity' column)
+  <root>/train/X_train.txt        561 fixed-width scientific-notation
+                                  columns per row (3-digit exponents,
+                                  e.g. " 2.8858451e-001")
+  <root>/train/y_train.txt        labels 1..6, one per line
+  <root>/train/subject_train.txt  subject ids 1..30, one per line
+  <root>/test/...                 same three files
+  <root>/features.txt             "1 tBodyAcc-mean()-X" … (561 rows,
+                                  names NOT unique in the published file)
+  <root>/activity_labels.txt      "1 WALKING" … "6 LAYING"
 
-Returned as a Table with FEAT_0..FEAT_560 double columns + ACTIVITY
-string labels, so StringIndexer/VectorAssembler/report layers treat it
-exactly like WISDM.
+``root`` may be the directory that CONTAINS "UCI HAR Dataset" too (the
+published zip's layout); subject/features/activity files are optional —
+the loader degrades to the canonical defaults when they're absent.
+
+Returned as a Table with FEAT_0..FEAT_560 double columns (+ SUBJECT when
+shipped) + ACTIVITY string labels, so StringIndexer/VectorAssembler/
+report layers treat it exactly like WISDM.  ``write_ucihar_fixture``
+emits this exact byte format so tests exercise the real parser contract
+offline (the environment cannot fetch the published archive).
 """
 
 from __future__ import annotations
@@ -38,30 +49,147 @@ UCIHAR_ACTIVITIES = (
 NUM_FEATURES = 561
 
 
-def _to_table(x: np.ndarray, y: np.ndarray) -> Table:
-    names = [f"FEAT_{i}" for i in range(x.shape[1])] + ["ACTIVITY"]
-    types = [ColumnType.DOUBLE] * x.shape[1] + [ColumnType.STRING]
+def _to_table(
+    x: np.ndarray,
+    y: np.ndarray,
+    subjects: np.ndarray | None = None,
+    activities: tuple[str, ...] = UCIHAR_ACTIVITIES,
+) -> Table:
+    names = [f"FEAT_{i}" for i in range(x.shape[1])]
+    types = [ColumnType.DOUBLE] * x.shape[1]
     cols = {f"FEAT_{i}": x[:, i] for i in range(x.shape[1])}
+    if subjects is not None:
+        names.append("SUBJECT")
+        types.append(ColumnType.INT)
+        cols["SUBJECT"] = np.asarray(subjects, np.int64)
+    names.append("ACTIVITY")
+    types.append(ColumnType.STRING)
     cols["ACTIVITY"] = np.asarray(
-        [UCIHAR_ACTIVITIES[int(lab) - 1] for lab in y], dtype=object
+        [activities[int(lab) - 1] for lab in y], dtype=object
     )
     return Table(cols, Schema(tuple(names), tuple(types)))
 
 
+def _resolve_root(root: str) -> str:
+    """Accept the dir holding train/test or the published zip's nesting."""
+    if os.path.isdir(os.path.join(root, "train")):
+        return root
+    nested = os.path.join(root, "UCI HAR Dataset")
+    if os.path.isdir(os.path.join(nested, "train")):
+        return nested
+    raise FileNotFoundError(
+        f"no UCI-HAR train/ directory under {root!r} "
+        "(or its 'UCI HAR Dataset' subdirectory)"
+    )
+
+
+def _read_indexed_names(path: str) -> tuple[str, ...] | None:
+    """'<index> <name>' files (features.txt / activity_labels.txt)."""
+    if not os.path.exists(path):
+        return None
+    names = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                names.append(line.split(maxsplit=1)[1])
+    return tuple(names)
+
+
 def load_ucihar(root: str, split: str = "all") -> Table:
-    """Load train/test/all splits from a UCI-HAR directory tree."""
+    """Load train/test/all splits from a published-layout UCI-HAR tree."""
+    root = _resolve_root(root)
     parts = {"train": ["train"], "test": ["test"], "all": ["train", "test"]}[
         split
     ]
-    xs, ys = [], []
+    activities = (
+        _read_indexed_names(os.path.join(root, "activity_labels.txt"))
+        or UCIHAR_ACTIVITIES
+    )
+    features = _read_indexed_names(os.path.join(root, "features.txt"))
+    xs, ys, subs = [], [], []
     for part in parts:
-        xs.append(
-            np.loadtxt(os.path.join(root, part, f"X_{part}.txt"), dtype=np.float64)
-        )
+        d = os.path.join(root, part)
+        x = np.loadtxt(os.path.join(d, f"X_{part}.txt"), dtype=np.float64)
+        if features is not None and x.shape[1] != len(features):
+            raise ValueError(
+                f"X_{part}.txt has {x.shape[1]} columns but features.txt "
+                f"names {len(features)}"
+            )
+        xs.append(x)
         ys.append(
-            np.loadtxt(os.path.join(root, part, f"y_{part}.txt"), dtype=np.int64)
+            np.loadtxt(os.path.join(d, f"y_{part}.txt"), dtype=np.int64)
         )
-    return _to_table(np.concatenate(xs), np.concatenate(ys))
+        sub_path = os.path.join(d, f"subject_{part}.txt")
+        if os.path.exists(sub_path):
+            subs.append(np.loadtxt(sub_path, dtype=np.int64))
+    subjects = np.concatenate(subs) if len(subs) == len(parts) else None
+    return _to_table(
+        np.concatenate(xs), np.concatenate(ys), subjects, activities
+    )
+
+
+def format_ucihar_value(v: float) -> str:
+    """One X_*.txt field: 7-decimal scientific notation with the published
+    files' 3-digit exponent (' 2.8858451e-001' / '-9.9527860e-001')."""
+    mantissa, exp = f"{float(v):.7e}".split("e")
+    return f"{mantissa}e{exp[0]}{exp[1:].lstrip('0').zfill(3)}"
+
+
+def write_ucihar_fixture(
+    root: str,
+    n_train: int = 64,
+    n_test: int = 32,
+    seed: int = 0,
+    num_features: int = NUM_FEATURES,
+) -> str:
+    """Write a byte-faithful "UCI HAR Dataset" tree with synthetic data.
+
+    Reproduces the published archive's on-disk contract: the nested
+    directory name, fixed-width space-padded X columns with 3-digit
+    exponents, per-line y/subject files, features.txt (561 indexed names,
+    including the real file's duplicated-name quirk) and
+    activity_labels.txt.  Returns the nested dataset root.
+    """
+    rng = np.random.default_rng((seed, 561))
+    base = os.path.join(root, "UCI HAR Dataset")
+    means = rng.normal(0.0, 1.5, size=(6, num_features))
+    os.makedirs(base, exist_ok=True)
+    with open(os.path.join(base, "activity_labels.txt"), "w") as f:
+        for i, name in enumerate(UCIHAR_ACTIVITIES, start=1):
+            f.write(f"{i} {name}\n")
+    with open(os.path.join(base, "features.txt"), "w") as f:
+        for i in range(1, num_features + 1):
+            # the published file repeats names (fBodyAcc-bandsEnergy()
+            # blocks); reproduce the quirk so loaders can't assume
+            # uniqueness
+            name = f"tBodyAcc-mean()-{'XYZ'[i % 3]}" if i % 7 == 0 else (
+                f"feat-{i}()"
+            )
+            f.write(f"{i} {name}\n")
+    for part, n in (("train", n_train), ("test", n_test)):
+        d = os.path.join(base, part)
+        os.makedirs(d, exist_ok=True)
+        y = rng.integers(1, 7, size=n)
+        subjects = rng.integers(1, 31, size=n)
+        x = np.clip(
+            means[y - 1] + rng.normal(0.0, 1.0, size=(n, num_features)),
+            -10,
+            10,
+        )
+        with open(os.path.join(d, f"X_{part}.txt"), "w") as f:
+            for row in x:
+                f.write(
+                    " ".join(
+                        format_ucihar_value(v).rjust(16) for v in row
+                    )
+                    + "\n"
+                )
+        with open(os.path.join(d, f"y_{part}.txt"), "w") as f:
+            f.writelines(f"{int(v)}\n" for v in y)
+        with open(os.path.join(d, f"subject_{part}.txt"), "w") as f:
+            f.writelines(f"{int(v)}\n" for v in subjects)
+    return base
 
 
 def synthetic_ucihar(n_rows: int = 2000, seed: int = 0) -> Table:

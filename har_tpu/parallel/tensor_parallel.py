@@ -96,6 +96,8 @@ def make_gspmd_scan_fit(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    augment: Callable | None = None,
+    class_weights=None,  # (C,) per-class loss weights, or None
 ) -> Callable:
     """fit(params, opt_state, rng, x, y, batch_idx, step0) → (params, opt_state, losses).
 
@@ -106,7 +108,13 @@ def make_gspmd_scan_fit(
     constrained to ``P(dp)`` — XLA propagates from there and inserts the
     tp all-reduces and the dp gradient reduction itself (no explicit
     psum: the compiler's reduction IS the treeAggregate equivalent).
+
+    ``augment``/``class_weights`` mirror trainer.make_scan_fit: the
+    augmentation runs inside the compiled step on the dp-sharded batch,
+    and class weighting turns the loss into Σ(ce·w)/Σw — both global
+    reductions the compiler places for the sharded layout.
     """
+    cw = None if class_weights is None else jnp.asarray(class_weights)
 
     def fit(params, opt_state, rng, x, y, batch_idx, step0):
         def step(carry, step_and_idx):
@@ -119,15 +127,22 @@ def make_gspmd_scan_fit(
                 y[idx], NamedSharding(mesh, P(DP_AXIS))
             )
             step_rng = jax.random.fold_in(rng, step_i)
+            if augment is not None:
+                # same rng decorrelation convention as make_scan_fit
+                xb = augment(jax.random.fold_in(step_rng, 1), xb)
 
             def mean_loss(p):
                 logits = apply_fn(
                     {"params": p}, xb, train=True,
                     rngs={"dropout": step_rng},
                 )
-                return optax.softmax_cross_entropy_with_integer_labels(
+                ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, yb
-                ).mean()
+                )
+                if cw is None:
+                    return ce.mean()
+                wb = cw[yb]
+                return (ce * wb).sum() / wb.sum()
 
             loss, grads = jax.value_and_grad(mean_loss)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)

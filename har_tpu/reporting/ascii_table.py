@@ -13,12 +13,39 @@ from typing import Iterable, Sequence
 import numpy as np
 
 
+def _java_double_str(v: float) -> str:
+    """Java Double.toString: plain decimal for |v| in [1e-3, 1e7),
+    scientific outside ('5.0E-4', '1.2345678E7'), a trailing .0 on whole
+    doubles.  Python's repr shares the shortest-round-trip mantissa but
+    switches notation at different thresholds and writes exponents
+    differently, so parity tables need the Java rules."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "Infinity"
+    if v == float("-inf"):
+        return "-Infinity"
+    a = abs(v)
+    if a == 0.0:
+        return "-0.0" if str(v).startswith("-") else "0.0"
+    if 1e-3 <= a < 1e7:
+        s = repr(v)  # never scientific in this range
+        return s if "." in s else s + ".0"
+    # shortest scientific mantissa that round-trips, Java exponent style
+    for p in range(1, 18):
+        cand = f"{v:.{p}e}"
+        if float(cand) == v:
+            m, e = cand.split("e")
+            m = m.rstrip("0")
+            if m.endswith("."):
+                m += "0"
+            return f"{m}E{int(e)}"
+    return repr(v)  # pragma: no cover - p=17 always round-trips
+
+
 def _fmt(v) -> str:
     if isinstance(v, (float, np.floating)):
-        # Java Double.toString keeps a trailing .0 on whole doubles
-        # ("0.0", "2.0" in result.txt:121-125); Python's float repr does
-        # the same shortest-round-trip formatting
-        return repr(float(v))
+        return _java_double_str(float(v))
     return str(v)
 
 

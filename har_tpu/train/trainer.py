@@ -100,6 +100,15 @@ def _run_fingerprint(
         h.update(repr(augment).encode())
     if cfg.class_weight is not None:
         h.update(repr(cfg.class_weight).encode())
+    if cfg.early_stop_patience:
+        # the early-stop loop snapshots different state (best-iterate
+        # carry) and a different schedule than the plain chunked run
+        h.update(
+            repr(
+                ("early_stop", cfg.early_stop_patience,
+                 cfg.validation_fraction)
+            ).encode()
+        )
     return h.hexdigest()[:16]
 
 
@@ -348,11 +357,6 @@ class Trainer:
                     "early stopping needs 0 < validation_fraction < 1 "
                     f"(got {cfg.validation_fraction})"
                 )
-            if cfg.checkpoint_dir:
-                raise ValueError(
-                    "early stopping and mid-training checkpointing are "
-                    "not supported together yet"
-                )
             if not self.scan:
                 raise ValueError(
                     "early stopping is implemented for the scanned path "
@@ -401,20 +405,10 @@ class Trainer:
                 "augmentation is implemented for the scanned path "
                 "(scan=True)"
             )
-        if self.augment is not None and tp > 1:
-            raise ValueError(
-                "augmentation is not wired into the tensor-parallel "
-                "(tp>1) trainer yet"
-            )
         if cfg.class_weight not in (None, "balanced"):
             raise ValueError(
                 f"class_weight={cfg.class_weight!r}; use None or "
                 "'balanced'"
-            )
-        if cfg.class_weight is not None and tp > 1:
-            raise ValueError(
-                "class weighting is not wired into the tensor-parallel "
-                "(tp>1) trainer yet"
             )
         class_weights = None
         if cfg.class_weight == "balanced":
@@ -459,7 +453,9 @@ class Trainer:
                 params = shard_params(params, mesh, specs)
                 opt_state = optimizer.init(params)
                 fit = make_gspmd_scan_fit(
-                    self.module.apply, optimizer, mesh
+                    self.module.apply, optimizer, mesh,
+                    augment=self.augment,
+                    class_weights=class_weights,
                 )
             else:
                 fit = make_scan_fit(
@@ -470,7 +466,7 @@ class Trainer:
             x_dev, y_dev = jnp.asarray(x), jnp.asarray(y)
             start_epoch = 0
             epochs_run = cfg.epochs  # branches override when they differ
-            if cfg.checkpoint_dir:
+            if cfg.checkpoint_dir and not cfg.early_stop_patience:
                 # fault tolerance: run in save_every_epochs chunks — one
                 # dispatch each — snapshotting (params, opt_state) after
                 # every chunk and resuming from the newest snapshot.  The
@@ -552,7 +548,10 @@ class Trainer:
             elif cfg.early_stop_patience:
                 # per-epoch dispatches: train one epoch's scan, score the
                 # held-out rows, keep the best epoch's parameters, stop
-                # after `patience` epochs without improvement
+                # after `patience` epochs without improvement.  With a
+                # checkpoint_dir, (params, opt_state) AND the best-
+                # iterate carry snapshot every save_every_epochs epochs
+                # and the run resumes mid-search after an interruption.
                 x_val_dev, y_val_np = jnp.asarray(x_val), np.asarray(y_val)
                 predict = jax.jit(
                     lambda p, xv: jnp.argmax(
@@ -564,31 +563,94 @@ class Trainer:
                 chunk_losses = []
                 bad = 0
                 epoch = 0
-                while epoch < cfg.epochs:
-                    lo = epoch * steps_per_epoch
-                    hi = lo + steps_per_epoch
-                    params, opt_state, losses = fit(
-                        params, opt_state, step_root, x_dev, y_dev,
-                        jnp.asarray(batch_idx[lo:hi]),
-                        jnp.asarray(lo, jnp.int32),
+                stopped = False
+                ckptr = None
+                if cfg.checkpoint_dir:
+                    import os
+
+                    from har_tpu.checkpoint import TrainCheckpointer
+
+                    slot = os.path.join(
+                        cfg.checkpoint_dir,
+                        _run_fingerprint(
+                            cfg, x, y, self.module, augment=self.augment
+                        ),
                     )
-                    chunk_losses.append(np.asarray(losses))
-                    acc = float(
-                        (np.asarray(predict(params, x_val_dev)) == y_val_np)
-                        .mean()
+                    ckptr = TrainCheckpointer(slot)
+                    host_params = jax.device_get(params)
+                    restored = ckptr.restore(
+                        template={
+                            "params": host_params,
+                            "opt_state": jax.device_get(opt_state),
+                            "extra": {
+                                "best_params": host_params,
+                                "best_acc": 0.0,
+                                "best_epoch": 0,
+                                "bad": 0,
+                            },
+                        },
+                        with_extra=True,
                     )
-                    val_accs.append(acc)
-                    epoch += 1
-                    if acc > best_acc:
-                        best_acc, best_epoch = acc, epoch
-                        best_params = jax.device_get(params)
-                        bad = 0
-                    else:
-                        bad += 1
-                        if bad >= cfg.early_stop_patience:
+                    if restored is not None:
+                        epoch, params, opt_state, extra = restored
+                        epoch = min(epoch, cfg.epochs)
+                        best_params = extra["best_params"]
+                        best_acc = float(extra["best_acc"])
+                        best_epoch = int(extra["best_epoch"])
+                        bad = int(extra["bad"])
+                        history["resumed_from_epoch"] = epoch
+                        # a run that already exhausted its patience is
+                        # COMPLETE: re-invoking it must serve the stored
+                        # best iterate, not train extra epochs
+                        stopped = bad >= cfg.early_stop_patience
+                try:
+                    while not stopped and epoch < cfg.epochs:
+                        lo = epoch * steps_per_epoch
+                        hi = lo + steps_per_epoch
+                        params, opt_state, losses = fit(
+                            params, opt_state, step_root, x_dev, y_dev,
+                            jnp.asarray(batch_idx[lo:hi]),
+                            jnp.asarray(lo, jnp.int32),
+                        )
+                        chunk_losses.append(np.asarray(losses))
+                        acc = float(
+                            (np.asarray(predict(params, x_val_dev))
+                             == y_val_np).mean()
+                        )
+                        val_accs.append(acc)
+                        epoch += 1
+                        if acc > best_acc:
+                            best_acc, best_epoch = acc, epoch
+                            best_params = jax.device_get(params)
+                            bad = 0
+                        else:
+                            bad += 1
+                            if bad >= cfg.early_stop_patience:
+                                stopped = True
+                        if ckptr is not None and (
+                            stopped
+                            or epoch % (cfg.save_every_epochs or 1) == 0
+                        ):
+                            ckptr.save(
+                                epoch, params, opt_state,
+                                extra={
+                                    "best_params": best_params,
+                                    "best_acc": best_acc,
+                                    "best_epoch": best_epoch,
+                                    "bad": bad,
+                                },
+                            )
+                        if stopped:
                             break
-                params = best_params
-                losses = np.concatenate(chunk_losses)
+                finally:
+                    if ckptr is not None:
+                        ckptr.close()
+                params = best_params if best_params is not None else params
+                losses = (
+                    np.concatenate(chunk_losses)
+                    if chunk_losses
+                    else np.zeros((0, 1), np.float32)
+                )
                 history["loss"] = list(
                     losses.reshape(-1, steps_per_epoch)[:, -1]
                 )

@@ -171,14 +171,67 @@ def test_early_stopping_validation():
     )
     with pytest.raises(ValueError, match="validation_fraction"):
         mk(validation_fraction=0.0).fit(x, y)
-    with pytest.raises(ValueError, match="not supported together"):
-        mk(checkpoint_dir="/tmp/nope").fit(x, y)
     with pytest.raises(ValueError, match="scanned path"):
         Trainer(
             MLP(num_classes=2),
             TrainerConfig(early_stop_patience=2),
             scan=False,
         ).fit(x, y)
+
+
+def test_early_stopping_composes_with_checkpointing(tmp_path):
+    """Early stopping + checkpoint_dir snapshot the best-iterate carry;
+    an identical re-run restores at the stopped epoch without retraining
+    and serves the same parameters."""
+    import numpy as np
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = TrainerConfig(
+        batch_size=64, epochs=4, early_stop_patience=10,
+        validation_fraction=0.2, checkpoint_dir=str(tmp_path), seed=3,
+    )
+    first = Trainer(MLP(num_classes=2, hidden=(16,)), cfg).fit(x, y)
+    assert "resumed_from_epoch" not in first.history
+    assert first.history["stopped_epoch"] == 4
+
+    second = Trainer(MLP(num_classes=2, hidden=(16,)), cfg).fit(x, y)
+    assert second.history["resumed_from_epoch"] == 4
+    np.testing.assert_array_equal(
+        first.predict_logits(x), second.predict_logits(x)
+    )
+
+
+def test_early_stop_resume_after_stop_does_not_retrain(tmp_path):
+    """Re-invoking a run whose patience was already exhausted must serve
+    the stored best iterate, not train additional epochs."""
+    import numpy as np
+
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=128).astype(np.int32)  # pure noise
+    cfg = TrainerConfig(
+        batch_size=32, epochs=50, early_stop_patience=1,
+        validation_fraction=0.25, checkpoint_dir=str(tmp_path), seed=0,
+        learning_rate=0.0,  # val accuracy can never improve -> stops fast
+    )
+    first = Trainer(MLP(num_classes=2, hidden=(8,)), cfg).fit(x, y)
+    stopped = first.history["stopped_epoch"]
+    assert stopped < 50
+
+    second = Trainer(MLP(num_classes=2, hidden=(8,)), cfg).fit(x, y)
+    assert second.history["resumed_from_epoch"] == stopped
+    assert second.history["stopped_epoch"] == stopped  # no extra epochs
+    np.testing.assert_array_equal(
+        first.predict_logits(x), second.predict_logits(x)
+    )
 
 
 def test_negative_patience_rejected():

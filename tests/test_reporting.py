@@ -13,6 +13,23 @@ from har_tpu.reporting import CSV_HEADER, CV_CSV_HEADER, ReportWriter, show
 from har_tpu.reporting.report import ModelResult
 
 
+def test_java_double_formatting():
+    """show() cells follow Java Double.toString: decimal in [1e-3, 1e7),
+    scientific outside, trailing .0 on whole doubles."""
+    from har_tpu.reporting.ascii_table import _java_double_str as j
+
+    assert j(0.0005) == "5.0E-4"
+    assert j(1e-05) == "1.0E-5"
+    assert j(12345678.0) == "1.2345678E7"
+    assert j(1e7) == "1.0E7"
+    assert j(2.0) == "2.0"
+    assert j(0.0) == "0.0"
+    assert j(-0.03) == "-0.03"
+    assert j(0.001) == "0.001"
+    assert j(float("nan")) == "NaN"
+    assert j(float("-inf")) == "-Infinity"
+
+
 def test_show_matches_spark_layout():
     out = show(["a", "bb"], [[1, 2.5], [10, 0.25]], max_rows=20)
     lines = out.strip().split("\n")

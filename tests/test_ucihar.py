@@ -6,9 +6,11 @@ import numpy as np
 from har_tpu.data.ucihar import (
     NUM_FEATURES,
     UCIHAR_ACTIVITIES,
+    format_ucihar_value,
     load_ucihar,
     synthetic_ucihar,
     ucihar_feature_set,
+    write_ucihar_fixture,
 )
 from har_tpu.models.logistic_regression import LogisticRegression
 from har_tpu.ops.metrics import evaluate
@@ -32,6 +34,62 @@ def test_load_ucihar_directory_layout(tmp_path):
     assert len(table) == 30
     train = load_ucihar(str(tmp_path), split="train")
     assert len(train) == 20
+
+
+def test_value_format_matches_published_files():
+    """X_*.txt fields: 7 decimals, 3-digit exponent — ' 2.8858451e-001'."""
+    assert format_ucihar_value(0.28858451) == "2.8858451e-001"
+    assert format_ucihar_value(-0.99527860) == "-9.9527860e-001"
+    assert format_ucihar_value(1.0) == "1.0000000e+000"
+    assert format_ucihar_value(2.5e-12) == "2.5000000e-012"
+
+
+def test_byte_faithful_fixture_roundtrip(tmp_path):
+    """The fixture reproduces the published archive's layout byte format
+    (nested dir, padded 3-digit-exponent columns, subject/feature/label
+    files) and the loader parses every piece of it."""
+    base = write_ucihar_fixture(
+        str(tmp_path), n_train=24, n_test=12, seed=0, num_features=561
+    )
+    assert base.endswith("UCI HAR Dataset")
+    # byte-format: first line of X_train has 561 fields, each with a
+    # 3-digit exponent, fixed 16-char padding between columns
+    line = open(f"{base}/train/X_train.txt").readline().rstrip("\n")
+    fields = line.split()
+    assert len(fields) == 561
+    assert all(f[-4] in "+-" and f[-3:].isdigit() for f in fields)
+    assert len(line) == 561 * 17 - 1  # 16-char fields + single spaces
+    # subject + activity label files
+    assert open(f"{base}/activity_labels.txt").readline() == "1 WALKING\n"
+    subjects = open(f"{base}/train/subject_train.txt").read().split()
+    assert len(subjects) == 24 and all(1 <= int(s) <= 30 for s in subjects)
+    feats = open(f"{base}/features.txt").read().splitlines()
+    assert len(feats) == 561 and feats[0].startswith("1 ")
+    names = [l.split(maxsplit=1)[1] for l in feats]
+    assert len(set(names)) < len(names)  # published duplicate-name quirk
+
+    # loader: from the OUTER root (published zip layout) and the nested one
+    for root in (str(tmp_path), base):
+        table = load_ucihar(root, split="all")
+        assert len(table) == 36
+        assert "SUBJECT" in table.column_names
+        assert set(np.unique(table["ACTIVITY"])) <= set(UCIHAR_ACTIVITIES)
+    train = load_ucihar(base, split="train")
+    assert len(train) == 24
+    # values survive the format with 7-decimal precision
+    x = ucihar_feature_set(train).features
+    assert x.shape == (24, 561)
+    assert np.isfinite(x).all()
+
+
+def test_loader_rejects_feature_count_mismatch(tmp_path):
+    base = write_ucihar_fixture(
+        str(tmp_path), n_train=4, n_test=2, num_features=16
+    )
+    with open(f"{base}/features.txt", "a") as f:
+        f.write("17 extra()\n")
+    with pytest.raises(ValueError, match="features.txt"):
+        load_ucihar(base)
 
 
 @pytest.mark.slow
