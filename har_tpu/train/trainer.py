@@ -70,19 +70,33 @@ class TrainerConfig:
 
 
 def _run_fingerprint(
-    cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module, augment=None
+    cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module, augment=None,
+    params=None,
 ) -> str:
     """Stable id for (model, data, schedule): the checkpoint-slot key.
 
     Hashes the module's configuration (Flax modules repr their dataclass
-    fields), data shapes + a sample, and every config field that shapes
-    the step sequence or optimizer schedule — two fits resume each
-    other's snapshots only when they would execute the identical run.
+    fields), the parameter tree's structure/shapes (a module whose repr
+    is unchanged but whose param layout changed — e.g. an internal layer
+    rewrite — must NOT resume old snapshots), data shapes + a sample,
+    and every config field that shapes the step sequence or optimizer
+    schedule — two fits resume each other's snapshots only when they
+    would execute the identical run.
     """
     import hashlib
 
     h = hashlib.sha1()
     h.update(repr(module).encode())
+    if params is not None:
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        h.update(
+            repr(
+                [
+                    (jax.tree_util.keystr(p), tuple(l.shape), str(l.dtype))
+                    for p, l in leaves
+                ]
+            ).encode()
+        )
     h.update(repr((x.shape, y.shape, str(x.dtype))).encode())
     h.update(np.ascontiguousarray(x[:64]).tobytes())
     h.update(np.ascontiguousarray(y[:64]).tobytes())
@@ -311,6 +325,23 @@ class NeuralModel:
         return Predictions.from_raw(logits, probs)
 
 
+def _replace_on_mesh(params, opt_state, mesh, specs):
+    """Re-place restored host arrays for a tp>1 run: params in the tp
+    layout, optimizer state replicated mesh-wide (GSPMD reshards mu/nu on
+    first use, and the first donated output re-adopts the computed
+    sharded layout for the rest of the run)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from har_tpu.parallel.tensor_parallel import shard_params
+
+    params = shard_params(params, mesh, specs)
+    rep = NamedSharding(mesh, PartitionSpec())
+    opt_state = jax.tree.map(
+        lambda leaf: jax.device_put(leaf, rep), opt_state
+    )
+    return params, opt_state
+
+
 class Trainer:
     """Fits a Flax module on (x, y) arrays, data-parallel over a mesh."""
 
@@ -331,6 +362,21 @@ class Trainer:
         # augment(key, xb) -> xb, applied inside the compiled train step
         # (scan path); see har_tpu.data.augment
         self.augment = augment
+
+    def _open_checkpointer(self, cfg, x, y, params):
+        """One slot-derivation for every checkpointing path (chunked and
+        early-stop), so the two can never drift onto different slots."""
+        import os
+
+        from har_tpu.checkpoint import TrainCheckpointer
+
+        slot = os.path.join(
+            cfg.checkpoint_dir,
+            _run_fingerprint(
+                cfg, x, y, self.module, augment=self.augment, params=params
+            ),
+        )
+        return TrainCheckpointer(slot)
 
     def fit(
         self,
@@ -477,16 +523,8 @@ class Trainer:
                 # identical run resumes them.
                 import os
 
-                from har_tpu.checkpoint import TrainCheckpointer
-
                 ckpt_every = cfg.save_every_epochs or 1
-                slot = os.path.join(
-                    cfg.checkpoint_dir,
-                    _run_fingerprint(
-                        cfg, x, y, self.module, augment=self.augment
-                    ),
-                )
-                ckptr = TrainCheckpointer(slot)
+                ckptr = self._open_checkpointer(cfg, x, y, params)
                 try:
                     restored = ckptr.restore(
                         template={
@@ -498,22 +536,8 @@ class Trainer:
                         start_epoch, params, opt_state = restored
                         start_epoch = min(start_epoch, cfg.epochs)
                         if tp > 1:
-                            # restored leaves are host arrays; re-place
-                            # params in the tp layout and the optimizer
-                            # state replicated mesh-wide (GSPMD reshards
-                            # mu/nu on first use, and the first chunk's
-                            # donated output re-adopts the computed
-                            # sharded layout for the rest of the run)
-                            from jax.sharding import (
-                                NamedSharding,
-                                PartitionSpec,
-                            )
-
-                            params = shard_params(params, mesh, specs)
-                            rep = NamedSharding(mesh, PartitionSpec())
-                            opt_state = jax.tree.map(
-                                lambda res: jax.device_put(res, rep),
-                                opt_state,
+                            params, opt_state = _replace_on_mesh(
+                                params, opt_state, mesh, specs
                             )
                     chunks_losses = []
                     epoch = start_epoch
@@ -566,17 +590,7 @@ class Trainer:
                 stopped = False
                 ckptr = None
                 if cfg.checkpoint_dir:
-                    import os
-
-                    from har_tpu.checkpoint import TrainCheckpointer
-
-                    slot = os.path.join(
-                        cfg.checkpoint_dir,
-                        _run_fingerprint(
-                            cfg, x, y, self.module, augment=self.augment
-                        ),
-                    )
-                    ckptr = TrainCheckpointer(slot)
+                    ckptr = self._open_checkpointer(cfg, x, y, params)
                     host_params = jax.device_get(params)
                     restored = ckptr.restore(
                         template={
@@ -599,6 +613,10 @@ class Trainer:
                         best_epoch = int(extra["best_epoch"])
                         bad = int(extra["bad"])
                         history["resumed_from_epoch"] = epoch
+                        if tp > 1:
+                            params, opt_state = _replace_on_mesh(
+                                params, opt_state, mesh, specs
+                            )
                         # a run that already exhausted its patience is
                         # COMPLETE: re-invoking it must serve the stored
                         # best iterate, not train extra epochs
@@ -629,6 +647,9 @@ class Trainer:
                                 stopped = True
                         if ckptr is not None and (
                             stopped
+                            or epoch == cfg.epochs  # final-epoch exit
+                            # must snapshot too, else a re-invocation
+                            # retrains the tail epochs
                             or epoch % (cfg.save_every_epochs or 1) == 0
                         ):
                             ckptr.save(

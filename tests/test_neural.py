@@ -194,6 +194,9 @@ def test_early_stopping_composes_with_checkpointing(tmp_path):
     cfg = TrainerConfig(
         batch_size=64, epochs=4, early_stop_patience=10,
         validation_fraction=0.2, checkpoint_dir=str(tmp_path), seed=3,
+        # 3 does not divide 4: the final epoch is snapshotted by the
+        # epoch-exhaustion save, not the cadence
+        save_every_epochs=3,
     )
     first = Trainer(MLP(num_classes=2, hidden=(16,)), cfg).fit(x, y)
     assert "resumed_from_epoch" not in first.history
@@ -245,6 +248,39 @@ def test_negative_patience_rejected():
         Trainer(
             MLP(num_classes=2), TrainerConfig(early_stop_patience=-3)
         ).fit(np.zeros((16, 4), np.float32), np.zeros((16,), np.int32))
+
+
+def test_fused_bilstm_direction_semantics():
+    """With tied direction weights, time-reversing the input must swap
+    the forward/backward output halves (each also time-reversed) — the
+    invariant that pins the fused scan's reversal bookkeeping."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from har_tpu.models.neural import FusedBiLSTMLayer
+
+    layer = FusedBiLSTMLayer(hidden=8, dtype=jnp.float32)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(3, 12, 5)), jnp.float32
+    )
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    params = jax.tree.map(
+        lambda p: p.at[1].set(p[0]), params
+    )  # tie fwd/bwd weights
+    y = layer.apply({"params": params}, x)
+    y_rev = layer.apply({"params": params}, x[:, ::-1, :])
+    h = 8
+    np.testing.assert_allclose(
+        np.asarray(y_rev[..., :h]),
+        np.asarray(y[:, ::-1, h:]),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_rev[..., h:]),
+        np.asarray(y[:, ::-1, :h]),
+        rtol=1e-5, atol=1e-5,
+    )
 
 
 def test_trainer_class_weight_balanced():
