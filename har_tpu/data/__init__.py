@@ -2,6 +2,7 @@ from har_tpu.data.schema import ColumnType, Schema, infer_schema
 from har_tpu.data.table import Table
 from har_tpu.data.csv_loader import read_csv
 from har_tpu.data.split import random_split
+from har_tpu.data.spark_split import mllib_vocab, spark_split_indices
 from har_tpu.data.wisdm import load_wisdm, WISDM_NUMERIC_COLUMNS, WISDM_CATEGORICAL_COLUMNS
 from har_tpu.data.synthetic import synthetic_wisdm
 from har_tpu.data.raw_loader import RawStream, load_raw_stream, stream_windows
@@ -18,6 +19,8 @@ __all__ = [
     "Table",
     "read_csv",
     "random_split",
+    "spark_split_indices",
+    "mllib_vocab",
     "load_wisdm",
     "synthetic_wisdm",
     "WISDM_NUMERIC_COLUMNS",

@@ -65,9 +65,11 @@ class FeatureSet:
     def train_test(
         self, train_fraction: float, seed: int
     ) -> tuple["FeatureSet", "FeatureSet"]:
-        """THE train/test split convention: every evaluation path (runner
-        featurize, checkpoint evaluate) must derive the test partition
-        through this one method or risk scoring on different rows."""
+        """Bernoulli train/test split.  Tabular-WISDM paths must go
+        through runner.derive_split instead (which routes to the
+        spark-exact replay per DataConfig.split_method and falls back
+        here) — every evaluation path sharing one derivation is what
+        keeps scoring on the same held-out rows."""
         train, test = self.split(
             [train_fraction, 1.0 - train_fraction], seed=seed
         )

@@ -75,10 +75,14 @@ def test_evaluate_checkpoint_raw_model(tmp_path):
         model=ModelConfig(name="cnn1d"),
     )
     train, _, _ = featurize(cfg, load_dataset(cfg))
-    est = build_estimator("cnn1d", {"epochs": 5, "batch_size": 64})
+    kwargs = {"channels": (16, 16)}  # small convs: the roundtrip is
+    # what's under test, not CNN capacity
+    est = build_estimator(
+        "cnn1d", {"epochs": 3, "batch_size": 64, **kwargs}
+    )
     model = est.fit(train)
     path = save_model(
-        str(tmp_path / "ckpt"), model, "cnn1d",
+        str(tmp_path / "ckpt"), model, "cnn1d", kwargs,
         dataset="wisdm_raw", synthetic_rows=600,
     )
     # no dataset/synthetic_rows restated: both come from metadata
@@ -98,11 +102,12 @@ def test_evaluate_checkpoint_dataset_recorded_and_enforced(tmp_path):
         model=ModelConfig(name="cnn1d"),
     )
     train, _, _ = featurize(cfg, load_dataset(cfg))
-    model = build_estimator("cnn1d", {"epochs": 1, "batch_size": 64}).fit(
-        train
-    )
+    kwargs = {"channels": (16, 16)}
+    model = build_estimator(
+        "cnn1d", {"epochs": 1, "batch_size": 64, **kwargs}
+    ).fit(train)
     path = save_model(
-        str(tmp_path / "ckpt"), model, "cnn1d",
+        str(tmp_path / "ckpt"), model, "cnn1d", kwargs,
         dataset="wisdm_raw", synthetic_rows=600,
     )
     # None → recorded dataset; mismatching explicit dataset refused

@@ -10,11 +10,16 @@ from har_tpu.models import LogisticRegression
 from har_tpu.ops.metrics import evaluate
 
 
-def _feature_sets(table, seed=2018):
+def _feature_sets(table, seed=2018, spark_exact=False):
     # reference fits the pipeline on the FULL df, then randomSplits the
     # transformed frame (Main/main.py:68-80)
     model = build_wisdm_pipeline().fit(table)
     fs = make_feature_set(model.transform(table))
+    if spark_exact:
+        from har_tpu.data.spark_split import spark_split_indices
+
+        tr, te = spark_split_indices(table, [0.7, 0.3], seed)
+        return fs.take(tr), fs.take(te)
     return fs.split([0.7, 0.3], seed=seed)
 
 
@@ -59,14 +64,21 @@ class TestWisdmParity:
     @pytest.mark.slow
     def test_reference_hyperparams_match_accuracy(self, wisdm_csv_path):
         table = load_wisdm(wisdm_csv_path)
-        train, test = _feature_sets(table)
+        train, test = _feature_sets(table, spark_exact=True)
         assert train.num_features == 3100
-        model = LogisticRegression().fit(train)  # reference defaults
-        preds = model.transform(test)
-        rep = evaluate(test.label, preds.raw, num_classes=6)
-        # reference: 0.6148 accuracy / 0.5630 F1
-        assert rep["accuracy"] >= 0.60
-        assert rep["f1"] >= 0.54
+        lr = LogisticRegression().fit(train)  # reference defaults
+        rep = evaluate(test.label, lr.transform(test).raw, num_classes=6)
+        # On the exact reference rows, MLlib's log-prior intercept init
+        # keeps the 20-iteration cutoff at or above the published
+        # 0.614769 (result.txt:167) — 0.6178 CPU / 0.6172 TPU here; the
+        # unconverged trajectory itself is arithmetic-order-sensitive
+        # (column permutations and backend matmul rounding move it a few
+        # rows), so exact equality is not a stable property of ANY
+        # reimplementation — match-or-beat is the contract.
+        assert rep["accuracy"] >= 0.6147
+        # F1 observed 0.5655 vs reference 0.5630; a small slack absorbs
+        # the same trajectory jitter the accuracy bound allows for
+        assert rep["f1"] >= 0.56
 
     @pytest.mark.slow
     def test_beats_reference_accuracy_and_f1(self, wisdm_csv_path):
