@@ -86,6 +86,35 @@ def create_multihost_mesh(
     return Mesh(grid, (DP_DCN_AXIS, DP_AXIS, TP_AXIS))
 
 
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Data-parallel axes present in a mesh, outermost (DCN) first.
+
+    The single source of truth for "which axes shard the batch" — the
+    trainers, batch-sharding helpers, and GSPMD constraints all consult
+    this so hybrid multi-slice meshes behave identically everywhere.
+    """
+    return tuple(a for a in (DP_DCN_AXIS, DP_AXIS) if a in mesh.shape)
+
+
+def data_shard_count(mesh: Mesh) -> int:
+    """How many ways the batch dimension splits on this mesh."""
+    return int(
+        np.prod([mesh.shape[a] for a in data_axes(mesh)], dtype=np.int64)
+    ) if data_axes(mesh) else 1
+
+
+def linear_data_shard_index(mesh: Mesh):
+    """Traced linear shard id across every data axis (inside shard_map).
+
+    Keeps per-shard rng folds unique on hybrid meshes: slice-major,
+    matching the device order `create_multihost_mesh` lays out.
+    """
+    idx = jax.lax.axis_index(DP_AXIS)
+    if DP_DCN_AXIS in mesh.shape:
+        idx = jax.lax.axis_index(DP_DCN_AXIS) * mesh.shape[DP_AXIS] + idx
+    return idx
+
+
 def linear_mesh(n: int, axis: str, devices: list | None = None) -> Mesh:
     """1-D mesh over ``n`` devices with one named axis (pp/ep layouts)."""
     devices = list(jax.devices()) if devices is None else list(devices)

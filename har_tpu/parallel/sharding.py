@@ -13,12 +13,14 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from har_tpu.parallel.mesh import DP_AXIS
+from har_tpu.parallel.mesh import DP_AXIS, data_axes, data_shard_count
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
-    """Rows sharded over dp, everything else replicated."""
-    return NamedSharding(mesh, P(DP_AXIS, *([None] * (ndim - 1))))
+    """Rows sharded over every data axis (dp, plus dp_dcn on hybrid
+    multi-slice meshes), everything else replicated."""
+    axes = data_axes(mesh) or (DP_AXIS,)
+    return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
@@ -48,7 +50,7 @@ def shard_batch(mesh: Mesh, *arrays: np.ndarray) -> tuple:
     Returns ``(*device_arrays, mask)`` where ``mask`` is 1.0 for real rows
     and 0.0 for padding — consumers weight their reductions by it.
     """
-    dp = mesh.shape[DP_AXIS]
+    dp = data_shard_count(mesh)
     out = []
     n = arrays[0].shape[0]
     for a in arrays:

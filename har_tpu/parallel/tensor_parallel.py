@@ -113,18 +113,25 @@ def make_gspmd_scan_fit(
     augmentation runs inside the compiled step on the dp-sharded batch,
     and class weighting turns the loss into Σ(ce·w)/Σw — both global
     reductions the compiler places for the sharded layout.
+
+    On hybrid multi-slice meshes the batch constraint covers BOTH data
+    axes (``(dp_dcn, dp)``), so every slice works on distinct rows and
+    the compiler's gradient reduction crosses DCN once per step.
     """
+    from har_tpu.parallel.mesh import data_axes
+
     cw = None if class_weights is None else jnp.asarray(class_weights)
+    batch_spec = P(data_axes(mesh) or DP_AXIS)
 
     def fit(params, opt_state, rng, x, y, batch_idx, step0):
         def step(carry, step_and_idx):
             params, opt_state = carry
             step_i, idx = step_and_idx
             xb = jax.lax.with_sharding_constraint(
-                x[idx], NamedSharding(mesh, P(DP_AXIS))
+                x[idx], NamedSharding(mesh, batch_spec)
             )
             yb = jax.lax.with_sharding_constraint(
-                y[idx], NamedSharding(mesh, P(DP_AXIS))
+                y[idx], NamedSharding(mesh, batch_spec)
             )
             step_rng = jax.random.fold_in(rng, step_i)
             if augment is not None:

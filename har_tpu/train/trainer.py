@@ -27,6 +27,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from har_tpu.models.base import Predictions
 from har_tpu.parallel.mesh import DP_AXIS, TP_AXIS, single_device_mesh
+from har_tpu.parallel.mesh import (
+    data_axes,
+    data_shard_count,
+    linear_data_shard_index,
+)
 from har_tpu.parallel.sharding import batch_sharding, pad_to_multiple
 
 
@@ -152,9 +157,10 @@ def make_train_step(
     partitioner adds nothing but compile-time work.
     """
     single = _is_single_device(mesh)
+    dp_axes = data_axes(mesh)
 
     def local_step(params, opt_state, rng, x, y, mask):
-        shard = 0 if single else jax.lax.axis_index(DP_AXIS)
+        shard = 0 if single else linear_data_shard_index(mesh)
         shard_rng = jax.random.fold_in(rng, shard)
 
         def local_sum(p):
@@ -169,7 +175,7 @@ def make_train_step(
         )(params)
         if not single:
             loss_sum, count, grads = jax.lax.psum(
-                (loss_sum, count, grads), DP_AXIS
+                (loss_sum, count, grads), dp_axes
             )
         count = jnp.maximum(count, 1.0)
         grads = jax.tree.map(lambda g: g / count, grads)
@@ -179,7 +185,7 @@ def make_train_step(
 
     if single:
         return jax.jit(local_step, donate_argnums=(0, 1))
-    rep, bat = P(), P(DP_AXIS)
+    rep, bat = P(), P(dp_axes)
     step = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -214,11 +220,19 @@ def make_scan_fit(
 
     On a 1-device mesh the whole run compiles under plain ``jit`` (the
     psum/axis_index are identities there — see make_train_step).
+
+    Hybrid multi-slice meshes (create_multihost_mesh: dp_dcn outermost,
+    dp inner) work transparently: the batch shards over BOTH data axes
+    and the gradient reduction psums over the (dp_dcn, dp) tuple — XLA
+    reduces over ICI within each slice, then once over DCN.
     """
     single = _is_single_device(mesh)
+    dp_axes = data_axes(mesh)
 
     def local_fit(params, opt_state, rng, x, y, batch_idx, step0):
-        shard = 0 if single else jax.lax.axis_index(DP_AXIS)
+        # linear shard id across every data axis, so per-shard rng
+        # folds stay unique on hybrid meshes
+        shard = 0 if single else linear_data_shard_index(mesh)
 
         def step(carry, step_and_idx):
             params, opt_state = carry
@@ -252,7 +266,7 @@ def make_scan_fit(
             )(params)
             if not single:
                 loss_sum, count, grads = jax.lax.psum(
-                    (loss_sum, count, grads), DP_AXIS
+                    (loss_sum, count, grads), dp_axes
                 )
             grads = jax.tree.map(lambda g: g / count, grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -274,7 +288,7 @@ def make_scan_fit(
     fit = jax.shard_map(
         local_fit,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, rep, P(None, DP_AXIS), rep),
+        in_specs=(rep, rep, rep, rep, rep, P(None, dp_axes), rep),
         out_specs=(rep, rep, rep),
         check_vma=False,
     )
@@ -420,11 +434,11 @@ class Trainer:
             x, y = x[train_rows], y[train_rows]
             n = len(x)
 
-        dp = mesh.shape[DP_AXIS]
+        dp = data_shard_count(mesh)
         if cfg.batch_size % dp:
             raise ValueError(
-                f"batch_size {cfg.batch_size} must be divisible by the dp "
-                f"mesh axis ({dp})"
+                f"batch_size {cfg.batch_size} must be divisible by the "
+                f"data-parallel shard count ({dp})"
             )
         steps_per_epoch = max(1, -(-n // cfg.batch_size))
         total_steps = steps_per_epoch * cfg.epochs
