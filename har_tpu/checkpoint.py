@@ -146,13 +146,16 @@ def _classical_registry():
 
     def extract_tree(model):
         t = model.tree
+        arrays = {
+            "tree_feature": t.feature,
+            "tree_threshold": t.threshold,
+            "tree_leaf_class": t.leaf_class,
+            "tree_leaf_probs": t.leaf_probs,
+        }
+        if t.leaf_counts is not None:
+            arrays["tree_leaf_counts"] = t.leaf_counts
         return (
-            {
-                "tree_feature": t.feature,
-                "tree_threshold": t.threshold,
-                "tree_leaf_class": t.leaf_class,
-                "tree_leaf_probs": t.leaf_probs,
-            },
+            arrays,
             {"max_depth": t.max_depth, "num_classes": model.num_classes},
         )
 
@@ -164,6 +167,9 @@ def _classical_registry():
                 leaf_class=arrays["tree_leaf_class"],
                 leaf_probs=arrays["tree_leaf_probs"],
                 max_depth=scalars["max_depth"],
+                # checkpoints predating the raw-counts field fall back to
+                # probabilities at transform time
+                leaf_counts=arrays.get("tree_leaf_counts"),
             ),
             num_classes=scalars["num_classes"],
         )

@@ -142,7 +142,7 @@ class RandomForestClassifier:
             data.features, self.max_bins, self.split_candidates
         )
         bins = binize(x, thresholds)
-        feature, threshold, leaf_class, leaf_probs = _grow_forest(
+        feature, threshold, leaf_class, leaf_probs, _ = _grow_forest(
             bins,
             thresholds,
             y,

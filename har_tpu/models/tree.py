@@ -136,6 +136,11 @@ class TreeArrays:
     leaf_class: np.ndarray  # int32 argmax class at the node
     leaf_probs: np.ndarray  # (nodes, C) class distribution at the node
     max_depth: int
+    # (nodes, C) raw class COUNTS — MLlib's rawPrediction column is the
+    # leaf's impurity stats, not the normalized distribution, and the
+    # Binary evaluator's threshold sweep ranks by it; None on checkpoints
+    # predating the field (transform then falls back to probabilities)
+    leaf_counts: np.ndarray | None = None
 
 
 def _gini(counts: jax.Array) -> jax.Array:
@@ -313,17 +318,17 @@ def _grow_tree(
     leaf_class = jnp.argmax(node_counts, axis=1).astype(jnp.int32)
     denom = jnp.maximum(node_counts.sum(-1, keepdims=True), 1e-12)
     leaf_probs = node_counts / denom
-    return feature, threshold, leaf_class, leaf_probs
+    return feature, threshold, leaf_class, leaf_probs, node_counts
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
-def _predict_tree(
+def _walk_tree(
     feature: jax.Array,
     threshold: jax.Array,
-    leaf_probs: jax.Array,
     x: jax.Array,
     max_depth: int,
 ):
+    """Leaf node id per row (vmapped scan over depth)."""
     n = x.shape[0]
 
     def walk(node, _):
@@ -337,7 +342,11 @@ def _predict_tree(
     node, _ = jax.lax.scan(
         walk, jnp.zeros((n,), jnp.int32), None, length=max_depth
     )
-    return leaf_probs[node]  # (n, C)
+    return node
+
+
+def _predict_tree(feature, threshold, leaf_probs, x, max_depth):
+    return leaf_probs[_walk_tree(feature, threshold, x, max_depth)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -374,7 +383,7 @@ class DecisionTreeClassifier:
             data.features, self.max_bins, self.split_candidates
         )
         bins = binize(x, thresholds)
-        feature, threshold, leaf_class, leaf_probs = _grow_tree(
+        feature, threshold, leaf_class, leaf_probs, leaf_counts = _grow_tree(
             bins,
             thresholds,
             y,
@@ -397,6 +406,7 @@ class DecisionTreeClassifier:
                 leaf_class=np.asarray(leaf_class),
                 leaf_probs=np.asarray(leaf_probs),
                 max_depth=self.max_depth,
+                leaf_counts=np.asarray(leaf_counts),
             ),
             num_classes=num_classes,
         )
@@ -413,15 +423,24 @@ class DecisionTreeModel:
         return int(_count_reachable(self.tree))
 
     def transform(self, data: FeatureSet) -> Predictions:
-        probs = _predict_tree(
-            jnp.asarray(self.tree.feature),
-            jnp.asarray(self.tree.threshold),
-            jnp.asarray(self.tree.leaf_probs),
-            jnp.asarray(data.features, jnp.float32),
-            max_depth=self.tree.max_depth,
+        node = np.asarray(
+            _walk_tree(
+                jnp.asarray(self.tree.feature),
+                jnp.asarray(self.tree.threshold),
+                jnp.asarray(data.features, jnp.float32),
+                max_depth=self.tree.max_depth,
+            )
         )
-        probs = np.asarray(probs)
-        return Predictions.from_raw(probs, probs)
+        probs = np.asarray(self.tree.leaf_probs)[node]
+        # rawPrediction = the leaf's class COUNTS (MLlib semantics: the
+        # Binary evaluator ranks its threshold sweep by these, which
+        # orders leaves differently than normalized probabilities)
+        raw = (
+            np.asarray(self.tree.leaf_counts)[node]
+            if self.tree.leaf_counts is not None
+            else probs
+        )
+        return Predictions.from_raw(raw, probs)
 
 
 def _count_reachable(tree: TreeArrays) -> int:

@@ -157,14 +157,97 @@ def classification_report(
 
 
 def evaluate(labels, raw_scores, num_classes, positive_class=1) -> dict[str, float]:
-    """Host-friendly wrapper: numpy in, python floats out."""
-    rep = classification_report(
-        jnp.asarray(labels),
-        jnp.asarray(raw_scores),
-        num_classes=num_classes,
-        positive_class=positive_class,
-    )
+    """Host evaluation battery in float64 — the report/CSV path.
+
+    Mirrors the jitted :func:`classification_report` formulas but computes
+    in double precision from exact integer counts, so the emitted values
+    equal MLlib's to the last digit (the reference CSVs carry full f64
+    reprs).  The binary block reproduces MLlib's
+    BinaryClassificationEvaluator semantics on multiclass data exactly
+    (reference Main/main.py:135-143 applies it to 6-class labels):
+    score = rawPrediction[1], positive = label > 0.5 (every non-class-0
+    row!), and ROC/PR curves over DISTINCT thresholds — tie groups form
+    one curve point, which changes areaUnderPR vs per-row accumulation.
+
+    The jitted battery stays for in-graph/device callers (CV sweeps).
+    """
+    import numpy as np
+
+    y = np.asarray(labels).astype(np.int64)
+    raw = np.asarray(raw_scores, np.float64)
+    pred = raw.argmax(-1)
+    n = len(y)
+    cm = np.zeros((num_classes, num_classes), np.float64)
+    np.add.at(cm, (y, pred), 1.0)
+
+    total = cm.sum()
+    tp = np.diagonal(cm)
+    actual = cm.sum(axis=1)
+    predicted = cm.sum(axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = np.where(predicted > 0, tp / np.maximum(predicted, 1), 0.0)
+        recall = np.where(actual > 0, tp / np.maximum(actual, 1), 0.0)
+        f1 = np.where(
+            precision + recall > 0,
+            2 * precision * recall / np.maximum(precision + recall, 1e-300),
+            0.0,
+        )
+    weights = actual / max(total, 1.0)
+    correct = float(tp.sum())
+
+    # --- MLlib binary evaluator (distinct-threshold curves) -------------
+    scores = raw[:, positive_class]
+    pos = (y > 0.5).astype(np.float64)
+    order = np.argsort(-scores, kind="stable")
+    s_sorted, p_sorted = scores[order], pos[order]
+    # last index of each distinct score = one curve point per threshold
+    if n:
+        last = np.nonzero(np.diff(s_sorted) != 0)[0]
+        bounds = np.concatenate([last, [n - 1]])
+        tp_c = np.cumsum(p_sorted)[bounds]
+        fp_c = (np.arange(1, n + 1, dtype=np.float64) - np.cumsum(p_sorted))[
+            bounds
+        ]
+        p_tot = max(pos.sum(), 1e-300)
+        n_tot = max(n - pos.sum(), 1e-300)
+        tpr = np.concatenate([[0.0], tp_c / p_tot])
+        fpr = np.concatenate([[0.0], fp_c / n_tot])
+        auroc = float(np.trapezoid(tpr, fpr))
+        prec_c = tp_c / np.maximum(tp_c + fp_c, 1e-300)
+        rec_c = tp_c / p_tot
+        aupr = float(
+            np.trapezoid(
+                np.concatenate([prec_c[:1], prec_c]),
+                np.concatenate([[0.0], rec_c]),
+            )
+        )
+    else:  # pragma: no cover - empty input
+        auroc = aupr = 0.0
+
+    # --- regression over class indices (reference applies it so) --------
+    yf, pf = y.astype(np.float64), pred.astype(np.float64)
+    err = yf - pf
+    mse = float((err**2).mean()) if n else 0.0
+    mae = float(np.abs(err).mean()) if n else 0.0
+    ss_tot = float(((yf - yf.mean()) ** 2).sum()) if n else 0.0
+    r2 = 1.0 - float((err**2).sum()) / max(ss_tot, 1e-300)
+
     return {
-        k: (v.tolist() if v.ndim else float(v))
-        for k, v in rep.items()
+        "confusion_matrix": cm.tolist(),
+        "accuracy": correct / max(total, 1.0),
+        "weightedPrecision": float((weights * precision).sum()),
+        "weightedRecall": float((weights * recall).sum()),
+        "f1": float((weights * f1).sum()),
+        "precision_per_class": precision.tolist(),
+        "recall_per_class": recall.tolist(),
+        "f1_per_class": f1.tolist(),
+        "count_total": float(total),
+        "count_correct": correct,
+        "count_wrong": float(total) - correct,
+        "areaUnderROC": auroc,
+        "areaUnderPR": aupr,
+        "mse": mse,
+        "rmse": float(np.sqrt(mse)),
+        "mae": mae,
+        "r2": r2,
     }

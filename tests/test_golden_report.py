@@ -126,6 +126,12 @@ def test_dt_block_structure_and_metrics(wisdm_csv_path, tmp_path):
     # bug — it prints rmse under the MSE label — is intentionally NOT
     # replicated, so that line is excluded)
     for text in [
+        # Binary evaluator: MLlib semantics on multiclass data (score =
+        # rawPrediction[1] = the leaf's class-1 COUNT, positive = label
+        # > 0.5, distinct-threshold curves) — exact equality
+        "Binary Classifier Raw Prediction ------------: 0.685412",
+        "Binary Clasifier Area Under PR --------------: 0.861856",
+        "Binary Clasifier Area Under ROC -------------: 0.685412",
         "MultiClass F1 -------------------------------: 0.679556",
         "MultiClass Weighted Precision ---------------: 0.644884",
         "MultiClass Weighted Recall ------------------: 0.730462",
