@@ -23,6 +23,7 @@ numeric, GBDT's binned view) shares the membership this module computes.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import Counter
 from typing import Sequence
 
@@ -50,8 +51,24 @@ def mllib_vocab(values: Sequence[str]) -> dict[str, int]:
     return {v: i for i, v in enumerate(keys)}
 
 
-def spark_sort_order(table: Table) -> np.ndarray:
-    """Original-row indices in the pre-sampling sorted-stream order."""
+@dataclasses.dataclass(frozen=True)
+class AssembledRows:
+    """The pipeline-transformed frame exactly as MLlib sees it: per-row
+    sparse (indices, values) in float64 (VectorAssembler drops explicit
+    zeros, actives ascending), the indexed label, and UID — the inputs
+    both the split replay and the bit-exact model replays consume."""
+
+    sparse: list[tuple[tuple[int, ...], tuple[float, ...]]]
+    label: np.ndarray  # (n,) float64, StringIndexer frequency-desc ids
+    uid: np.ndarray  # (n,) int64
+    num_features: int
+    nums: list[tuple[float, ...]]  # raw numeric column values per row
+    cats: list[tuple[str, ...]]  # raw categorical strings per row
+    activity: list[str]
+
+
+def assemble_rows(table: Table) -> AssembledRows:
+    """Reproduce the MLlib pipeline output (Main/main.py:51-73) row by row."""
     cats = [
         [str(v) for v in table[c]] for c in WISDM_CATEGORICAL_COLUMNS
     ]
@@ -63,12 +80,16 @@ def spark_sort_order(table: Table) -> np.ndarray:
     label_vocab = mllib_vocab([str(v) for v in table[LABEL_COLUMN]])
     activity = [str(v) for v in table[LABEL_COLUMN]]
     uid = (
-        table["UID"].tolist()
+        np.asarray(table["UID"], dtype=np.int64)
         if "UID" in table.column_names
-        else [0] * len(table)
+        else np.zeros(len(table), dtype=np.int64)
     )
 
-    keys = []
+    base = int(offsets[-1])
+    num_features = base + len(numeric)
+    sparse = []
+    label = np.zeros(len(table), np.float64)
+    nums_out: list[tuple[float, ...]] = []
     for j in range(len(table)):
         idx: list[int] = []
         val: list[float] = []
@@ -77,21 +98,50 @@ def spark_sort_order(table: Table) -> np.ndarray:
             if rank < widths[k]:
                 idx.append(int(offsets[k]) + rank)
                 val.append(1.0)
-        base = int(offsets[-1])
-        nums = [float(col[j]) for col in numeric]
+        nums = tuple(float(col[j]) for col in numeric)
         for k, v in enumerate(nums):
             if v != 0.0:
                 idx.append(base + k)
                 val.append(v)
+        sparse.append((tuple(idx), tuple(val)))
+        label[j] = float(label_vocab[activity[j]])
+        nums_out.append(nums)
+    return AssembledRows(
+        sparse=sparse,
+        label=label,
+        uid=uid,
+        num_features=num_features,
+        nums=nums_out,
+        cats=[
+            tuple(cats[k][j] for k in range(len(cats)))
+            for j in range(len(table))
+        ],
+        activity=activity,
+    )
+
+
+def spark_sort_order(
+    table: Table, rows: AssembledRows | None = None
+) -> np.ndarray:
+    """Original-row indices in the pre-sampling sorted-stream order.
+
+    Pass a precomputed ``assemble_rows(table)`` to avoid re-running the
+    pure-Python assembly when the caller already has one."""
+    if rows is None:
+        rows = assemble_rows(table)
+
+    keys = []
+    for j in range(len(rows.sparse)):
+        idx, val = rows.sparse[j]
         keys.append(
             (
-                label_vocab[activity[j]],
-                tuple(idx),
-                tuple(val),
-                uid[j],
-                *nums,
-                *(cats[k][j] for k in range(len(cats))),
-                activity[j],
+                rows.label[j],
+                idx,
+                val,
+                rows.uid[j],
+                *rows.nums[j],
+                *rows.cats[j],
+                rows.activity[j],
             )
         )
     return np.asarray(
@@ -100,7 +150,10 @@ def spark_sort_order(table: Table) -> np.ndarray:
 
 
 def spark_split_indices(
-    table: Table, fractions: Sequence[float], seed: int
+    table: Table,
+    fractions: Sequence[float],
+    seed: int,
+    rows: AssembledRows | None = None,
 ) -> list[np.ndarray]:
     """Split row indices exactly as the reference's randomSplit would.
 
@@ -108,7 +161,7 @@ def spark_split_indices(
     the row order Spark's train/test dataframes iterate in — so
     ``show(5)``-style report samples line up with result.txt too.
     """
-    order = spark_sort_order(table)
+    order = spark_sort_order(table, rows)
     draws = bernoulli_draws(len(order), seed)
     fracs = np.asarray(fractions, dtype=np.float64)
     if np.any(fracs < 0):

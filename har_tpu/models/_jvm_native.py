@@ -1,0 +1,203 @@
+"""ctypes bridge to the JVM-parity math kernels (native/mllibmath.cpp).
+
+Compiled with ``-ffp-contract=off``: the JVM never fuses a*b+c into an FMA,
+and GCC's default contraction would silently fork the bit-exact L-BFGS
+trajectory the MLlib LogisticRegression replay reproduces.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from har_tpu.data._native_build import NativeLib
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "native",
+)
+
+_F64P = ctypes.POINTER(ctypes.c_double)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.set_math_backend.restype = None
+    lib.set_math_backend.argtypes = [ctypes.c_int]
+    lib.dnrm2_f2j.restype = ctypes.c_double
+    lib.dnrm2_f2j.argtypes = [_F64P, ctypes.c_int64]
+    lib.jvm_exp.restype = ctypes.c_double
+    lib.jvm_exp.argtypes = [ctypes.c_double]
+    lib.jvm_log.restype = ctypes.c_double
+    lib.jvm_log.argtypes = [ctypes.c_double]
+    lib.ddot_seq.restype = ctypes.c_double
+    lib.ddot_seq.argtypes = [_F64P, _F64P, ctypes.c_int64]
+    lib.lr_loss_grad.restype = ctypes.c_double
+    lib.lr_loss_grad.argtypes = [
+        _F64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, _I32P, _F64P, _I64P, _F64P, _F64P,
+        ctypes.c_double, _F64P,
+    ]
+    lib.lr_predict.restype = None
+    lib.lr_predict.argtypes = [
+        _F64P, _F64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _I32P, _F64P, _I64P, _F64P, _F64P,
+    ]
+
+
+_LIB = NativeLib(
+    src=os.path.join(_NATIVE_DIR, "mllibmath.cpp"),
+    so=os.path.join(_NATIVE_DIR, "libharjvm.so"),
+    configure=_configure,
+    extra_flags=("-ffp-contract=off",),
+)
+
+
+def load():
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError(
+            f"JVM-parity native kernel unavailable: {_LIB.build_error}"
+        )
+    return lib
+
+
+def available() -> bool:
+    return _LIB.available()
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctype)
+
+
+def set_math_backend(backend: int) -> None:
+    """0 = fdlibm (JDK StrictMath), 1 = platform libm; oracle arbiter."""
+    load().set_math_backend(int(backend))
+
+
+def dnrm2_f2j(a: np.ndarray) -> float:
+    assert a.dtype == np.float64 and a.flags.c_contiguous
+    return load().dnrm2_f2j(_ptr(a, _F64P), a.size)
+
+
+def jvm_exp(x: float) -> float:
+    return load().jvm_exp(float(x))
+
+
+def jvm_log(x: float) -> float:
+    return load().jvm_log(float(x))
+
+
+def ddot(a: np.ndarray, b: np.ndarray) -> float:
+    """Strict left-to-right dot (F2J ddot order; Breeze norm = sqrt of it)."""
+    assert a.dtype == np.float64 and b.dtype == np.float64
+    assert a.flags.c_contiguous and b.flags.c_contiguous
+    return load().ddot_seq(_ptr(a, _F64P), _ptr(b, _F64P), a.size)
+
+
+class CsrMatrix:
+    """Row-major sparse matrix in MLlib active-iteration order."""
+
+    def __init__(
+        self,
+        indices: np.ndarray,
+        values: np.ndarray,
+        indptr: np.ndarray,
+        n_cols: int,
+    ):
+        self.indices = np.ascontiguousarray(indices, np.int32)
+        self.values = np.ascontiguousarray(values, np.float64)
+        self.indptr = np.ascontiguousarray(indptr, np.int64)
+        self.n_cols = int(n_cols)
+        self.n_rows = len(self.indptr) - 1
+
+    @classmethod
+    def from_rows(cls, rows, n_cols: int) -> "CsrMatrix":
+        """rows: iterable of (indices, values) pairs, active order."""
+        indptr = [0]
+        idx: list[int] = []
+        val: list[float] = []
+        for ri, rv in rows:
+            idx.extend(int(i) for i in ri)
+            val.extend(float(v) for v in rv)
+            indptr.append(len(idx))
+        return cls(
+            np.asarray(idx, np.int32),
+            np.asarray(val, np.float64),
+            np.asarray(indptr, np.int64),
+            n_cols,
+        )
+
+    def take(self, row_ids) -> "CsrMatrix":
+        indptr = [0]
+        idx: list[np.ndarray] = []
+        val: list[np.ndarray] = []
+        total = 0
+        for r in row_ids:
+            lo, hi = int(self.indptr[r]), int(self.indptr[r + 1])
+            idx.append(self.indices[lo:hi])
+            val.append(self.values[lo:hi])
+            total += hi - lo
+            indptr.append(total)
+        return CsrMatrix(
+            np.concatenate(idx) if idx else np.empty(0, np.int32),
+            np.concatenate(val) if val else np.empty(0, np.float64),
+            np.asarray(indptr, np.int64),
+            self.n_cols,
+        )
+
+
+def lr_loss_grad(
+    coef: np.ndarray,
+    x: CsrMatrix,
+    labels: np.ndarray,
+    feat_std: np.ndarray,
+    num_classes: int,
+    fit_intercept: bool,
+    reg_l2: float,
+    grad_out: np.ndarray,
+) -> float:
+    lib = load()
+    return lib.lr_loss_grad(
+        _ptr(coef, _F64P),
+        x.n_rows,
+        x.n_cols,
+        num_classes,
+        1 if fit_intercept else 0,
+        _ptr(x.indices, _I32P),
+        _ptr(x.values, _F64P),
+        _ptr(x.indptr, _I64P),
+        _ptr(labels, _F64P),
+        _ptr(feat_std, _F64P),
+        float(reg_l2),
+        _ptr(grad_out, _F64P),
+    )
+
+
+def lr_predict(
+    coef_matrix: np.ndarray,  # (k, d) row-major, original feature space
+    intercepts: np.ndarray,  # (k,)
+    x: CsrMatrix,
+) -> tuple[np.ndarray, np.ndarray]:
+    lib = load()
+    k, d = coef_matrix.shape
+    raw = np.empty((x.n_rows, k), np.float64)
+    prob = np.empty((x.n_rows, k), np.float64)
+    lib.lr_predict(
+        _ptr(np.ascontiguousarray(coef_matrix, np.float64), _F64P),
+        _ptr(np.ascontiguousarray(intercepts, np.float64), _F64P),
+        x.n_rows,
+        d,
+        k,
+        _ptr(x.indices, _I32P),
+        _ptr(x.values, _F64P),
+        _ptr(x.indptr, _I64P),
+        _ptr(raw, _F64P),
+        _ptr(prob, _F64P),
+    )
+    return raw, prob
