@@ -158,6 +158,22 @@ def scala_hash_improve(hcode: int) -> int:
     return h ^ (h >> 10)
 
 
+def scala_int_trie_order(keys) -> list[int]:
+    """scala immutable.HashMap[Int-hashed key] iteration order.
+
+    The hash trie walks 5-bit chunks of improve(key.##) LSB-first; whole
+    doubles 0.0..5.0 hash like their int values (scala unified hashing),
+    so MulticlassMetrics' ``labelCountByClass`` map iterates class ids in
+    this order — the order its weighted metrics accumulate in.
+    """
+
+    def chunk_key(k: int) -> tuple[int, ...]:
+        h = scala_hash_improve(k & _M32)
+        return tuple((h >> (5 * level)) & 31 for level in range(7))
+
+    return sorted(keys, key=chunk_key)
+
+
 def scala_hashmap_key(s: str) -> tuple[int, ...]:
     """Sort key reproducing scala immutable.HashMap iteration order.
 

@@ -38,6 +38,11 @@ class FeatureSet:
     # split paths, in sampled-stream order) — lets the report render the
     # reference's train/test show(5) tables; None once re-indexed
     rows: np.ndarray | None = None
+    # float64 sparse design for this split (models.mllib_exact.ExactDesign),
+    # attached by the spark-exact split path; the bit-exact MLlib replay
+    # estimators train from it (float32 device features drop the low
+    # bits MLlib's L-BFGS trajectory depends on).  Dropped by take().
+    exact: object | None = None
 
     def __len__(self) -> int:
         return len(self.features)

@@ -48,6 +48,15 @@ def _configure(lib: ctypes.CDLL) -> None:
         _F64P, _F64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         _I32P, _F64P, _I64P, _F64P, _F64P,
     ]
+    lib.rf_poisson_weights.restype = None
+    lib.rf_poisson_weights.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_double, _F64P,
+    ]
+    lib.reservoir_sample_range.restype = None
+    lib.reservoir_sample_range.argtypes = [
+        ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64, _I32P,
+    ]
 
 
 _LIB = NativeLib(
@@ -98,6 +107,29 @@ def ddot(a: np.ndarray, b: np.ndarray) -> float:
     assert a.dtype == np.float64 and b.dtype == np.float64
     assert a.flags.c_contiguous and b.flags.c_contiguous
     return load().ddot_seq(_ptr(a, _F64P), _ptr(b, _F64P), a.size)
+
+
+def rf_poisson_weights(
+    seed: int, n_rows: int, num_trees: int, subsample: float = 1.0
+) -> np.ndarray:
+    """(n_rows, num_trees) BaggedPoint bootstrap counts; pass the already
+    partition-adjusted seed (seed + partitionIndex + 1)."""
+    out = np.empty((n_rows, num_trees), np.float64)
+    load().rf_poisson_weights(
+        int(seed), n_rows, num_trees, float(subsample), _ptr(out, _F64P)
+    )
+    return out
+
+
+def reservoir_sample_range(
+    xorshift_state: int, n_items: int, k: int
+) -> np.ndarray:
+    """SamplingUtils.reservoirSampleAndCount over range(n_items)."""
+    out = np.empty(k, np.int32)
+    load().reservoir_sample_range(
+        int(xorshift_state) & (2**64 - 1), n_items, k, _ptr(out, _I32P)
+    )
+    return out
 
 
 class CsrMatrix:

@@ -17,16 +17,17 @@ returned in the original feature space.  α = elastic_net_param.
 Solver: optax L-BFGS under `lax.scan` for the smooth case; proximal
 gradient (FISTA) when α > 0 so the L1 term is handled exactly.
 
-Documented divergence from the reference's published numbers (SURVEY §7
-hard part b): with standardization, the effective penalty on an original-
-space coefficient is ∝ its feature's variance, so the 3,090 rare one-hot
-dims are nearly unregularized.  The converged optimum of this (MLlib's
-own) objective scores 0.633 on the reference test split; MLlib's reported
-0.7145 (CV block) is an artifact of Breeze L-BFGS being cut off at
-maxIter=20 far from convergence — a trajectory, not an optimum, and not
-reproducible by a different L-BFGS implementation.  `standardize=False`
-(uniform penalty) converges to 0.72+ and beats the reference's CV
-headline with a single fit (see bench.py).
+This is the TPU-native FAST lane.  The reference's published numbers
+(LR 0.6148, CV 0.7145) are the maxIter=20 Breeze trajectory, which this
+converged solver intentionally does not chase — the bit-exact replay
+lane (har_tpu.models.mllib_lr: Breeze L-BFGS/OWL-QN ports over MLlib's
+standardized objective with fdlibm transcendentals) reproduces them
+exactly.  Analysis note that still holds: with standardization the
+effective penalty on an original-space coefficient is ∝ its feature's
+variance, so the 3,090 rare one-hot dims are nearly unregularized and
+the CONVERGED optimum of MLlib's objective scores only ~0.633;
+`standardize=False` (uniform penalty) converges to 0.72+ and beats the
+reference's CV headline with a single fit (see bench.py).
 """
 
 from __future__ import annotations

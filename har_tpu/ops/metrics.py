@@ -192,8 +192,23 @@ def evaluate(labels, raw_scores, num_classes, positive_class=1) -> dict[str, flo
             2 * precision * recall / np.maximum(precision + recall, 1e-300),
             0.0,
         )
-    weights = actual / max(total, 1.0)
     correct = float(tp.sum())
+    # MulticlassMetrics' weighted aggregates fold ``metric(c) * count(c)
+    # / labelCount`` over labelCountByClass — a scala immutable HashMap
+    # iterated in hash-trie order — so the CSVs' full-f64 reprs only
+    # match MLlib with the same per-term arithmetic and the same
+    # accumulation order (numpy's pairwise sum differs in the last ulp).
+    from har_tpu.data.spark_random import scala_int_trie_order
+
+    label_count = max(total, 1.0)
+    w_precision = 0.0
+    w_recall = 0.0
+    w_f1 = 0.0
+    for c in scala_int_trie_order(range(num_classes)):
+        cnt = float(actual[c])
+        w_precision += float(precision[c]) * cnt / label_count
+        w_recall += float(recall[c]) * cnt / label_count
+        w_f1 += float(f1[c]) * cnt / label_count
 
     # --- MLlib binary evaluator (distinct-threshold curves) -------------
     scores = raw[:, positive_class]
@@ -235,9 +250,9 @@ def evaluate(labels, raw_scores, num_classes, positive_class=1) -> dict[str, flo
     return {
         "confusion_matrix": cm.tolist(),
         "accuracy": correct / max(total, 1.0),
-        "weightedPrecision": float((weights * precision).sum()),
-        "weightedRecall": float((weights * recall).sum()),
-        "f1": float((weights * f1).sum()),
+        "weightedPrecision": w_precision,
+        "weightedRecall": w_recall,
+        "f1": w_f1,
         "precision_per_class": precision.tolist(),
         "recall_per_class": recall.tolist(),
         "f1_per_class": f1.tolist(),

@@ -1,0 +1,126 @@
+"""The reference-exact pipeline: reproduce result.txt block for block.
+
+The committed reference artifact (Main/wisdm_main_ver_0.0/main_result/
+result.txt) is the notebook-variant run: prefix (schema → EDA → pipeline
+→ split tables), then FOUR model blocks — LR, LR-CV (MAE-quirk
+CrossValidator), DT, RF — with the per-block prediction-sample filters
+the script hardcodes (prediction==5 for LR, ==0 for the others;
+Main/main.py:127,223,309,490).
+
+``parity_run`` drives the bit-exact replay estimators
+(har_tpu.models.mllib_exact) through that exact sequence and writes the
+same artifacts.  Everything except run-specific noise (timings, random
+uids, transcendental last-ulps in the LR probability strings) is
+byte-identical to the reference's captured run — the golden test
+(tests/test_golden_report.py) pins it line by line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Sequence
+
+import numpy as np
+
+from har_tpu.config import DataConfig, RunConfig
+from har_tpu.ops.metrics import evaluate
+from har_tpu.reporting import ModelResult, ReportWriter
+
+
+def write_reference_prefix(report, table, train, test, pipe) -> None:
+    """Lines 1-139 of result.txt: schema → samples → class counts →
+    describe → pipeline schema → feature sample → split counts/tables."""
+    report.line("Loading Data Set...")
+    report.schema(table)
+    report.sample(table)
+    report.class_counts(table["ACTIVITY"])
+    report.summary(table)
+    report.pipeline_schema(table)
+    cols = pipe.transform(table)
+    feats = np.asarray(cols["features"], np.float32)
+    labels = np.asarray(cols["label"], np.float64)
+    report.sample_feature_data(table, labels, feats)
+    report.split_counts(len(train), len(test))
+    report.split_sample_tables(
+        table, feats, labels, train.rows, test.rows
+    )
+
+
+def parity_run(
+    output_dir: str,
+    config: RunConfig | None = None,
+    blocks: Sequence[str] = ("lr", "lr_cv", "dt", "rf"),
+) -> dict:
+    """Run the reference-exact pipeline; returns block accuracies +
+    artifact paths."""
+    from har_tpu.models.mllib_exact import (
+        CrossValidatorExact,
+        LogisticRegressionExact,
+        RandomForestExact,
+    )
+    from har_tpu.models.tree import DecisionTreeClassifier
+    from har_tpu.runner import (
+        _spark_display_name,
+        featurize,
+        load_dataset,
+    )
+
+    config = config or RunConfig(
+        data=DataConfig(dataset="wisdm"), output_dir=output_dir
+    )
+    config = dataclasses.replace(config, output_dir=output_dir)
+    table = load_dataset(config)
+    train, test, pipe = featurize(config, table)
+    report = ReportWriter(
+        output_dir,
+        class_names=(
+            list(train.class_names) if train.class_names else None
+        ),
+        reference_quirks=True,
+    )
+    write_reference_prefix(report, table, train, test, pipe)
+
+    # (job name, estimator, reference sample filter class, is_cv)
+    jobs = {
+        "lr": ("logistic_regression", LogisticRegressionExact(), 5, False),
+        "lr_cv": (
+            "logistic_regression_cv",
+            CrossValidatorExact(),
+            0,
+            True,
+        ),
+        "dt": ("decision_tree", DecisionTreeClassifier(), 0, False),
+        "rf": ("random_forest", RandomForestExact(), 0, False),
+    }
+    accuracies: dict[str, float] = {}
+    results = []
+    for key in blocks:
+        name, est, class_id, is_cv = jobs[key]
+        t0 = time.perf_counter()
+        model = est.fit(train)
+        train_time = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        preds = model.transform(test)
+        test_time = time.perf_counter() - t0
+        metrics = evaluate(test.label, preds.raw, model.num_classes)
+        result = ModelResult(
+            name=name,
+            metrics=metrics,
+            train_time_s=train_time,
+            test_time_s=test_time,
+            is_cv=is_cv,
+            display_name=_spark_display_name(name, model, is_cv),
+        )
+        report.model_block(
+            result,
+            sample_text=report.prediction_sample(
+                test, preds, class_id=class_id
+            ),
+        )
+        results.append(result)
+        accuracies[name] = float(metrics["accuracy"])
+
+    paths = report.save()
+    return {"accuracies": accuracies, "artifacts": paths}

@@ -272,16 +272,34 @@ def derive_split(
     scoring on different rows than training held out.
     """
     if resolve_split_method(data) == "spark":
-        from har_tpu.data.spark_split import spark_split_indices
+        from har_tpu.data.spark_split import (
+            assemble_rows,
+            spark_split_indices,
+        )
+        from har_tpu.models.mllib_exact import DeferredExactDesign
 
+        asm = assemble_rows(table)
         train_idx, test_idx = spark_split_indices(
             table,
             [data.train_fraction, 1.0 - data.train_fraction],
             data.seed,
+            rows=asm,
         )
+        # float64 design for the bit-exact MLlib replay estimators,
+        # deferred: assemble_rows was already paid for the split itself,
+        # and the CSR packing happens only if an exact estimator runs
+        shared: dict = {}
         return (
-            dataclasses.replace(full.take(train_idx), rows=train_idx),
-            dataclasses.replace(full.take(test_idx), rows=test_idx),
+            dataclasses.replace(
+                full.take(train_idx),
+                rows=train_idx,
+                exact=DeferredExactDesign(shared, asm, train_idx),
+            ),
+            dataclasses.replace(
+                full.take(test_idx),
+                rows=test_idx,
+                exact=DeferredExactDesign(shared, asm, test_idx),
+            ),
         )
     return full.train_test(data.train_fraction, data.seed)
 

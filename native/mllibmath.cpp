@@ -367,3 +367,148 @@ void lr_predict(const double *coefm, const double *intercepts, int64_t n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// RandomForest bagging RNG stack (clean-room ports of published algorithms)
+// ---------------------------------------------------------------------------
+// MLlib's RF (reference Main/main.py:478) draws its randomness from three
+// generators, all replayed here:
+//   - commons-math3 Well19937c + PoissonDistribution(1.0).sample() for the
+//     per-(row, tree) bootstrap counts (BaggedPoint, seed+partition+1);
+//   - Spark's XORShiftRandom for per-node feature-subset reservoir
+//     sampling (SamplingUtils.reservoirSampleAndCount) — the caller
+//     passes the MurmurHash3-mixed initial state (the 64-byte-buffer
+//     seed quirk lives in har_tpu.data.spark_random);
+//   - java.util.Random's LCG for the per-node seed stream (Python side).
+
+namespace {
+
+constexpr int kWellR = 624;  // (19937 + 31) / 32
+
+struct Well19937c {
+  int32_t v[kWellR];
+  int index;
+
+  void seed_long(int64_t seed) {
+    // AbstractWell.setSeed(long) -> setSeed(int[]{hi, lo}), then fill
+    // v[i] = (int)((1812433253L * (v[i-2] ^ (v[i-2] >> 30)) + i))
+    int32_t init[2] = {
+        static_cast<int32_t>(static_cast<uint64_t>(seed) >> 32),
+        static_cast<int32_t>(seed & 0xffffffffLL)};
+    v[0] = init[0];
+    v[1] = init[1];
+    for (int i = 2; i < kWellR; ++i) {
+      int64_t l = v[i - 2];  // sign-extended, like Java's int -> long
+      v[i] = static_cast<int32_t>(
+          (1812433253LL * (l ^ (l >> 30)) + i) & 0xffffffffLL);
+    }
+    index = 0;
+  }
+
+  int32_t next(int bits) {
+    const int index_rm1 = (index + kWellR - 1) % kWellR;
+    const int index_rm2 = (index + kWellR - 2) % kWellR;
+    const int32_t v0 = v[index];
+    const int32_t vm1 = v[(index + 70) % kWellR];
+    const int32_t vm2 = v[(index + 179) % kWellR];
+    const int32_t vm3 = v[(index + 449) % kWellR];
+
+    const int32_t z0 = (0x80000000 & v[index_rm1]) ^ (0x7fffffff & v[index_rm2]);
+    const int32_t z1 = (v0 ^ (v0 << 25)) ^
+                       (vm1 ^ static_cast<int32_t>(static_cast<uint32_t>(vm1) >> 27));
+    const int32_t z2 = static_cast<int32_t>(static_cast<uint32_t>(vm2) >> 9) ^
+                       (vm3 ^ static_cast<int32_t>(static_cast<uint32_t>(vm3) >> 1));
+    const int32_t z3 = z1 ^ z2;
+    const int32_t z4 = z0 ^ (z1 ^ (z1 << 9)) ^ (z2 ^ (z2 << 21)) ^
+                       (z3 ^ static_cast<int32_t>(static_cast<uint32_t>(z3) >> 21));
+
+    v[index] = z3;
+    v[index_rm1] = z4;
+    v[index_rm2] &= 0x80000000;
+    index = index_rm1;
+
+    // Matsumoto-Kurita tempering (the "c" in Well19937c)
+    int32_t z4t = z4 ^ ((z4 << 7) & static_cast<int32_t>(0xe46e1700));
+    z4t = z4t ^ ((z4t << 15) & static_cast<int32_t>(0x9b868000));
+    return static_cast<int32_t>(static_cast<uint32_t>(z4t) >> (32 - bits));
+  }
+
+  double next_double() {
+    // BitsStreamGenerator.nextDouble: (next(26)<<26 | next(26)&0x3ffffff)
+    // * 2^-52
+    const int64_t high = static_cast<int64_t>(next(26)) << 26;
+    const int32_t low = next(26) & 0x03ffffff;
+    return static_cast<double>(high | low) * 0x1.0p-52;
+  }
+
+  // commons-math3 PoissonDistribution.sample() for mean < 40: Knuth's
+  // multiplication method.
+  int64_t next_poisson(double mean, double p) {
+    int64_t n = 0;
+    double r = 1.0;
+    while (n < 1000 * mean) {
+      const double rnd = next_double();
+      r *= rnd;
+      if (r >= p) {
+        n++;
+      } else {
+        return n;
+      }
+    }
+    return n;
+  }
+};
+
+struct XorShift64 {
+  uint64_t state;  // MurmurHash3-mixed, supplied by the caller
+
+  int32_t next(int bits) {
+    uint64_t s = state;
+    s ^= s << 21;
+    s ^= s >> 35;
+    s ^= s << 4;
+    state = s;
+    return static_cast<int32_t>(s & ((1LL << bits) - 1));
+  }
+
+  double next_double() {
+    // java.util.Random.nextDouble over the overridden next()
+    const int64_t high = static_cast<int64_t>(next(26)) << 27;
+    return static_cast<double>(high + next(27)) * 0x1.0p-53;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// (n_rows, num_trees) Poisson(subsample) bootstrap counts, row-major,
+// exactly the BaggedPoint stream: one Well19937c seeded once with
+// (seed + partitionIndex + 1), rows outer, trees inner.
+void rf_poisson_weights(int64_t seed, int64_t n_rows, int64_t num_trees,
+                        double subsample, double *out) {
+  Well19937c rng;
+  rng.seed_long(seed);
+  const double p = fdlibm_exp(-subsample);  // FastMath.exp(-mean)
+  for (int64_t r = 0; r < n_rows; ++r)
+    for (int64_t t = 0; t < num_trees; ++t)
+      out[r * num_trees + t] = static_cast<double>(rng.next_poisson(subsample, p));
+}
+
+// SamplingUtils.reservoirSampleAndCount over Range(0, n_items) with k
+// slots; xorshift_state is the MurmurHash3-mixed XORShiftRandom seed.
+void reservoir_sample_range(uint64_t xorshift_state, int64_t n_items,
+                            int64_t k, int32_t *out) {
+  for (int64_t i = 0; i < k && i < n_items; ++i) out[i] = static_cast<int32_t>(i);
+  if (n_items <= k) return;
+  XorShift64 rng{xorshift_state};
+  int64_t l = k;
+  for (int64_t item = k; item < n_items; ++item) {
+    l += 1;
+    const int64_t replacement =
+        static_cast<int64_t>(rng.next_double() * static_cast<double>(l));
+    if (replacement < k) out[replacement] = static_cast<int32_t>(item);
+  }
+}
+
+}  // extern "C"

@@ -150,6 +150,104 @@ def test_dt_block_structure_and_metrics(wisdm_csv_path, tmp_path):
         assert any(text in line for line in ref_block), text
 
 
+# --- full-file byte parity (round-3: the whole 320 lines) ---------------
+
+# Run-specific noise: the model-uid line and the two timing lines that
+# open each of the four blocks (result.txt:141-143, 186-188, 231-233,
+# 276-278).  Spark's uids are random per run and the reference's wall
+# times are its own machine's; BOTH still must match structurally, which
+# _masked() enforces.
+_UID_TIMING_LINES = frozenset(
+    n for start in (141, 186, 231, 276) for n in range(start, start + 3)
+)
+# The LR/LR-CV probability sample rows (result.txt:147-151, 192-196):
+# 16-digit Double.toString reprs reproduced to >= 13 significant digits —
+# the residual is the reference JDK build's Math.exp/log last-ulps (see
+# har_tpu/models/mllib_lr.py).  Pinned to a >= 15-shared-chars floor
+# instead of byte equality.
+_LR_PROB_LINES = frozenset(range(147, 152)) | frozenset(range(192, 197))
+
+
+def _masked(line: str) -> str:
+    line = re.sub(r"_[0-9a-f]{20}\b", "_<uid>", line)
+    return re.sub(
+        r"(trained in|made in) -?\d+(\.\d+)?([eE]-?\d+)? seconds",
+        r"\1 <t> seconds",
+        line,
+    )
+
+
+@pytest.fixture(scope="module")
+def parity_artifacts(tmp_path_factory, wisdm_csv_path):
+    from har_tpu.models import _jvm_native
+    from har_tpu.parity import parity_run
+
+    if not _jvm_native.available():
+        pytest.skip("native JVM-parity kernel unavailable")
+    out_dir = tmp_path_factory.mktemp("parity")
+    out = parity_run(str(out_dir))
+    return out_dir, out
+
+
+@pytest.mark.slow
+def test_full_result_txt_byte_parity(parity_artifacts):
+    """parity_run reproduces ALL 320 lines of the reference's captured
+    result.txt: byte-equal everywhere except the documented exclusion
+    set (uid/timing noise masked structurally; LR probability strings
+    >= 15 shared leading chars).  This subsumes the prefix/DT pins and
+    adds the LR, LR-CV and RF blocks (VERDICT r2 item 6)."""
+    tmp_path, out = parity_artifacts
+    assert out["accuracies"] == {
+        "logistic_regression": pytest.approx(999 / 1625),
+        "logistic_regression_cv": pytest.approx(1161 / 1625),
+        "decision_tree": pytest.approx(1187 / 1625),
+        "random_forest": pytest.approx(1027 / 1625),
+    }
+    ours = open(tmp_path / "result.txt").read().splitlines()
+    ref = _reference_lines()
+    assert len(ours) == len(ref)
+    for i, (a, b) in enumerate(zip(ours, ref), start=1):
+        if i in _UID_TIMING_LINES:
+            assert _masked(a) == _masked(b), f"line {i} structure differs"
+        elif i in _LR_PROB_LINES:
+            shared = 0
+            for x, y in zip(a, b):
+                if x != y:
+                    break
+                shared += 1
+            assert shared >= 15 and a[:5] == b[:5], (
+                f"line {i}: only {shared} shared chars\n ours: {a!r}\n"
+                f"  ref: {b!r}"
+            )
+        else:
+            assert a == b, (
+                f"line {i} differs:\n ours: {a!r}\n  ref: {b!r}"
+            )
+
+
+@pytest.mark.slow
+def test_csv_value_parity(parity_artifacts):
+    """Both metrics CSVs match the reference's on every value column at
+    full float64 repr (classifier-name and timing columns are the
+    run-specific exclusions)."""
+    import csv as _csv
+
+    tmp_path, _ = parity_artifacts
+    ref_dir = os.path.dirname(REFERENCE_RESULT)
+    for fname in (
+        "additional_param.csv",
+        "crossFold_additional_param.csv",
+    ):
+        ours = list(_csv.reader(open(os.path.join(tmp_path, fname))))
+        ref = list(_csv.reader(open(os.path.join(ref_dir, fname))))
+        assert len(ours) == len(ref), fname
+        skip_cols = {0, 7, 8}  # Classifier, train time, test time
+        for i, (ra, rb) in enumerate(zip(ours, ref)):
+            va = [v for j, v in enumerate(ra) if j not in skip_cols]
+            vb = [v for j, v in enumerate(rb) if j not in skip_cols]
+            assert va == vb, f"{fname} row {i}: {va} vs {vb}"
+
+
 def test_section_sequence(prefix_report):
     """Banner/section order equals the reference's (SURVEY §1 layers)."""
     def sections(lines):
