@@ -139,6 +139,9 @@ class FusedBiLSTMLayer(nn.Module):
             jnp.zeros((2, b, h), jnp.float32),
             jnp.zeros((2, b, h), jnp.float32),
         )
+        # unroll factors 2-8 were measured and don't beat the plain loop
+        # (the serial dependency, not loop-trip overhead, is the bound —
+        # docs/bilstm_profile.md has the arithmetic)
         _, hs = jax.lax.scan(step, init, xproj.transpose(2, 0, 1, 3))
         # (T, 2, B, H): undo the backward direction's time reversal
         fwd = hs[:, 0].transpose(1, 0, 2)

@@ -66,6 +66,14 @@ def mllib_split_candidates(x: np.ndarray, max_bins: int) -> np.ndarray:
     reference's DT/RF searched (Main/main.py:297,478), so gains — and
     trees — line up with the captured run.
 
+    Parity scope (ADVICE r2): Spark computes candidates on a SAMPLE when
+    n > max(maxBins², 10000); WISDM's 3,793 rows are below that
+    threshold, so this unsampled walk is exact here, but above it the
+    candidate set (and the parity claim) diverges — and the host-side
+    per-feature np.unique loop is also slower than the on-device
+    "quantile" method for large non-binary data.  Prefer
+    split_candidates="quantile" off the WISDM parity lanes.
+
     Unused candidate slots are padded with ``+inf``: their "splits" route
     every row left and are rejected by the min-instances guard.
     """

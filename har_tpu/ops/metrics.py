@@ -173,6 +173,8 @@ def evaluate(labels, raw_scores, num_classes, positive_class=1) -> dict[str, flo
     """
     import numpy as np
 
+    # numpy<2 has no np.trapezoid (ADVICE r2: unbounded numpy dep)
+    _trapezoid = getattr(np, "trapezoid", None) or np.trapz
     y = np.asarray(labels).astype(np.int64)
     raw = np.asarray(raw_scores, np.float64)
     pred = raw.argmax(-1)
@@ -227,11 +229,11 @@ def evaluate(labels, raw_scores, num_classes, positive_class=1) -> dict[str, flo
         n_tot = max(n - pos.sum(), 1e-300)
         tpr = np.concatenate([[0.0], tp_c / p_tot])
         fpr = np.concatenate([[0.0], fp_c / n_tot])
-        auroc = float(np.trapezoid(tpr, fpr))
+        auroc = float(_trapezoid(tpr, fpr))
         prec_c = tp_c / np.maximum(tp_c + fp_c, 1e-300)
         rec_c = tp_c / p_tot
         aupr = float(
-            np.trapezoid(
+            _trapezoid(
                 np.concatenate([prec_c[:1], prec_c]),
                 np.concatenate([[0.0], rec_c]),
             )

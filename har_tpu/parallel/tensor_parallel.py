@@ -166,6 +166,45 @@ def make_gspmd_scan_fit(
     return jax.jit(fit, donate_argnums=(0, 1))
 
 
+def make_gspmd_train_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    augment: Callable | None = None,
+) -> Callable:
+    """Per-batch GSPMD step for the STREAMING trainer path under tp>1.
+
+    step(params, opt_state, rng, x, y, mask) → (params, opt_state, loss).
+    Params arrive tp-sharded (`shard_params`); the host feeds each batch
+    already dp-sharded (trainer.batch_sharding), and XLA propagates the
+    layout — inserting the tp all-reduces and dp gradient reduction —
+    exactly as in make_gspmd_scan_fit, one dispatch per batch instead of
+    one per run.  The per-row mask doubles as the class-weight carrier,
+    like the data-parallel streaming step.
+    """
+
+    def step(params, opt_state, rng, x, y, mask):
+        if augment is not None:
+            # same rng decorrelation convention as the scan paths
+            x = augment(jax.random.fold_in(rng, 1), x)
+
+        def mean_loss(p):
+            logits = apply_fn(
+                {"params": p}, x, train=True, rngs={"dropout": rng}
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            )
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        loss, grads = jax.value_and_grad(mean_loss)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 def tp_dim_check(params, specs, tp: int) -> None:
     """Refuse silently-unsharded layouts: every tp-sharded dim must divide."""
     def check(x, s):

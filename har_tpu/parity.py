@@ -123,4 +123,11 @@ def parity_run(
         accuracies[name] = float(metrics["accuracy"])
 
     paths = report.save()
+    from har_tpu.reporting.charts import save_metric_charts
+
+    charts = save_metric_charts(
+        paths.get("csv"), paths.get("cv_csv"), output_dir
+    )
+    if charts:
+        paths["charts"] = os.path.dirname(charts[0])
     return {"accuracies": accuracies, "artifacts": paths}

@@ -171,12 +171,8 @@ def test_early_stopping_validation():
     )
     with pytest.raises(ValueError, match="validation_fraction"):
         mk(validation_fraction=0.0).fit(x, y)
-    with pytest.raises(ValueError, match="scanned path"):
-        Trainer(
-            MLP(num_classes=2),
-            TrainerConfig(early_stop_patience=2),
-            scan=False,
-        ).fit(x, y)
+    # round 3: early stopping works on the streaming path too — parity
+    # covered in tests/test_trainer_streaming.py
 
 
 def test_early_stopping_composes_with_checkpointing(tmp_path):

@@ -1,0 +1,44 @@
+
+
+def test_metric_charts_written(tmp_path):
+    """The Graph.xlsx role: 8 chart PNGs rendered from the two CSVs
+    (VERDICT r2 missing #3)."""
+    import csv as _csv
+
+    from har_tpu.reporting.charts import save_metric_charts
+
+    plain = tmp_path / "additional_param.csv"
+    cv = tmp_path / "crossFold_additional_param.csv"
+    with open(plain, "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(
+            ["Classifier", "Count Total", "Correct", "Wrong",
+             "Ratio Wrong", "Ratio Correct", "F1 Score",
+             "Training Time", "Testing Time", "Accuracy"]
+        )
+        w.writerow(["LogisticRegression_ab12", 10, 6, 4, 0.4, 0.6,
+                    0.55, 1.2, 0.1, 0.6])
+        w.writerow(["DecisionTreeClassificationModel_cd34", 10, 7, 3,
+                    0.3, 0.7, 0.65, 2.0, 0.2, 0.7])
+    with open(cv, "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(
+            ["Classifier", "Count Total", "Correct", "Wrong",
+             "Ratio Wrong", "Ratio Correct", "F1 Score",
+             "Cross Validation Training Time",
+             "Cross Validation Testing Time", "Cross Fold Accuracy"]
+        )
+        w.writerow(["LogisticRegression_ab12", 10, 7, 3, 0.3, 0.7,
+                    0.6, 10.0, 0.05, 0.7])
+    out = save_metric_charts(str(plain), str(cv), str(tmp_path / "charts"))
+    assert len(out) == 8
+    import os
+
+    names = sorted(os.path.basename(p) for p in out)
+    assert names == sorted(
+        ["Graph Accuracy.png", "Graph F1 Score.png",
+         "Graph Training Time.png", "Graph Testing Time.png",
+         "Graph CV Accuracy.png", "Graph CV F1 Score.png",
+         "Graph CV Training Time.png", "Graph CV Testing Time.png"]
+    )
+    assert all(os.path.getsize(p) > 1000 for p in out)

@@ -1,0 +1,113 @@
+"""Streaming-path feature parity (VERDICT r2 item 7): the scan=False
+trainer supports augmentation, early stopping, mid-training
+checkpointing and tp>1 just like the scanned path."""
+
+import numpy as np
+import pytest
+
+
+def _toy(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (np.abs(x[:, 0] * 2 + x[:, 1]) * classes % classes).astype(np.int32)
+    return x, y
+
+
+def _trainer(scan, cfg=None, mesh=None, augment=None):
+    from har_tpu.models.neural import MODEL_REGISTRY
+    from har_tpu.train.trainer import Trainer, TrainerConfig
+
+    module = MODEL_REGISTRY["mlp"](hidden=(16,), num_classes=3)
+    return Trainer(
+        module,
+        config=cfg or TrainerConfig(batch_size=64, epochs=3),
+        mesh=mesh,
+        scan=scan,
+        augment=augment,
+    )
+
+
+def test_streaming_augment_runs():
+    from har_tpu.train.trainer import TrainerConfig
+
+    x, y = _toy()
+
+    def augment(key, xb):
+        import jax
+
+        return xb + 0.01 * jax.random.normal(key, xb.shape)
+
+    model = _trainer(
+        scan=False,
+        cfg=TrainerConfig(batch_size=64, epochs=2),
+        augment=augment,
+    ).fit(x, y, num_classes=3)
+    assert len(model.history["loss"]) == 2
+
+
+def test_streaming_early_stop_returns_best():
+    from har_tpu.train.trainer import TrainerConfig
+
+    x, y = _toy()
+    cfg = TrainerConfig(
+        batch_size=64,
+        epochs=20,
+        early_stop_patience=2,
+        validation_fraction=0.25,
+    )
+    model = _trainer(scan=False, cfg=cfg).fit(x, y, num_classes=3)
+    h = model.history
+    assert "val_accuracy" in h and "best_epoch" in h
+    assert h["stopped_epoch"] <= 20
+    assert len(h["val_accuracy"]) == h["stopped_epoch"]
+
+
+def test_streaming_checkpoint_resume(tmp_path):
+    from har_tpu.train.trainer import TrainerConfig
+
+    x, y = _toy()
+    cfg = TrainerConfig(
+        batch_size=64,
+        epochs=4,
+        checkpoint_dir=str(tmp_path),
+        save_every_epochs=2,
+        seed=3,
+    )
+    m1 = _trainer(scan=False, cfg=cfg).fit(x, y, num_classes=3)
+    # resume: a fresh fit finds the completed snapshot and (having no
+    # epochs left) serves it without retraining
+    m2 = _trainer(scan=False, cfg=cfg).fit(x, y, num_classes=3)
+    assert m2.history.get("resumed_from_epoch") == 4
+    for a, b in zip(_leaves(m1.params), _leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def test_streaming_tp_trains_sharded():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    from har_tpu.parallel import create_mesh
+    from har_tpu.train.trainer import TrainerConfig
+
+    mesh = create_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    x, y = _toy()
+    model = _trainer(
+        scan=False,
+        cfg=TrainerConfig(batch_size=64, epochs=2),
+        mesh=mesh,
+    ).fit(x, y, num_classes=3)
+    assert len(model.history["loss"]) == 2
+    # same-loss sanity vs single-device streaming run
+    single = _trainer(
+        scan=False, cfg=TrainerConfig(batch_size=64, epochs=2)
+    ).fit(x, y, num_classes=3)
+    assert abs(
+        model.history["loss"][-1] - single.history["loss"][-1]
+    ) < 0.2
