@@ -145,6 +145,21 @@ def _parser() -> argparse.ArgumentParser:
     s.add_argument("--output-dir", default="main_result")
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
+
+    pa = sub.add_parser(
+        "parity",
+        help="reproduce the reference's result.txt byte-for-byte "
+             "(bit-exact MLlib replays: LR, LR-CV, DT, RF)",
+    )
+    pa.add_argument("--data-path", default=None)
+    pa.add_argument("--output-dir", default="parity_result")
+    pa.add_argument(
+        "--blocks",
+        nargs="+",
+        default=["lr", "lr_cv", "dt", "rf"],
+        choices=["lr", "lr_cv", "dt", "rf"],
+        help="which reference blocks to run (default: all four)",
+    )
     return p
 
 
@@ -166,6 +181,22 @@ def main(argv=None) -> int:
         import bench
 
         bench.main()
+        return 0
+
+    if args.command == "parity":
+        from har_tpu.parity import parity_run
+
+        config = None
+        if args.data_path is not None:
+            # output_dir comes from parity_run's positional arg — the
+            # single source of truth (it overwrites the config's anyway)
+            config = RunConfig(
+                data=DataConfig(dataset="wisdm", path=args.data_path)
+            )
+        out = parity_run(
+            args.output_dir, config=config, blocks=tuple(args.blocks)
+        )
+        print(json.dumps(out))
         return 0
 
     if args.command == "sweep":

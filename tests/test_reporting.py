@@ -285,3 +285,29 @@ def test_run_with_partial_device_mesh(tmp_path):
     )
     outcome = run(config, models=["mlp"], with_cv=False)
     assert 0.0 <= outcome.accuracies["mlp"] <= 1.0
+
+
+@pytest.mark.slow
+def test_cli_parity_subcommand(tmp_path, capsys):
+    """`har parity` runs the reference-exact pipeline and reports the
+    four exact block accuracies."""
+    import json as _json
+    import os
+
+    from tests.conftest import has_reference_data
+
+    if not has_reference_data():
+        pytest.skip("reference WISDM CSV not mounted")
+    from har_tpu.models import _jvm_native
+
+    if not _jvm_native.available():
+        pytest.skip("native JVM-parity kernel unavailable")
+    from har_tpu.cli import main
+
+    rc = main(["parity", "--output-dir", str(tmp_path), "--blocks", "lr"])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["accuracies"]["logistic_regression"] == pytest.approx(
+        999 / 1625
+    )
+    assert os.path.exists(tmp_path / "result.txt")
