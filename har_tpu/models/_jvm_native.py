@@ -85,7 +85,11 @@ def _ptr(a: np.ndarray, ctype):
 
 
 def set_math_backend(backend: int) -> None:
-    """0 = fdlibm (JDK StrictMath), 1 = platform libm; oracle arbiter."""
+    """Transcendental family for the replay kernels; oracle arbiter.
+
+    0 = fdlibm (JDK StrictMath — the production default), 1 = platform
+    libm, 2 = long-double round-trip (x87-style double rounding on x86
+    only).  Anything else clamps to 0."""
     load().set_math_backend(int(backend))
 
 

@@ -179,22 +179,39 @@ double fdlibm_log(double x) {
 
 // Which transcendental family the replay uses: 0 = fdlibm (JDK StrictMath,
 // and Math.exp/log on the JDK 8 era the reference ran), 1 = the platform
-// libm — kept switchable so the oracle (result.txt's 16-digit probability
-// strings) can arbitrate empirically.
+// libm, 2 = long-double round-trip (approximates x87 double rounding ONLY
+// where long double is the 80-bit extended type, i.e. x86; elsewhere it
+// is just extra precision) — kept switchable so the oracle (result.txt's
+// 16-digit probability strings) can arbitrate empirically.  Unknown
+// values are clamped to fdlibm, the production default.
 int g_math_backend = 0;
 
 inline double exp_impl(double x) {
-  return g_math_backend == 0 ? fdlibm_exp(x) : std::exp(x);
+  switch (g_math_backend) {
+    case 0: return fdlibm_exp(x);
+    case 1: return std::exp(x);
+    default:
+      // x87-style double rounding: 80-bit extended result rounded to
+      // double (what a JIT'd x87 transcendental would produce)
+      return static_cast<double>(expl(static_cast<long double>(x)));
+  }
 }
 inline double log_impl(double x) {
-  return g_math_backend == 0 ? fdlibm_log(x) : std::log(x);
+  switch (g_math_backend) {
+    case 0: return fdlibm_log(x);
+    case 1: return std::log(x);
+    default:
+      return static_cast<double>(logl(static_cast<long double>(x)));
+  }
 }
 
 }  // namespace
 
 extern "C" {
 
-void set_math_backend(int backend) { g_math_backend = backend; }
+void set_math_backend(int backend) {
+  g_math_backend = (backend == 1 || backend == 2) ? backend : 0;
+}
 
 double jvm_exp(double x) { return exp_impl(x); }
 double jvm_log(double x) { return log_impl(x); }

@@ -1,0 +1,123 @@
+"""Long-context attention artifact: Pallas flash vs XLA attention on TPU.
+
+Long sequences are first-class in this framework (SURVEY §5.7 marks them
+out of the reference's scope; we ship them anyway): ops/flash_attention
+streams K/V blocks through VMEM with the running-softmax recurrence, and
+Transformer1D auto-switches to it at T >= 2048.  This script measures
+both attention paths at long window lengths on the real chip and writes
+artifacts/long_context_bench.json:
+
+    python scripts/long_context_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+    from har_tpu.models.transformer import Transformer1D
+
+    results = []
+    for t_len, batch in ((1024, 32), (2048, 16), (4096, 8), (8192, 4), (16384, 4)):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.normal(size=(batch, t_len, 3)), jnp.float32
+        )
+        row = {"seq_len": t_len, "batch": batch}
+        for use_flash in (False, True):
+            key = "flash_ms" if use_flash else "xla_ms"
+            model = Transformer1D(
+                num_classes=6,
+                embed_dim=128,
+                num_heads=4,
+                num_layers=2,
+                use_flash=use_flash,
+            )
+            try:
+                params = model.init(
+                    jax.random.PRNGKey(0), x[:1], train=False
+                )["params"]
+            except Exception:
+                row[key] = "OOM"  # init already materializes the scores
+                continue
+            # amortize the ~80 ms remote-dispatch latency: run the
+            # forward REPEAT times inside ONE program (fori_loop with a
+            # scalar carry so nothing is dead-code-eliminated)
+            REPEAT = 50
+
+            def many(p, xb):
+                def body(_, acc):
+                    return acc + model.apply({"params": p}, xb).sum()
+
+                return jax.lax.fori_loop(0, REPEAT, body, jnp.float32(0))
+
+            fwd = jax.jit(many)
+            try:
+                # np.asarray forces materialization — on the axon remote
+                # backend block_until_ready returns before execution ends
+                np.asarray(fwd(params, x))  # compile + run
+            except Exception:
+                # the (B, H, T, T) score materialization blows HBM at
+                # long T — the axis where the streaming flash kernel is
+                # the only option
+                row[key] = "OOM"
+                continue
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(fwd(params, x))
+                times.append((time.perf_counter() - t0) / REPEAT)
+            row[key] = round(float(np.median(times)) * 1e3, 2)
+        if isinstance(row.get("xla_ms"), float) and isinstance(
+            row.get("flash_ms"), float
+        ):
+            row["speedup"] = round(row["xla_ms"] / row["flash_ms"], 2)
+        results.append(row)
+        print(json.dumps(row))
+
+    out_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts",
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "long_context_bench.json"), "w") as f:
+        json.dump(
+            {
+                "backend": jax.default_backend(),
+                "note": (
+                    "per-forward time, median of 3 x 50-iteration "
+                    "compiled loops (dispatch amortized), Transformer1D "
+                    "embed 128 x 2 layers; flash = Pallas "
+                    "streaming-softmax kernel.  Honest finding: XLA's "
+                    "own attention fusion already streams the softmax "
+                    "at these shapes (it runs T=16384 where a "
+                    "materialized (B,H,T,T) would need 17G), so the "
+                    "Pallas kernel MATCHES rather than beats it on one "
+                    "chip; its value here is the ring-attention "
+                    "composition (parallel/ring_attention.py), where "
+                    "the sequence is sharded across devices"
+                ),
+                "rows": results,
+            },
+            f,
+            indent=2,
+        )
+    print("wrote artifacts/long_context_bench.json")
+
+
+if __name__ == "__main__":
+    main()

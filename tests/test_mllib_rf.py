@@ -80,3 +80,41 @@ def test_rf_poisson_weights_mean():
     assert w.shape == (2000, 50)
     assert np.all(w == np.floor(w)) and np.all(w >= 0)
     assert abs(w.mean() - 1.0) < 0.01
+
+
+def test_java_random_known_values():
+    """java.util.Random LCG against its published stream for seed 0:
+    new Random(0).nextLong() is the well-known -4962768465676381896."""
+    from har_tpu.models.mllib_rf import JavaRandom
+
+    r = JavaRandom(0)
+    assert r.next_long() == -4962768465676381896
+    # nextInt() values for seed 42 (first two draws of next(32))
+    r = JavaRandom(42)
+    assert r.next(32) == -1170105035
+    assert r.next(32) == 234785527
+
+
+def test_reservoir_matches_python_reference():
+    """The native reservoir equals a straight-line Python XORShift walk."""
+    from har_tpu.data.spark_random import XORShiftRandom, xorshift_hash_seed
+    from har_tpu.models import _jvm_native
+
+    if not _jvm_native.available():
+        import pytest
+
+        pytest.skip("native JVM-parity kernel unavailable")
+    seed = 987654321
+    n, k = 200, 14
+    native = _jvm_native.reservoir_sample_range(
+        xorshift_hash_seed(seed), n, k
+    )
+    rng = XORShiftRandom(seed)
+    res = list(range(k))
+    length = k
+    for item in range(k, n):
+        length += 1
+        replacement = int(rng.next_double() * length)
+        if replacement < k:
+            res[replacement] = item
+    assert list(native) == res
