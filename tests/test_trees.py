@@ -82,7 +82,7 @@ def _parity_features(wisdm_csv_path):
     from bench import load_features, load_table
     from har_tpu.data.spark_split import spark_split_indices
 
-    table = load_table()
+    table, _is_real = load_table()
     tr, te = spark_split_indices(table, [0.7, 0.3], seed=2018)
     return load_features(table, tr, te)
 
