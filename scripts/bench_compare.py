@@ -1,0 +1,111 @@
+"""Lane-for-lane comparison of two bench result JSONs.
+
+The bench emits per-lane configs and run variance (`lanes` block) so
+that consecutive rounds can be compared honestly (VERDICT r2 item 4).
+This tool does the comparison: for every lane present in both files it
+prints the throughput delta, flags config changes (a delta with a config
+change is a CONFIG note, not a regression), and uses the reported std to
+say whether a delta clears the noise floor.
+
+    python scripts/bench_compare.py BENCH_r02.json BENCH_r03.json
+
+Reading the output: the exact parity accuracies and the saturation lane
+(compute-bound, measured inside one program) are the STABLE comparators
+— they reproduce run-over-run to the last digit / ~1%.  The raw
+windows/s of the small-model lanes are dispatch-bound through the remote
+chip tunnel and additionally swing with HOST load (a concurrent CPU job
+depresses them 15-30% beyond their own reported std), so treat their
+"REGRESSION" flags as a prompt to re-run solo before concluding anything.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _lanes(doc: dict) -> dict:
+    return doc.get("extra", {}).get("lanes", {}) or {}
+
+
+def _fmt(v) -> str:
+    if isinstance(v, (int, float)):
+        return f"{v:,.0f}" if abs(v) >= 1000 else f"{v:.4g}"
+    return str(v)
+
+
+def _load(path: str) -> dict:
+    doc = json.load(open(path))
+    # the round driver wraps the bench line: {"cmd":..., "parsed": {...}}
+    return doc.get("parsed", doc)
+
+
+def compare(old_path: str, new_path: str) -> int:
+    old_doc = _load(old_path)
+    new_doc = _load(new_path)
+    old_lanes, new_lanes = _lanes(old_doc), _lanes(new_doc)
+
+    print(f"headline: {old_doc.get('value')} -> {new_doc.get('value')} "
+          f"{old_doc.get('unit', '')}")
+    if not old_lanes or not new_lanes:
+        print(
+            "note: one side predates per-lane stats (r03+); only the "
+            "headline and flat extras can be compared"
+        )
+
+    regressions = 0
+    for name in sorted(set(old_lanes) & set(new_lanes)):
+        a, b = old_lanes[name], new_lanes[name]
+        wa = a.get("windows_per_sec_median")
+        wb = b.get("windows_per_sec_median")
+        if wa is None or wb is None or not wa:
+            continue
+        delta_pct = (wb - wa) / wa * 100.0
+        noise = (
+            (a.get("windows_per_sec_std", 0.0) +
+             b.get("windows_per_sec_std", 0.0))
+            / max(wa, 1e-9) * 100.0
+        )
+        config_changed = a.get("config") != b.get("config")
+        if config_changed:
+            tag = "CONFIG CHANGED"
+            diff_keys = [
+                k
+                for k in set(a.get("config", {})) | set(b.get("config", {}))
+                if a.get("config", {}).get(k) != b.get("config", {}).get(k)
+            ]
+            detail = f" ({', '.join(sorted(diff_keys))})"
+        elif abs(delta_pct) <= max(noise, 10.0):
+            tag, detail = "within noise", ""
+        elif delta_pct < 0:
+            tag, detail = "REGRESSION", ""
+            regressions += 1
+        else:
+            tag, detail = "improvement", ""
+        print(
+            f"  {name:24s} {_fmt(wa):>12s} -> {_fmt(wb):>12s} w/s "
+            f"({delta_pct:+.1f}%, noise ±{noise:.1f}%)  {tag}{detail}"
+        )
+
+    # flat extras worth tracking across rounds even without lane stats
+    for key in (
+        "lr_parity_test_accuracy",
+        "rf_parity_test_accuracy",
+        "lr_cv_mllib_objective_test_accuracy",
+        "dt_parity_test_accuracy",
+        "gbdt_test_accuracy",
+        "saturation_mfu_pct",
+        "saturation_steady_mfu_pct",
+    ):
+        va = old_doc.get("extra", {}).get(key)
+        vb = new_doc.get("extra", {}).get(key)
+        if va is not None or vb is not None:
+            marker = "" if va == vb else "  <-- changed"
+            print(f"  {key:40s} {va} -> {vb}{marker}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    sys.exit(compare(sys.argv[1], sys.argv[2]))
