@@ -342,6 +342,13 @@ class LogisticRegression:
     # (WISDM: Standing 246 vs Walking 2081) pull equally on the loss
     class_weight: str | None = None
     num_classes: int | None = None  # inferred from labels when None
+    # optional jax.sharding.Mesh: cv_scores shards the grid axis over
+    # its data axis so independent (reg × fold) fits train on separate
+    # devices — SURVEY §2c.2's task parallelism ACROSS devices, not
+    # just vmapped on one.  fit() ignores it (one fit = one program).
+    mesh: object | None = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     def copy_with(self, **params) -> "LogisticRegression":
         return dataclasses.replace(self, **params)
@@ -377,13 +384,40 @@ class LogisticRegression:
             enp = float(g.get("elastic_net_param", self.elastic_net_param))
             by_enp.setdefault(enp, []).append(i)
         for enp, idxs in by_enp.items():
-            regs = jnp.asarray(
-                [
-                    float(grid[i].get("reg_param", self.reg_param))
-                    for i in idxs
-                ],
-                jnp.float32,
-            )
+            reg_vals = [
+                float(grid[i].get("reg_param", self.reg_param))
+                for i in idxs
+            ]
+            n_real = len(reg_vals)
+            regs = jnp.asarray(reg_vals, jnp.float32)
+            axes = self._mesh_data_axes()
+            if axes:
+                # shard the grid axis over the mesh's data axis: each
+                # device trains its slice of the (reg × fold) matrix —
+                # GSPMD partitions the vmap lanes, which are independent
+                # fits.  Pad to a multiple of the shard count (padding
+                # lanes repeat the last reg; dropped below).  Single-
+                # process meshes only: the host gathers the score matrix
+                # with np.asarray below.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from har_tpu.parallel.mesh import data_shard_count
+
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "mesh-sharded cv_scores supports single-process "
+                        "meshes; drop the mesh (or gather externally) "
+                        "for multi-host sweeps"
+                    )
+                shards = data_shard_count(self.mesh)
+                pad = (-n_real) % shards
+                if pad:
+                    regs = jnp.concatenate(
+                        [regs, jnp.repeat(regs[-1:], pad)]
+                    )
+                regs = jax.device_put(
+                    regs, NamedSharding(self.mesh, PartitionSpec(axes))
+                )
             out = _cv_scores_group(
                 x, y, jnp.asarray(tidx), jnp.asarray(tw),
                 jnp.asarray(vidx), jnp.asarray(vw), regs,
@@ -394,8 +428,16 @@ class LogisticRegression:
                 standardize=self.standardize,
                 metric=metric,
             )
-            scores[idxs] = np.asarray(out, np.float64)
+            scores[idxs] = np.asarray(out, np.float64)[:n_real]
         return scores
+
+    def _mesh_data_axes(self) -> tuple:
+        """Data axes of the attached mesh ('dp' [+ 'dp_dcn']), or ()."""
+        if self.mesh is None:
+            return ()
+        from har_tpu.parallel.mesh import data_axes
+
+        return data_axes(self.mesh)
 
     def fit(self, data: FeatureSet) -> "LogisticRegressionModel":
         if self.class_weight not in (None, "balanced"):

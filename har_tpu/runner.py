@@ -91,6 +91,7 @@ def _known_params() -> set[str]:
         for cls in _CLASSICAL.values()
         for f in dataclasses.fields(cls)
     } | {f.name for f in dataclasses.fields(TrainerConfig)} | {"augment"}
+    known.discard("mesh")  # infrastructure field, not a hyperparameter
     for name in _NEURAL:
         known |= _neural_model_fields(name)
     return known
@@ -134,7 +135,19 @@ def build_estimator(name: str, params: dict | None = None, mesh=None):
                 UserWarning,
                 stacklevel=2,
             )
-        return cls(**{k: v for k, v in params.items() if k in fields})
+        # "mesh" is infrastructure, not a hyperparameter: a params-dict
+        # mesh would bypass type checks and collide with the mesh arg
+        kwargs = {
+            k: v
+            for k, v in params.items()
+            if k in fields and k != "mesh"
+        }
+        if mesh is not None and "mesh" in fields:
+            # classical estimators with a device-parallel sweep (LR's
+            # cv_scores shards the grid axis) get the mesh; plain fits
+            # ignore it
+            kwargs["mesh"] = mesh
+        return cls(**kwargs)
     if name in _NEURAL:
         train_keys = {f.name for f in dataclasses.fields(TrainerConfig)}
         cfg = TrainerConfig(

@@ -139,3 +139,41 @@ def test_class_weight_balanced():
     assert recall_minority(balanced) > recall_minority(plain)
     with pytest.raises(ValueError, match="class_weight"):
         LogisticRegression(class_weight="nope").fit(data)
+
+
+def test_cv_scores_grid_sharded_over_mesh():
+    """cv_scores with a mesh shards the grid axis over dp: the sharded
+    sweep selects the same winner and scores match the single-device
+    sweep (independent lanes — partitioning must not change the math
+    beyond tiling-level float noise)."""
+    import jax
+    import numpy as np
+    import pytest
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 virtual devices")
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.logistic_regression import LogisticRegression
+    from har_tpu.parallel import create_mesh
+    from har_tpu.tuning.cross_validator import kfold_indices, param_grid
+
+    rng = np.random.default_rng(0)
+    n, d = 240, 12
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, 3)).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int32)
+    data = FeatureSet(features=x, label=y)
+    folds = kfold_indices(n, 3, seed=0)
+    grid = param_grid(reg_param=[0.01, 0.03, 0.1, 0.3, 0.5])  # 5 % 4 != 0
+
+    base = LogisticRegression(max_iter=15)
+    mesh = create_mesh(dp=4, tp=1, devices=jax.devices()[:4])
+    plain = base.cv_scores(data, folds, grid, "accuracy")
+    sharded = base.copy_with(mesh=mesh).cv_scores(
+        data, folds, grid, "accuracy"
+    )
+    assert sharded.shape == plain.shape == (5, 3)
+    np.testing.assert_allclose(sharded, plain, atol=2e-3)
+    assert int(np.argmax(sharded.mean(1))) == int(
+        np.argmax(plain.mean(1))
+    )
