@@ -18,8 +18,18 @@ from har_tpu.models.ensemble import (
     VotingModel,
     seed_ensemble,
 )
+from har_tpu.models.mllib_exact import (
+    CrossValidatorExact,
+    ExactDesign,
+    LogisticRegressionExact,
+    RandomForestExact,
+)
 
 __all__ = [
+    "CrossValidatorExact",
+    "ExactDesign",
+    "LogisticRegressionExact",
+    "RandomForestExact",
     "Predictions",
     "Classifier",
     "ClassifierModel",
