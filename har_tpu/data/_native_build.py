@@ -67,10 +67,34 @@ class NativeLib:
             if err is not None:
                 self.build_error = err
                 return None
-            lib = ctypes.CDLL(self._so)
-            self._configure(lib)
+            try:
+                lib = self._load_and_configure()
+            except (OSError, AttributeError):
+                # a stale shipped .so (e.g. checked out with arbitrary
+                # mtimes so the staleness check passed) may miss newer
+                # symbols — force ONE rebuild from the present source
+                # before degrading to unavailable (never raise through
+                # every consumer's available() fallback)
+                try:
+                    os.remove(self._so)
+                except OSError:
+                    pass
+                err = self._build()
+                if err is not None:
+                    self.build_error = err
+                    return None
+                try:
+                    lib = self._load_and_configure()
+                except (OSError, AttributeError) as e:
+                    self.build_error = f"native library unusable: {e}"
+                    return None
             self._lib = lib
             return self._lib
+
+    def _load_and_configure(self) -> ctypes.CDLL:
+        lib = ctypes.CDLL(self._so)
+        self._configure(lib)
+        return lib
 
     def available(self) -> bool:
         return self.load() is not None
