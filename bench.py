@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import pathlib
 import sys
 import time
 
@@ -525,6 +526,17 @@ def main() -> None:
         "vs_baseline": round(windows_per_sec / REFERENCE_ROWS_PER_SEC, 2),
         "extra": extra,
     }
+    # Durable copy FIRST (VERDICT r3 weak #5): the round driver keeps only
+    # the last 2000 bytes of stdout, which truncated r3's parity keys out
+    # of existence.  The full dict always lands in artifacts/ so no number
+    # depends on the tail window; bench_compare accepts this file as-is.
+    try:
+        art = pathlib.Path(__file__).resolve().parent / "artifacts"
+        art.mkdir(exist_ok=True)
+        (art / "bench_latest.json").write_text(json.dumps(result, indent=1))
+    except OSError as e:  # a read-only checkout must not kill the print
+        print(f"warning: could not write artifacts/bench_latest.json: {e}",
+              file=sys.stderr)
     print(json.dumps(result))
 
 

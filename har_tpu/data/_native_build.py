@@ -10,10 +10,19 @@ only declare their symbol tables.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Callable
+
+# Exported by every .so we build (see _build): the sha256 of the source
+# it was compiled from.  _load_and_configure verifies it against the
+# on-disk source, so a stale shipped binary can never masquerade as
+# current — git checkouts don't preserve mtimes, which made the old
+# mtime-only staleness check unsound for committed .so files.
+_HASH_SYMBOL = "har_native_source_hash"
 
 
 class NativeLib:
@@ -34,30 +43,76 @@ class NativeLib:
         self._lib: ctypes.CDLL | None = None
         self.build_error: str | None = None
 
-    def _build(self) -> str | None:
-        """Compile if stale; returns an error string or None."""
+    def _source_hash(self) -> str | None:
         try:
-            if os.path.exists(self._so) and os.path.getmtime(
-                self._so
-            ) >= os.path.getmtime(self._src):
-                return None
-        except OSError as e:  # source missing alongside a shipped .so
-            if os.path.exists(self._so):
-                return None
-            return f"native source unavailable: {e}"
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            *self._extra_flags, self._src, "-o", self._so,
-        ]
+            with open(self._src, "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return None
+
+    def _build(self, force: bool = False) -> str | None:
+        """Compile if absent (or force=True); returns an error string or None.
+
+        Existence is the only fast-path here — true staleness (source
+        edited since the .so was built) is caught by the embedded-hash
+        check in _load_and_configure, which retries with force=True.
+        The compile goes to a temp path and lands via os.replace, so a
+        failed rebuild never destroys a working shipped binary and no
+        process can dlopen a half-written one.
+        """
+        if not force and os.path.exists(self._so):
+            return None
+        if not os.path.exists(self._src):
+            return "native source unavailable"
+        src_hash = self._source_hash()
+        if src_hash is None:
+            return "native source unreadable"
+        # a tiny second TU embeds the source hash as an exported symbol,
+        # so the binary itself carries its provenance.  The non-brace
+        # extern "C" form is load-bearing: it implies `extern` storage,
+        # without which a namespace-scope const char[] has internal
+        # linkage and never reaches the dynamic symbol table.
+        hash_cpp = (
+            f'extern "C" const char {_HASH_SYMBOL}[] = "{src_hash}";\n'
+        )
+        so_dir = os.path.dirname(self._so) or "."
+        hash_src = tmp_so = None
         try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=120
-            )
-        except (OSError, subprocess.TimeoutExpired) as e:
-            return f"g++ unavailable: {e}"
-        if proc.returncode != 0:
-            return f"native build failed: {proc.stderr[-500:]}"
-        return None
+            try:
+                fd, hash_src = tempfile.mkstemp(suffix=".cpp")
+                with os.fdopen(fd, "w") as tmp:
+                    tmp.write(hash_cpp)
+                tmp_so = os.path.join(
+                    so_dir,
+                    f".{os.path.basename(self._so)}.{os.getpid()}.tmp",
+                )
+            except OSError as e:  # unwritable temp dir degrades, not raises
+                return f"native build staging failed: {e}"
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                *self._extra_flags, self._src, hash_src, "-o", tmp_so,
+            ]
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=120
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                return f"g++ unavailable: {e}"
+            if proc.returncode != 0:
+                return f"native build failed: {proc.stderr[-500:]}"
+            try:
+                os.replace(tmp_so, self._so)
+            except OSError as e:
+                return f"native library install failed: {e}"
+            return None
+        finally:
+            for path in (hash_src, tmp_so):
+                if path is None:
+                    continue
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     def load(self) -> ctypes.CDLL | None:
         with self._lock:
@@ -70,16 +125,13 @@ class NativeLib:
             try:
                 lib = self._load_and_configure()
             except (OSError, AttributeError):
-                # a stale shipped .so (e.g. checked out with arbitrary
-                # mtimes so the staleness check passed) may miss newer
-                # symbols — force ONE rebuild from the present source
-                # before degrading to unavailable (never raise through
-                # every consumer's available() fallback)
-                try:
-                    os.remove(self._so)
-                except OSError:
-                    pass
-                err = self._build()
+                # a stale shipped .so (hash mismatch, missing provenance
+                # symbol, or missing newer symbols) — force ONE rebuild
+                # from the present source before degrading to unavailable
+                # (never raise through every consumer's available()
+                # fallback).  The stale binary stays on disk until the
+                # replacement lands (os.replace in _build).
+                err = self._build(force=True)
                 if err is not None:
                     self.build_error = err
                     return None
@@ -93,6 +145,42 @@ class NativeLib:
 
     def _load_and_configure(self) -> ctypes.CDLL:
         lib = ctypes.CDLL(self._so)
+        try:
+            return self._verify_and_configure(lib)
+        except Exception:
+            # unmap the rejected library: dlopen caches by pathname, so
+            # without dlclose the forced rebuild would reload THIS stale
+            # mapping instead of the fresh binary
+            try:
+                import _ctypes
+
+                _ctypes.dlclose(lib._handle)
+            except Exception:
+                pass
+            raise
+
+    def _verify_and_configure(self, lib: ctypes.CDLL) -> ctypes.CDLL:
+        # provenance check: the hash baked in at build time must match the
+        # present source.  A shipped .so predating the hash symbol raises
+        # AttributeError, a mismatched one OSError — both land in load()'s
+        # single forced-rebuild path.  If the source is gone entirely
+        # (binary-only install), the shipped binary is all there is: trust it.
+        src_hash = self._source_hash()
+        if src_hash is not None:
+            try:
+                arr = (ctypes.c_char * (len(src_hash) + 1)).in_dll(
+                    lib, _HASH_SYMBOL
+                )
+            except ValueError as e:  # symbol absent: pre-hash-era binary
+                raise OSError(
+                    f"native library lacks provenance symbol: {e}"
+                ) from e
+            embedded = arr.value.decode("ascii", "replace")
+            if embedded != src_hash:
+                raise OSError(
+                    f"stale native library {self._so}: built from source "
+                    f"{embedded[:12]}…, current source is {src_hash[:12]}…"
+                )
         self._configure(lib)
         return lib
 

@@ -467,11 +467,14 @@ class OWLQN(LBFGS):
         # a strict sequential accumulation (mapActive walks ascending)
         contrib = np.abs(l1 * x)
         mask = l1 != 0.0
-        # zero contributions leave the accumulator bit-identical (x+0.0==x
-        # for any non-negative running sum), so only nonzeros are folded —
-        # in index order, strictly sequentially, like mapActive's walk
+        # Breeze folds each |l1*x_i| into an accumulator INITIALIZED at
+        # newVal ((newVal+c0)+c1...), not newVal + (0+c0+c1...): start the
+        # sequential fold at new_val so the FP association matches exactly.
+        # Zero contributions leave the accumulator bit-identical (x+0.0==x
+        # for any finite non-negative x; new_val is a loss, never -0.0), so
+        # only nonzeros are folded — in index order, like mapActive's walk.
         nz = contrib[mask]
-        adj_value = new_val + _sequential_sum(nz[nz != 0.0])
+        adj_value = _sequential_sum(nz[nz != 0.0], init=new_val)
         delta_plus = v + l1
         delta_minus = v - l1
         at_zero = np.where(
@@ -485,9 +488,9 @@ class OWLQN(LBFGS):
         return adj_value, res
 
 
-def _sequential_sum(values: np.ndarray) -> float:
-    """Strict left-to-right sum (JVM accumulation order)."""
-    acc = 0.0
+def _sequential_sum(values: np.ndarray, init: float = 0.0) -> float:
+    """Strict left-to-right sum starting at init (JVM accumulation order)."""
+    acc = float(init)
     for v in values:
         acc += float(v)
     return acc

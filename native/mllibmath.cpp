@@ -502,11 +502,18 @@ extern "C" {
 // (n_rows, num_trees) Poisson(subsample) bootstrap counts, row-major,
 // exactly the BaggedPoint stream: one Well19937c seeded once with
 // (seed + partitionIndex + 1), rows outer, trees inner.
+//
+// Bit-exactness contract: parity is verified ONLY for subsample=1.0 (the
+// value MLlib's RandomForestClassifier always uses and the only one the
+// reference run exercises).  commons-math3 computes the rejection
+// threshold with FastMath.exp, which can differ from fdlibm_exp in the
+// last ulp for other arguments; exp(-1.0) is test-verified identical.
+// Port FastMath's table-driven exp before trusting non-unit subsample.
 void rf_poisson_weights(int64_t seed, int64_t n_rows, int64_t num_trees,
                         double subsample, double *out) {
   Well19937c rng;
   rng.seed_long(seed);
-  const double p = fdlibm_exp(-subsample);  // FastMath.exp(-mean)
+  const double p = fdlibm_exp(-subsample);  // FastMath.exp(-mean); see contract above
   for (int64_t r = 0; r < n_rows; ++r)
     for (int64_t t = 0; t < num_trees; ++t)
       out[r * num_trees + t] = static_cast<double>(rng.next_poisson(subsample, p));
