@@ -91,39 +91,71 @@ def load_features(table, tr, te, asm=None):
     return train, test
 
 
-def neural_lane(name, train_set, config, model_kwargs=None, runs=2):
+def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
+                peak=None):
     """(model, stats) — stats carries the lane's full config and run
     variance so consecutive bench runs are comparable lane-for-lane
     (VERDICT r2 weak #4: a bench that can't distinguish a regression
     from noise can't defend match-or-beat claims).
 
-    One compute_flops warmup fit records the compiled program's XLA flop
-    count (and pays compile); per-run dispatch latency through a remote
-    chip is noisy, so the headline rate is the best of `runs` plain
-    compiled executions, with median/std alongside.
+    Per-lane MFU (VERDICT r3 #1) comes in two flavors:
+      mfu_pct        — program flops over END-TO-END fit wall-clock; on
+                       short lanes this is dominated by the ~2-4 s fixed
+                       dispatch/transfer latency of the remote-chip
+                       tunnel, not the compiled program
+      steady_mfu_pct — flops over IN-PROGRAM step time, from the slope
+                       between a short (epochs/5) and the full fit; this
+                       is what the chip does once fed (scripts/
+                       mfu_tune.py validated slope-vs-long-run agreement)
+
+    The short fit doubles as the flops probe: XLA's cost analysis counts
+    the scanned body once (per-step), so the short program reports the
+    same per-step count as the full one.  The first full fit is a
+    compile/warmup run and is not timed; the headline rate is the best
+    of `runs` timed executions, with median/std alongside.
     """
     from har_tpu.models.neural_classifier import NeuralClassifier
 
-    warm_est = NeuralClassifier(
+    kwargs = dict(model_kwargs or {})
+    epochs_short = max(1, config.epochs // 5)
+    short_cfg = dataclasses.replace(
+        config, epochs=epochs_short, compute_flops=True
+    )
+    warm_short = NeuralClassifier(
+        name, config=short_cfg, model_kwargs=kwargs
+    ).fit(train_set)
+    per_step_flops = warm_short.history.get("program_flops_raw", 0.0)
+    short_est = NeuralClassifier(
         name,
-        config=dataclasses.replace(config, compute_flops=True),
-        model_kwargs=dict(model_kwargs or {}),
+        config=dataclasses.replace(config, epochs=epochs_short),
+        model_kwargs=kwargs,
     )
-    warm = warm_est.fit(train_set)
-    flops = warm.history.get("program_flops", 0.0)
-    est = NeuralClassifier(
-        name, config=config, model_kwargs=dict(model_kwargs or {})
+    t_short = min(
+        float(short_est.fit(train_set).history["train_time_s"])
+        for _ in range(2)
     )
+
+    est = NeuralClassifier(name, config=config, model_kwargs=kwargs)
+    est.fit(train_set)  # warmup: compile the full program
     results = [est.fit(train_set) for _ in range(runs)]
     wps = [float(r.history["windows_per_sec"]) for r in results]
     times = [float(r.history["train_time_s"]) for r in results]
+
+    steps_per_epoch = -(-len(train_set) // config.batch_size)
+    steps_full = steps_per_epoch * config.epochs
+    steps_short = steps_per_epoch * epochs_short
+    t_full = min(times)
+    step_s = max(
+        (t_full - t_short) / max(steps_full - steps_short, 1), 1e-9
+    )
+    program_flops = per_step_flops * steps_full
     stats = {
         "model": name,
         "config": {
             "batch_size": config.batch_size,
             "epochs": config.epochs,
             "learning_rate": config.learning_rate,
-            "model_kwargs": dict(model_kwargs or {}),
+            "model_kwargs": kwargs,
             "n_train": len(train_set),
             "window_shape": list(
                 np.asarray(train_set.features).shape[1:]
@@ -133,10 +165,28 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2):
         "windows_per_sec_best": round(max(wps), 1),
         "windows_per_sec_median": round(float(np.median(wps)), 1),
         "windows_per_sec_std": round(float(np.std(wps)), 1),
-        "train_time_s_best": round(min(times), 4),
+        "train_time_s_best": round(t_full, 4),
         "train_time_s_median": round(float(np.median(times)), 4),
-        "program_flops": flops,
+        "program_flops": program_flops,
+        "steady_state_step_ms": round(step_s * 1e3, 3),
+        "dispatch_overhead_ms": round(
+            max(t_short - steps_short * step_s, 0.0) * 1e3, 1
+        ),
     }
+    if per_step_flops:
+        stats["achieved_tflops"] = round(
+            program_flops / t_full / 1e12, 3
+        )
+        stats["steady_achieved_tflops"] = round(
+            per_step_flops / step_s / 1e12, 3
+        )
+        if peak:
+            stats["mfu_pct"] = round(
+                100.0 * program_flops / t_full / peak, 2
+            )
+            stats["steady_mfu_pct"] = round(
+                100.0 * per_step_flops / step_s / peak, 2
+            )
     return results[-1], stats
 
 
@@ -155,7 +205,7 @@ def main() -> None:
     from har_tpu.models.logistic_regression import LogisticRegression
     from har_tpu.ops.metrics import evaluate
     from har_tpu.train.trainer import TrainerConfig
-    from har_tpu.utils.mfu import chip_peak_flops, mfu_fields
+    from har_tpu.utils.mfu import chip_peak_flops
 
     peak = chip_peak_flops()
     table, is_real_data = load_table()
@@ -201,10 +251,10 @@ def main() -> None:
             weight_decay=1e-4, seed=0,
         ),
         runs=3,
+        peak=peak,
     )
     windows_per_sec = mlp_stats["windows_per_sec_best"]
     train_time = mlp_stats["train_time_s_best"]
-    mlp_flops = mlp_stats["program_flops"]
     acc = evaluate(test.label, mlp_model.transform(test).raw, 6)["accuracy"]
 
     # raw-window lanes (BASELINE.json configs 3/5): models on (200, 3)
@@ -216,51 +266,59 @@ def main() -> None:
     raw_train = FeatureSet(
         features=raw.windows, label=raw.labels.astype(np.int32)
     )
-    # bs=2048 + 128-wide channels tile the MXU well; epochs=150 amortizes
-    # the fixed per-fit dispatch/transfer latency so the rate reflects the
-    # steady-state step time (>250k windows/s on one chip, clearing the
-    # >=50k v5e-8 north star on a single device)
+    # bs=2048 + 256-wide channels: the r4 mfu_tune sweep (artifacts/
+    # mfu_tune.json) measured 128-wide convs at 17.8% steady MFU
+    # (bandwidth-bound: each elementwise pass streams the full
+    # (B,T,C) activation) vs 33.4% at 256 — the wider contraction
+    # turns the same conv stack compute-bound while still clearing
+    # the 50k windows/s north star by >3x
     _, cnn_stats = neural_lane(
         "cnn1d",
         raw_train,
         TrainerConfig(batch_size=2048, epochs=150, learning_rate=2e-3),
-        model_kwargs={"channels": (128, 128, 128)},
-        runs=3,
+        model_kwargs={"channels": (256, 256, 256)},
+        runs=2,
+        peak=peak,
     )
     cnn_wps = cnn_stats["windows_per_sec_best"]
     cnn_time = cnn_stats["train_time_s_best"]
-    cnn_flops = cnn_stats["program_flops"]
 
     # BiLSTM on the same raw windows (BASELINE.json config 5): the
-    # sequence-serial lane — one fused (x,h)->4H matmul per step under
-    # lax.scan; throughput is step-latency bound, reported for coverage
-    # batch 2048 quarters the scan-step count per epoch vs r2's 512: the
-    # recurrence is step-latency bound, so fewer/fatter timestep matmuls
-    # is the lever; hidden stays 128 — the 200-step backward pass keeps
-    # B x T x 2H activations live, and batch 4096 x hidden 256 OOMs the
-    # 16G chip (see docs/bilstm_profile.md for the arithmetic)
+    # sequence-serial lane.  r4 configuration (artifacts/mfu_tune.json):
+    # full-batch 8192 — the recurrence is step-LATENCY bound, so the
+    # only lever is more windows per serial scan step — with bf16
+    # streamed activations (halves the HBM bytes each of the 200 steps
+    # reads/writes) and a remat'd scan step (backward recomputes gate
+    # preactivations instead of streaming T saved (2,B,4H) tensors; also
+    # what makes batch 8192 COMPILE — without it the saved residuals OOM
+    # compile-time VMEM planning).  51k -> 83k windows/s measured.
     _, bilstm_stats = neural_lane(
         "bilstm",
         raw_train,
-        TrainerConfig(batch_size=2048, epochs=30, learning_rate=2e-3),
+        TrainerConfig(batch_size=8192, epochs=60, learning_rate=2e-3),
+        model_kwargs={"bf16_stream": True, "remat": True},
         runs=2,
+        peak=peak,
     )
     bilstm_wps = bilstm_stats["windows_per_sec_best"]
     bilstm_time = bilstm_stats["train_time_s_best"]
-    bilstm_flops = bilstm_stats["program_flops"]
 
     # Transformer encoder on the same raw windows (4th neural family,
-    # VERDICT r1 weak #3): T=200 is below the flash-attention auto
-    # threshold, so this times the XLA-fused attention path
+    # VERDICT r1 weak #3), XLA-fused attention (the measured winner at
+    # T=200 — artifacts/mfu_tune.json use_flash variants).  r4 shape:
+    # embed 256 x 8 heads (mfu_tune: embed 64 ran at 5.9% steady MFU —
+    # every matmul's contraction dim underfills the MXU's 128 lanes;
+    # embed 256 at batch 1024 reaches ~21%)
     _, tfm_stats = neural_lane(
         "transformer",
         raw_train,
-        TrainerConfig(batch_size=512, epochs=30, learning_rate=1e-3),
+        TrainerConfig(batch_size=1024, epochs=20, learning_rate=1e-3),
+        model_kwargs={"embed_dim": 256, "num_heads": 8},
         runs=2,
+        peak=peak,
     )
     tfm_wps = tfm_stats["windows_per_sec_best"]
     tfm_time = tfm_stats["train_time_s_best"]
-    tfm_flops = tfm_stats["program_flops"]
 
     # Chip-saturation lane (VERDICT r2 weak #1/item 3): a transformer
     # sized for the MXU — embed 768 (12 heads x 64), 4 layers, bf16
@@ -275,34 +333,16 @@ def main() -> None:
     )
     sat_kwargs = {"embed_dim": 768, "num_layers": 4, "num_heads": 12}
     sat_batch = 1024  # 4096 OOMs 16G HBM (activations for the bwd pass)
-    _, sat_short = neural_lane(
-        "transformer",
-        sat_train,
-        TrainerConfig(batch_size=sat_batch, epochs=1, learning_rate=1e-3),
-        model_kwargs=sat_kwargs,
-        runs=2,  # best-of-2 like the full run — a single noisy short
-        # draw would bias the two-point step-time fit
-    )
     _, sat_stats = neural_lane(
         "transformer",
         sat_train,
         TrainerConfig(batch_size=sat_batch, epochs=5, learning_rate=1e-3),
         model_kwargs=sat_kwargs,
         runs=2,
+        peak=peak,
     )
-    steps_per_epoch = -(-len(sat_train) // sat_batch)
-    sat_steps_short = steps_per_epoch * 1
-    sat_steps_full = steps_per_epoch * 5
-    sat_t_short = sat_short["train_time_s_best"]
-    sat_t_full = sat_stats["train_time_s_best"]
-    sat_step_s = max(
-        (sat_t_full - sat_t_short) / max(sat_steps_full - sat_steps_short, 1),
-        1e-9,
-    )
-    sat_dispatch_s = max(sat_t_short - sat_steps_short * sat_step_s, 0.0)
-    sat_stats["steady_state_step_ms"] = round(sat_step_s * 1e3, 2)
-    sat_stats["dispatch_overhead_ms"] = round(sat_dispatch_s * 1e3, 2)
     sat_stats["mfu_target_pct"] = 30.0
+    sat_t_full = sat_stats["train_time_s_best"]
 
     # reference-parity lanes: the reference's own headline workloads on
     # its own 3,100-dim one-hot feature space and exact split rows
@@ -417,6 +457,64 @@ def main() -> None:
         "accuracy"
     ]
 
+    # Raw-window accuracy lane (VERDICT r3 #4): synthesize windows whose
+    # per-class/axis mean/std/peak-frequency replay the WISDM table's own
+    # summary statistics, train the CNN, and measure held-out accuracy —
+    # this turns "≥97% needs raw windows" from an assertion into a
+    # measurement on the best stand-in the shipped data admits (the
+    # reference drops the raw stream, Main/main.py:22-26).
+    from har_tpu.data.raw_windows import calibrated_raw_stream
+    from har_tpu.data.split import split_indices
+    from har_tpu.models.neural_classifier import NeuralClassifier
+
+    cal = calibrated_raw_stream(table, n_windows=8192, seed=0)
+    cal_tr, cal_te = split_indices(len(cal), [0.85, 0.15], seed=7)
+    cal_train = FeatureSet(
+        features=cal.windows[cal_tr], label=cal.labels[cal_tr]
+    )
+    cal_test = FeatureSet(
+        features=cal.windows[cal_te], label=cal.labels[cal_te]
+    )
+    cal_est = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(
+            batch_size=1024, epochs=40, learning_rate=2e-3, seed=0
+        ),
+        model_kwargs={"channels": (128, 128, 128)},
+    )
+    t0 = time.perf_counter()
+    cal_model = cal_est.fit(cal_train)
+    cal_time = time.perf_counter() - t0
+    n_cal_classes = len(cal.class_names)
+    raw_acc = evaluate(
+        cal_test.label, cal_model.transform(cal_test).raw, n_cal_classes
+    )["accuracy"]
+
+    # UCI-HAR paper-parity lane (VERDICT r3 #5): runs LR+CV against the
+    # published ≈0.91 the moment a real dataset tree is present; skips
+    # with guidance otherwise (no vacuous synthetic numbers)
+    from har_tpu.parity import ucihar_parity_lane
+
+    ucihar = ucihar_parity_lane()
+
+    # Device-parallel CV sweep scaling (VERDICT r3 #7): measured by
+    # scripts/cv_scaling.py on an 8-device virtual CPU mesh (virtual
+    # devices are fixed at backend init, so the measurement owns its
+    # process); embedded here with provenance so the bench line carries
+    # the multi-device data point
+    cv_scaling = None
+    scaling_path = (
+        pathlib.Path(__file__).resolve().parent
+        / "artifacts" / "cv_scaling.json"
+    )
+    if scaling_path.exists():
+        try:
+            cv_scaling = json.loads(scaling_path.read_text())
+            cv_scaling["source"] = (
+                "artifacts/cv_scaling.json (scripts/cv_scaling.py)"
+            )
+        except (OSError, ValueError):
+            cv_scaling = None
 
     best_acc = max(acc, gb_acc)
     best_wps = max(windows_per_sec, cnn_wps, bilstm_wps, tfm_wps)
@@ -454,6 +552,13 @@ def main() -> None:
         "reference_lr_cv_train_time_s": 129.948,
         "reference_lr_cv_accuracy": 0.7145,
         "lr_uniform_reg_test_accuracy": round(lr_u_acc, 4),
+        # raw-window accuracy on the statistics-calibrated synthetic
+        # stream (held-out split; see calibrated_raw_stream)
+        "raw_synthetic_accuracy": round(raw_acc, 4),
+        "raw_synthetic_train_time_s": round(cal_time, 4),
+        "raw_synthetic_n_windows": len(cal),
+        "ucihar_parity": ucihar,
+        "cv_sweep_scaling": cv_scaling,
         "n_train": len(train),
         "split": "spark-exact",
         "backend": jax.default_backend(),
@@ -468,41 +573,35 @@ def main() -> None:
                 "sweep: artifacts/accuracy_ceiling_sweep.json); >=97% "
                 "needs raw 20 Hz windows, which the reference repo does "
                 "not ship and the offline environment cannot fetch — "
-                "raw-window models are implemented and benched on "
-                "synthetic streams"
+                "measured on the statistics-calibrated synthetic stream "
+                "instead: see raw_synthetic_accuracy"
             ),
+            "raw_synthetic_accuracy": round(raw_acc, 4),
             "throughput_target_windows_per_sec": NORTH_STAR_WINDOWS_PER_SEC,
             "best_windows_per_sec": round(best_wps, 1),
             "throughput_met": bool(best_wps >= NORTH_STAR_WINDOWS_PER_SEC),
         },
     }
-    for prefix, t, flops in (
-        ("mlp", train_time, mlp_flops),
-        ("cnn", cnn_time, cnn_flops),
-        ("bilstm", bilstm_time, bilstm_flops),
-        ("transformer", tfm_time, tfm_flops),
-        ("saturation", sat_t_full, sat_stats["program_flops"]),
+    # Per-lane MFU, both accountings (VERDICT r3 #1): mfu_pct is
+    # end-to-end (flops over fit wall-clock — dispatch-latency-laden on
+    # short lanes), steady_mfu_pct is in-program (flops over steady step
+    # time).  Flat keys mirror the lane stats so bench_compare and older
+    # readers keep working.
+    for prefix, stats in (
+        ("mlp", mlp_stats),
+        ("cnn", cnn_stats),
+        ("bilstm", bilstm_stats),
+        ("transformer", tfm_stats),
+        ("saturation", sat_stats),
     ):
-        extra.update(
-            mfu_fields(
-                prefix,
-                {"program_flops": flops, "train_time_s": t},
-                peak,
-            )
-        )
-    # steady-state MFU: the same program flops over in-program step time
-    # only (dispatch/input overhead excluded) — the chip-saturation
-    # number the >=30% target refers to
-    extra.update(
-        mfu_fields(
-            "saturation_steady",
-            {
-                "program_flops": sat_stats["program_flops"],
-                "train_time_s": sat_steps_full * sat_step_s,
-            },
-            peak,
-        )
-    )
+        for key in (
+            "mfu_pct",
+            "steady_mfu_pct",
+            "achieved_tflops",
+            "steady_achieved_tflops",
+        ):
+            if key in stats:
+                extra[f"{prefix}_{key}"] = stats[key]
     extra["saturation_mfu_target_pct"] = 30.0
     extra["saturation_steady_state_step_ms"] = sat_stats[
         "steady_state_step_ms"

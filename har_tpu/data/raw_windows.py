@@ -87,6 +87,106 @@ _CLASS_DYNAMICS = {
 }
 
 
+def _class_axis_stats(table) -> dict[str, dict[str, tuple[float, ...]]]:
+    """Per-activity (mean, std, peak-interval-ms) per axis from the
+    transformed WISDM table.
+
+    Pulls the reference's own summary columns ({X,Y,Z}AVG / {X,Y,Z}STDDEV /
+    {X,Y,Z}PEAK, Main/main.py's feature space): medians per class, ignoring
+    the '?' sentinels the shipped CSV uses in the PEAK columns (XAVG is
+    all-zero there — that IS the statistic, so x oscillates around 0).
+    """
+    import numpy as np  # noqa: F811  (self-contained for clarity)
+
+    activity = np.asarray(table["ACTIVITY"], object)
+    out: dict[str, dict[str, tuple[float, ...]]] = {}
+
+    def med(col: str, mask) -> float | None:
+        try:
+            raw = np.asarray(table[col], object)[mask]
+        except KeyError:
+            return None
+        vals = []
+        for v in raw:
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                continue
+            if np.isfinite(f):
+                vals.append(f)
+        return float(np.median(vals)) if vals else None
+
+    for name in np.unique(activity):
+        mask = activity == name
+        stats = {}
+        for key, suffix, default in (
+            ("mean", "AVG", 0.0),
+            ("std", "STDDEV", 1.0),
+            ("peak_ms", "PEAK", 0.0),
+        ):
+            vals = tuple(
+                m if (m := med(f"{axis}{suffix}", mask)) is not None
+                else default
+                for axis in "XYZ"
+            )
+            stats[key] = vals
+        out[str(name)] = stats
+    return out
+
+
+def calibrated_raw_stream(
+    table,
+    n_windows: int = 8192,
+    seed: int = 0,
+    window: int = WINDOW_STEPS,
+) -> WindowedDataset:
+    """Raw windows whose per-class statistics replay the WISDM table's.
+
+    The reference drops the raw 20 Hz stream (Main/main.py:22-26 keeps
+    only the 43 summary features), so the accuracy a raw-window model can
+    reach is unobservable on shipped data.  This generator closes the
+    loop (VERDICT r3 item 4): each class's windows are synthesized so
+    their per-axis mean, standard deviation and dominant peak interval
+    match the medians the reference's own transform measured on that
+    class — gravity components from {X,Y,Z}AVG, oscillation frequency
+    from {X,Y,Z}PEAK (ms between peaks), and amplitude/noise split so the
+    per-axis std equals {X,Y,Z}STDDEV (noise takes 35% of the variance).
+    Class priors are the table's empirical activity distribution.
+    """
+    import numpy as np  # noqa: F811
+
+    stats = _class_axis_stats(table)
+    activity = np.asarray(table["ACTIVITY"], object)
+    names, counts = np.unique(activity, return_counts=True)
+    names = [str(n) for n in names]
+    priors = counts / counts.sum()
+
+    rng = np.random.default_rng((seed, 20824))
+    labels = rng.choice(len(names), size=n_windows, p=priors).astype(np.int32)
+    t = np.arange(window, dtype=np.float32) / SAMPLE_HZ
+    windows = np.empty((n_windows, window, 3), np.float32)
+    for i, lab in enumerate(labels):
+        s = stats[names[lab]]
+        phase = rng.uniform(0, 2 * np.pi, size=3)
+        for axis in range(3):
+            mean = s["mean"][axis]
+            std = max(s["std"][axis], 1e-3) * rng.uniform(0.9, 1.1)
+            peak_ms = s["peak_ms"][axis]
+            sigma = np.sqrt(0.35) * std
+            amp = np.sqrt(2.0 * (std * std - sigma * sigma))
+            if peak_ms and peak_ms > 0:
+                freq = 1000.0 / peak_ms * rng.uniform(0.95, 1.05)
+                osc = amp * np.sin(2 * np.pi * freq * t + phase[axis])
+            else:  # static activity: all variance is noise
+                sigma, osc = std, 0.0
+            windows[i, :, axis] = (
+                mean + osc + rng.normal(0, sigma, size=window)
+            )
+    return WindowedDataset(
+        windows=windows, labels=labels, class_names=tuple(names)
+    )
+
+
 def synthetic_raw_stream(
     n_windows: int = 1000,
     seed: int = 0,

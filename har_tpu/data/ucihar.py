@@ -96,6 +96,32 @@ def _read_indexed_names(path: str) -> tuple[str, ...] | None:
     return tuple(names)
 
 
+def resolve_ucihar_root() -> str | None:
+    """Locate a real 'UCI HAR Dataset' tree, or None.
+
+    Probes $HAR_TPU_UCIHAR_ROOT first, then conventional data dirs.  The
+    paper-parity lane (har_tpu.parity.ucihar_parity_lane, VERDICT r3
+    item 5) keys off this: present → run LR+CV and check the published
+    ≈0.91 accuracy; absent → skip with a clear message.  The offline
+    environment cannot fetch the archive, so the lane stays falsifiable
+    without being runnable here.
+    """
+    candidates = [
+        os.environ.get("HAR_TPU_UCIHAR_ROOT"),
+        ".",
+        "./data",
+        os.path.expanduser("~/data"),
+    ]
+    for cand in candidates:
+        if not cand:
+            continue
+        try:
+            return _resolve_root(cand)
+        except FileNotFoundError:
+            continue
+    return None
+
+
 def load_ucihar(root: str, split: str = "all") -> Table:
     """Load train/test/all splits from a published-layout UCI-HAR tree."""
     root = _resolve_root(root)

@@ -29,6 +29,7 @@ from har_tpu.features.wisdm_pipeline import FeatureSet
 from har_tpu.models.base import Predictions
 from har_tpu.models.tree import (
     _grow_tree,
+    auto_pallas_hist,
     _predict_tree,
     binize,
     split_thresholds,
@@ -45,6 +46,7 @@ from har_tpu.models.tree import (
         "features_per_split",
         "num_trees",
         "tree_batch",
+        "use_pallas_hist",
     ),
 )
 def _grow_forest(
@@ -59,6 +61,7 @@ def _grow_forest(
     features_per_split: int,
     num_trees: int,
     tree_batch: int = 8,
+    use_pallas_hist: bool = False,
 ):
     n = bins.shape[0]
     boot_rng, feat_rng = jax.random.split(rng)
@@ -79,6 +82,7 @@ def _grow_forest(
             max_bins=max_bins,
             min_instances=min_instances,
             features_per_split=features_per_split,
+            use_pallas_hist=use_pallas_hist,
         )
 
     # lax.map with batch_size: trees grow `tree_batch` at a time (vmapped
@@ -118,6 +122,9 @@ class RandomForestClassifier:
     # mllib: exact MLlib split-candidate set (parity default);
     # quantile: evenly spaced on-device quantiles
     split_candidates: str = "mllib"
+    # None = auto: evidence-based policy from artifacts/hist_bench.json
+    # (see har_tpu.models.tree.auto_pallas_hist)
+    use_pallas_hist: bool | None = None
 
     def copy_with(self, **params) -> "RandomForestClassifier":
         return dataclasses.replace(self, **params)
@@ -153,6 +160,7 @@ class RandomForestClassifier:
             min_instances=self.min_instances_per_node,
             features_per_split=self._features_per_split(x.shape[1]),
             num_trees=self.num_trees,
+            use_pallas_hist=auto_pallas_hist(self.use_pallas_hist),
         )
         return RandomForestModel(
             feature=np.asarray(feature),

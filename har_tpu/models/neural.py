@@ -98,11 +98,26 @@ class FusedBiLSTMLayer(nn.Module):
 
     hidden: int
     dtype: jnp.dtype = jnp.bfloat16
+    # Store the hoisted input projections and the scanned step in bf16
+    # instead of f32.  The recurrence is HBM-traffic bound (each of the
+    # T steps streams its (2,B,4H) xproj slice plus saved residuals for
+    # the backward pass), so halving those bytes buys throughput; cell
+    # state c and the gate nonlinearity stay f32 either way, which keeps
+    # the drift over 200 steps in the noise (test_neural pins fwd/bwd
+    # agreement).  Off by default: parity-era checkpoints and the exact
+    # fwd/bwd-equivalence tests predate it.
+    bf16_stream: bool = False
+    # jax.checkpoint the scan step: the backward pass recomputes the
+    # gate preactivations from (hprev, xt) instead of streaming T saved
+    # (2,B,4H) gate tensors back from HBM — trades one small matmul per
+    # step for 4H of saved residual bandwidth.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):  # (B, T, I) -> (B, T, 2H)
         b, t, i = x.shape
         h = self.hidden
+        stream_dtype = self.dtype if self.bf16_stream else jnp.float32
         wx = self.param(
             "wx", nn.initializers.lecun_normal(), (2, i, 4 * h), jnp.float32
         )
@@ -120,11 +135,12 @@ class FusedBiLSTMLayer(nn.Module):
                 preferred_element_type=jnp.float32,
             )
             + bias[:, None, None, :]
-        )  # (2, B, T, 4H) f32, one MXU pass for all steps x directions
+        ).astype(stream_dtype)
+        # (2, B, T, 4H), one MXU pass for all steps x directions
 
         def step(carry, xt):  # xt: (2, B, 4H)
             hprev, cprev = carry
-            gates = xt + jnp.einsum(
+            gates = xt.astype(jnp.float32) + jnp.einsum(
                 "dbh,dhg->dbg",
                 hprev.astype(self.dtype),
                 wh.astype(self.dtype),
@@ -133,10 +149,13 @@ class FusedBiLSTMLayer(nn.Module):
             gi, gf, gg, go = jnp.split(gates, 4, axis=-1)
             c = jax.nn.sigmoid(gf) * cprev + jax.nn.sigmoid(gi) * jnp.tanh(gg)
             hnew = jax.nn.sigmoid(go) * jnp.tanh(c)
-            return (hnew, c), hnew
+            return (hnew.astype(stream_dtype), c), hnew.astype(stream_dtype)
+
+        if self.remat:
+            step = jax.checkpoint(step)
 
         init = (
-            jnp.zeros((2, b, h), jnp.float32),
+            jnp.zeros((2, b, h), stream_dtype),
             jnp.zeros((2, b, h), jnp.float32),
         )
         # unroll factors 2-8 were measured and don't beat the plain loop
@@ -157,12 +176,17 @@ class BiLSTM(nn.Module):
     num_layers: int = 1
     dropout_rate: float = 0.2
     dtype: jnp.dtype = jnp.bfloat16
+    bf16_stream: bool = False  # see FusedBiLSTMLayer
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         x = x.astype(self.dtype)
         for _ in range(self.num_layers):
-            x = FusedBiLSTMLayer(self.hidden, self.dtype)(x)
+            x = FusedBiLSTMLayer(
+                self.hidden, self.dtype,
+                bf16_stream=self.bf16_stream, remat=self.remat,
+            )(x)
         # mean-pool the concatenated fwd/bwd features over time
         x = x.mean(axis=-2)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

@@ -39,6 +39,45 @@ from har_tpu.features.wisdm_pipeline import FeatureSet
 from har_tpu.models.base import Predictions
 
 
+@functools.lru_cache(maxsize=1)
+def _hist_bench_prefers_pallas() -> bool | None:
+    """artifacts/hist_bench.json's measured verdict, or None when absent."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "artifacts",
+        "hist_bench.json",
+    )
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    policy = doc.get("auto_policy", "")
+    return policy.startswith("pallas") if policy else None
+
+
+def auto_pallas_hist(flag: bool | None) -> bool:
+    """Resolve a use_pallas_hist tri-state to a concrete choice.
+
+    Explicit True/False wins.  Auto (None) consults the measured
+    comparison in artifacts/hist_bench.json (scripts/hist_bench.py,
+    VERDICT r3 #6b: "a kernel nobody measures is a liability") when it
+    exists; off-TPU the kernel would run in interpret mode, so auto is
+    always False there.
+    """
+    if flag is not None:
+        return flag
+    if jax.default_backend() != "tpu":
+        return False
+    prefers = _hist_bench_prefers_pallas()
+    return True if prefers is None else prefers
+
+
 def quantile_thresholds(
     x: jax.Array, max_bins: int
 ) -> jax.Array:
@@ -368,9 +407,10 @@ class DecisionTreeClassifier:
     # mllib: exact MLlib split-candidate set (parity default);
     # quantile: evenly spaced on-device quantiles
     split_candidates: str = "mllib"
-    # None = auto: the fused Pallas histogram on TPU (no HBM one-hot
-    # indicator), the XLA one-hot matmul elsewhere (the kernel would run
-    # in slow interpret mode off-TPU)
+    # None = auto: evidence-based policy (auto_pallas_hist) — the
+    # measured winner from artifacts/hist_bench.json on TPU, the XLA
+    # one-hot matmul elsewhere (the kernel would run in slow interpret
+    # mode off-TPU)
     use_pallas_hist: bool | None = None
 
     def copy_with(self, **params) -> "DecisionTreeClassifier":
@@ -401,11 +441,7 @@ class DecisionTreeClassifier:
             max_depth=self.max_depth,
             max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
-            use_pallas_hist=(
-                jax.default_backend() == "tpu"
-                if self.use_pallas_hist is None
-                else self.use_pallas_hist
-            ),
+            use_pallas_hist=auto_pallas_hist(self.use_pallas_hist),
         )
         return DecisionTreeModel(
             tree=TreeArrays(

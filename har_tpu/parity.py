@@ -131,3 +131,74 @@ def parity_run(
     if charts:
         paths["charts"] = os.path.dirname(charts[0])
     return {"accuracies": accuracies, "artifacts": paths}
+
+
+def ucihar_parity_lane(root: str | None = None) -> dict:
+    """The paper's second benchmark, falsifiable on demand (VERDICT r3 #5).
+
+    The reference paper (Paper §4 Fig 2-3, §5) reports LR+CrossValidator
+    reaching 91.9% accuracy/F1 (Fig 2-3; 91.02% in the conclusion) on the
+    UCI-HAR smartphone dataset under the same pipeline it runs on WISDM —
+    70/30 random split, 5-fold CV over the 9-point reg×elasticNet grid.
+    This lane replays that protocol on a real "UCI HAR Dataset" tree the
+    moment one is present (har_tpu.data.ucihar.resolve_ucihar_root) and
+    reports the measured-vs-published gap; with no tree it returns a
+    skipped marker instead of a vacuous synthetic number.
+
+    Tolerance: the paper's split seed is unknown (Spark randomSplit over
+    a different row encoding), so parity means within ±0.02 of the
+    published 0.9102-0.919 band, not bit-exactness.
+    """
+    from har_tpu.data.split import split_indices
+    from har_tpu.data.ucihar import (
+        load_ucihar,
+        resolve_ucihar_root,
+        ucihar_feature_set,
+    )
+    from har_tpu.models.logistic_regression import LogisticRegression
+    from har_tpu.tuning import CrossValidator, param_grid
+
+    expected = {"fig2_accuracy": 0.919, "conclusion_accuracy": 0.9102}
+    root = root if root is not None else resolve_ucihar_root()
+    if root is None:
+        return {
+            "skipped": (
+                "no 'UCI HAR Dataset' tree found — set "
+                "HAR_TPU_UCIHAR_ROOT (or drop the published archive in "
+                "./data) to run the paper-parity lane"
+            ),
+            "expected": expected,
+        }
+    table = load_ucihar(root, "all")
+    data = ucihar_feature_set(table)
+    tr, te = split_indices(len(data), [0.7, 0.3], seed=2018)
+    train, test = data.take(tr), data.take(te)
+
+    grid = param_grid(
+        reg_param=[0.1, 0.3, 0.5], elastic_net_param=[0.0, 0.1, 0.2]
+    )
+    cv = CrossValidator(
+        estimator=LogisticRegression(), grid=grid, num_folds=5, seed=2018
+    )
+    t0 = time.perf_counter()
+    model = cv.fit(train)
+    preds = model.transform(test)
+    train_time = time.perf_counter() - t0
+    m = evaluate(test.label, preds.raw, int(data.label.max()) + 1)
+    acc = float(m["accuracy"])
+    return {
+        "root": root,
+        "n_train": len(tr),
+        "n_test": len(te),
+        "accuracy": round(acc, 4),
+        "weighted_f1": round(float(m["f1"]), 4),
+        "train_time_s": round(train_time, 3),
+        "best_params": model.best_params,
+        "expected": expected,
+        "within_tolerance": bool(
+            expected["conclusion_accuracy"] - 0.02
+            <= acc
+            <= expected["fig2_accuracy"] + 0.02
+        ),
+        "reference_train_time_s": 271.196,  # paper Table 2, 70-30 LR+CV
+    }

@@ -1,0 +1,118 @@
+"""Device-parallel CV sweep scaling measurement (VERDICT r3 item 7).
+
+The sharded CV sweep (LogisticRegression.cv_scores with a mesh: the
+(reg x fold) grid axis partitioned over the mesh's data axis) was
+dryrun-verified for correctness in round 3; this script measures the
+WIN: wall-clock for the reference's 45-cell sweep (9-point grid x
+5 folds) on the WISDM one-hot feature space at 1 / 2 / 4 / 8 devices of
+a virtual CPU mesh — the same mesh construction the driver's
+dryrun_multichip exercises, so the scaling shape transfers to a real
+multi-chip TPU slice (per-device compute is CPU-slow here, but the
+sweep's parallel efficiency is what's being demonstrated).
+
+Writes artifacts/cv_scaling.json; bench.py embeds it (clearly marked
+with its provenance) as extra["cv_sweep_scaling"].
+
+Run STANDALONE (it must own the process: virtual CPU devices are fixed
+at backend init):
+
+    python scripts/cv_scaling.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ART = os.path.join(ROOT, "artifacts", "cv_scaling.json")
+
+
+def main() -> None:
+    import jax
+
+    # the axon sitecustomize preload ignores JAX_PLATFORMS from env;
+    # the config update is the reliable switch (verify skill notes)
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from har_tpu.data.spark_split import assemble_rows, spark_split_indices
+    from har_tpu.features.wisdm_pipeline import (
+        build_wisdm_pipeline,
+        make_feature_set,
+    )
+    from har_tpu.models.logistic_regression import LogisticRegression
+    from har_tpu.parallel.mesh import create_mesh
+    from har_tpu.tuning import param_grid
+    from har_tpu.tuning.cross_validator import kfold_indices
+
+    from bench import load_table
+
+    table, is_real = load_table()
+    asm = assemble_rows(table)
+    tr, _ = spark_split_indices(table, [0.7, 0.3], seed=2018, rows=asm)
+    pipeline = build_wisdm_pipeline()
+    model = pipeline.fit(table)
+    train = make_feature_set(model.transform(table)).take(tr)
+
+    grid = param_grid(
+        reg_param=[0.1, 0.3, 0.5], elastic_net_param=[0.0, 0.1, 0.2]
+    )
+    folds = kfold_indices(len(train), 5, seed=2018)
+    est = LogisticRegression(standardize=False)
+
+    devices = jax.devices()
+    assert len(devices) >= 8, devices
+    timings = []
+    baseline = None
+    for n_dev in (1, 2, 4, 8):
+        mesh = create_mesh(dp=n_dev, devices=devices[:n_dev])
+        lr = est.copy_with(mesh=None if n_dev == 1 else mesh)
+        # warmup compiles this device count's program
+        lr.cv_scores(train, folds, grid, "accuracy")
+        t0 = time.perf_counter()
+        scores = lr.cv_scores(train, folds, grid, "accuracy")
+        np.asarray(scores)
+        wall = time.perf_counter() - t0
+        if baseline is None:
+            baseline = wall
+        timings.append(
+            {
+                "devices": n_dev,
+                "wall_s": round(wall, 3),
+                "speedup_vs_1dev": round(baseline / wall, 2),
+                "best_cell_accuracy": round(float(np.max(scores)), 4),
+            }
+        )
+        print(json.dumps(timings[-1]))
+
+    out = {
+        "note": "HONEST READING: virtual CPU devices share one physical socket, so wall-clock cannot improve with device count here (XLA already parallelizes the vmapped 45-fit program across cores at 1 device; sharding splits the same silicon and adds collective overhead). These rows are correctness/compilation evidence for the sharded sweep at increasing device counts. The wall-clock WIN the sharding exists for shows up on real multi-chip slices (each shard gets its own MXU); the measured single-chip evidence for the CV story is in bench.py: the vectorized 45-fit sweep runs ~6-11 s vs Spark's 129.9 s for the identical protocol.",
+        "protocol": (
+            "45-cell CV sweep (9-point reg x elasticNet grid, 5 folds) "
+            "on the WISDM 3,100-dim one-hot features; grid axis sharded "
+            "over the mesh data axis (LogisticRegression.cv_scores)"
+        ),
+        "backend": "cpu (8 virtual devices, xla_force_host_platform_"
+                   "device_count)",
+        "real_data": bool(is_real),
+        "n_train": len(train),
+        "timings": timings,
+    }
+    os.makedirs(os.path.dirname(ART), exist_ok=True)
+    with open(ART, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": ART}))
+
+
+if __name__ == "__main__":
+    main()

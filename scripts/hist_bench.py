@@ -1,0 +1,127 @@
+"""Pallas fused histogram vs XLA one-hot-matmul histogram (VERDICT r3 #6b).
+
+The tree growers build per-level histograms either as one big MXU matmul
+against an HBM-resident (n, d*B) one-hot indicator, or with the fused
+Pallas kernel (har_tpu.ops.pallas_hist) that expands bin ids tile-by-tile
+in VMEM.  This measures BOTH paths on the workloads the framework
+actually runs them on and writes artifacts/hist_bench.json, from which
+DecisionTreeClassifier's auto policy takes its evidence:
+
+  - reference parity shape: WISDM 3,100-dim one-hot feature space
+    (DT max_depth=3/bins=32; the one-hot indicator alone is ~1.4 GB)
+  - classical shape: 13-dim numeric view, RF 100 trees x depth 4
+
+Run solo on the real chip:
+
+    python scripts/hist_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ART = os.path.join(ROOT, "artifacts", "hist_bench.json")
+
+
+def timed_best(fn, runs=3):
+    fn()  # warmup/compile
+    return round(min(
+        (lambda t0=time.perf_counter(): (fn(), time.perf_counter() - t0)[1])()
+        for _ in range(runs)
+    ), 4)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+    from bench import load_features, load_table
+    from har_tpu.data.spark_split import assemble_rows, spark_split_indices
+    from har_tpu.data.wisdm import numeric_feature_view
+    from har_tpu.features.string_indexer import StringIndexer
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.forest import RandomForestClassifier
+    from har_tpu.models.tree import DecisionTreeClassifier
+
+    table, is_real = load_table()
+    asm = assemble_rows(table)
+    tr, te = spark_split_indices(table, [0.7, 0.3], seed=2018, rows=asm)
+    onehot_train, _ = load_features(table, tr, te, asm=asm)
+    x, _ = numeric_feature_view(table)
+    y = np.asarray(
+        StringIndexer("ACTIVITY", "label").fit(table).transform(table)[
+            "label"
+        ],
+        np.int32,
+    )
+    numeric_train = FeatureSet(features=x[tr], label=y[tr])
+
+    rows = []
+    for name, train, est in (
+        (
+            "dt_onehot3100_depth3_bins32",
+            onehot_train,
+            DecisionTreeClassifier(max_depth=3, max_bins=32),
+        ),
+        (
+            "dt_numeric13_depth6_bins128",
+            numeric_train,
+            DecisionTreeClassifier(max_depth=6, max_bins=128),
+        ),
+        (
+            "rf100_numeric13_depth4_bins32",
+            numeric_train,
+            RandomForestClassifier(
+                num_trees=100, max_depth=4, max_bins=32
+            ),
+        ),
+    ):
+        row = {"workload": name, "n_train": len(train)}
+        for label, flag in (("pallas_s", True), ("matmul_s", False)):
+            e = est.copy_with(use_pallas_hist=flag)
+            try:
+                row[label] = timed_best(lambda e=e: e.fit(train))
+            except Exception as exc:
+                row[label] = f"FAILS: {str(exc)[:120]}"
+        if isinstance(row.get("pallas_s"), float) and isinstance(
+            row.get("matmul_s"), float
+        ):
+            row["pallas_speedup"] = round(
+                row["matmul_s"] / row["pallas_s"], 2
+            )
+        rows.append(row)
+        print(json.dumps(row))
+
+    winners = [
+        r["pallas_speedup"] for r in rows if "pallas_speedup" in r
+    ]
+    out = {
+        "backend": jax.default_backend(),
+        "real_data": bool(is_real),
+        "note": (
+            "fit() wall-clock best-of-3 (compile excluded), same model "
+            "both paths; pallas_speedup > 1 means the fused kernel wins"
+        ),
+        "rows": rows,
+        "auto_policy": (
+            "pallas on TPU" if winners and float(np.median(winners)) >= 1.0
+            else "matmul (one-hot) everywhere"
+        ),
+    }
+    os.makedirs(os.path.dirname(ART), exist_ok=True)
+    with open(ART, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": ART, "auto_policy": out["auto_policy"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Shape/batch sweep for the raw-window bench lanes' MFU (VERDICT r3 #1).
+
+For each lane variant this measures the STEADY-STATE step time — two fits
+with different epoch counts, slope = in-program step time, intercept =
+dispatch/transfer overhead (the same two-point split the bench's
+saturation lane uses; through the remote-chip tunnel the fixed overhead
+is seconds, so end-to-end MFU understates what the compiled program
+achieves).  Per variant it records:
+
+  steady_tflops / steady_mfu_pct  — program flops over in-program time
+  e2e_mfu_pct                     — same flops over wall-clock fit time
+  windows_per_sec                 — the bench lane's headline accounting
+
+Run solo on the real chip (concurrent host load depresses lane times
+15-30%):
+
+    python scripts/mfu_tune.py [lane ...]   # default: all lanes
+
+Results append to artifacts/mfu_tune.json, keyed by variant name, so a
+sweep can be re-run lane by lane while tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ART = os.path.join(ROOT, "artifacts", "mfu_tune.json")
+
+
+def _fit(name, train_set, cfg, model_kwargs, flops=False):
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig  # noqa: F401  (doc)
+
+    if flops:
+        cfg = dataclasses.replace(cfg, compute_flops=True)
+    est = NeuralClassifier(name, config=cfg, model_kwargs=dict(model_kwargs))
+    return est.fit(train_set)
+
+
+def measure(
+    name, train_set, batch, epochs_short, epochs_full, model_kwargs,
+    runs=2,
+):
+    """Two-epoch-count timing → steady step time + per-step flops."""
+    from har_tpu.train.trainer import TrainerConfig
+    from har_tpu.utils.mfu import chip_peak_flops
+
+    base = TrainerConfig(batch_size=batch, learning_rate=1e-3, seed=0)
+    short_cfg = dataclasses.replace(base, epochs=epochs_short)
+    full_cfg = dataclasses.replace(base, epochs=epochs_full)
+
+    # warmups compile both programs and record per-step flops
+    warm = _fit(name, train_set, full_cfg, model_kwargs, flops=True)
+    per_step_flops = warm.history.get("program_flops_raw", 0.0)
+    _fit(name, train_set, short_cfg, model_kwargs)
+
+    t_short = min(
+        float(_fit(name, train_set, short_cfg, model_kwargs)
+              .history["train_time_s"])
+        for _ in range(runs)
+    )
+    fulls = [
+        _fit(name, train_set, full_cfg, model_kwargs) for _ in range(runs)
+    ]
+    t_full = min(float(r.history["train_time_s"]) for r in fulls)
+
+    steps_per_epoch = -(-len(train_set) // batch)
+    d_steps = steps_per_epoch * (epochs_full - epochs_short)
+    d_t = max(t_full - t_short, 1e-9)
+    step_s = d_t / d_steps
+    peak = chip_peak_flops()
+    steady = per_step_flops / step_s
+    total_flops = per_step_flops * steps_per_epoch * epochs_full
+    out = {
+        "model": name,
+        "batch": batch,
+        "model_kwargs": dict(model_kwargs),
+        "epochs": [epochs_short, epochs_full],
+        "t_short_s": round(t_short, 4),
+        "t_full_s": round(t_full, 4),
+        "steady_step_ms": round(step_s * 1e3, 3),
+        "dispatch_overhead_s": round(
+            max(t_short - steps_per_epoch * epochs_short * step_s, 0.0), 3
+        ),
+        "per_step_gflops": round(per_step_flops / 1e9, 2),
+        "steady_tflops": round(steady / 1e12, 2),
+        "windows_per_sec": round(len(train_set) * epochs_full / t_full, 1),
+        "e2e_mfu_pct": (
+            round(100.0 * total_flops / t_full / peak, 2) if peak else None
+        ),
+        "steady_mfu_pct": (
+            round(100.0 * steady / peak, 2) if peak else None
+        ),
+    }
+    return out
+
+
+def main(argv):
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+
+    raw = synthetic_raw_stream(n_windows=8192, seed=0)
+    train = FeatureSet(
+        features=raw.windows, label=raw.labels.astype(np.int32)
+    )
+
+    # epochs_full is sized so in-program time dominates the ~2-4 s fixed
+    # tunnel dispatch latency (short fits gave two-point slopes noisier
+    # than the quantity being measured); with t_full >> overhead the
+    # slope and the raw t_full/steps estimate agree.
+    grids = {
+        "cnn1d": [
+            dict(batch=2048, epochs_short=60, epochs_full=600,
+                 model_kwargs={"channels": (128, 128, 128)}),
+            dict(batch=4096, epochs_short=60, epochs_full=600,
+                 model_kwargs={"channels": (128, 128, 128)}),
+            dict(batch=2048, epochs_short=30, epochs_full=300,
+                 model_kwargs={"channels": (256, 256, 256)}),
+            dict(batch=4096, epochs_short=30, epochs_full=300,
+                 model_kwargs={"channels": (256, 256, 256)}),
+            dict(batch=4096, epochs_short=15, epochs_full=100,
+                 model_kwargs={"channels": (512, 512, 512)}),
+        ],
+        "transformer": [
+            dict(batch=512, epochs_short=30, epochs_full=150,
+                 model_kwargs={}),
+            dict(batch=1024, epochs_short=20, epochs_full=100,
+                 model_kwargs={"embed_dim": 128, "num_heads": 8}),
+            dict(batch=2048, epochs_short=20, epochs_full=100,
+                 model_kwargs={"embed_dim": 128, "num_heads": 8}),
+            dict(batch=1024, epochs_short=10, epochs_full=60,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8}),
+            dict(batch=2048, epochs_short=10, epochs_full=60,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8}),
+            # Pallas flash attention at T=200 (single 200-block): the
+            # unfused path's (B,H,T,T) f32 scores are the HBM hog at
+            # these shapes — measure whether fusing pays below the
+            # _FLASH_AUTO_T=2048 threshold too
+            dict(batch=1024, epochs_short=10, epochs_full=60,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "use_flash": True}),
+            dict(batch=1024, epochs_short=20, epochs_full=100,
+                 model_kwargs={"embed_dim": 128, "num_heads": 8,
+                               "use_flash": True}),
+        ],
+        "bilstm": [
+            dict(batch=2048, epochs_short=10, epochs_full=60,
+                 model_kwargs={}),
+            dict(batch=2048, epochs_short=10, epochs_full=60,
+                 model_kwargs={"bf16_stream": True}),
+            dict(batch=2048, epochs_short=10, epochs_full=60,
+                 model_kwargs={"bf16_stream": True, "remat": True}),
+            dict(batch=4096, epochs_short=10, epochs_full=60,
+                 model_kwargs={"bf16_stream": True}),
+            dict(batch=8192, epochs_short=10, epochs_full=60,
+                 model_kwargs={"bf16_stream": True}),
+            dict(batch=8192, epochs_short=10, epochs_full=60,
+                 model_kwargs={"bf16_stream": True, "remat": True}),
+        ],
+    }
+    lanes = argv[1:] or list(grids)
+
+    results = {}
+    if os.path.exists(ART):
+        results = json.load(open(ART))
+    for lane in lanes:
+        for spec in grids[lane]:
+            key = (
+                f"{lane}_b{spec['batch']}_"
+                + "_".join(
+                    f"{k}{v}" for k, v in sorted(
+                        spec["model_kwargs"].items()
+                    )
+                )
+            ).rstrip("_")
+            if key in results and "error" not in results[key]:
+                continue  # already measured; delete the artifact to redo
+            try:
+                out = measure(lane, train, **spec)
+            except Exception as e:  # OOM etc.: record and keep sweeping
+                out = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            results[key] = out
+            print(json.dumps({key: out}))
+            with open(ART, "w") as f:
+                json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

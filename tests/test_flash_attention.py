@@ -123,11 +123,15 @@ def test_auto_flash_requires_tpu():
 
 
 def test_pick_block():
-    assert pick_block(400) == 200
+    # default max_block raised to 512 in r4: with K/V streamed on the
+    # grid (VMEM stays O(block)), 512 measured fastest at long T
+    assert pick_block(400) == 400
     assert pick_block(128) == 128
-    assert pick_block(512) == 256
+    assert pick_block(1024) == 512
+    assert pick_block(512, max_block=256) == 256
     assert pick_block(6) == 6  # tiny T: whole-sequence block
-    assert pick_block(401) == 0  # prime > max_block: no usable divisor
+    assert pick_block(401) == 401  # prime <= max_block: one whole block
+    assert pick_block(521) == 0  # prime > max_block: no usable divisor
 
 
 def test_non_dividing_block_raises():

@@ -318,3 +318,60 @@ def test_trainer_class_weight_balanced():
     )
     with pytest.raises(ValueError, match="class_weight"):
         mk("nope", True).fit(x, y)
+
+
+def test_fused_bilstm_bf16_stream_and_remat_match_baseline():
+    """The bench's headline BiLSTM lane runs bf16_stream+remat; those
+    flags must be numerically equivalent to the default path (remat
+    exactly — it only changes what the backward recomputes; bf16_stream
+    within bf16 rounding) in BOTH directions of autodiff."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from har_tpu.models.neural import FusedBiLSTMLayer
+
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(4, 24, 5)), jnp.float32
+    )
+    base = FusedBiLSTMLayer(hidden=8, dtype=jnp.float32)
+    params = base.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss_fn(layer):
+        def loss(p, xb):
+            return (layer.apply({"params": p}, xb) ** 2).sum()
+
+        return jax.jit(jax.value_and_grad(loss))
+
+    v0, g0 = loss_fn(base)(params, x)
+    # remat alone: bit-for-bit the same function, different bwd schedule
+    v_r, g_r = loss_fn(
+        FusedBiLSTMLayer(hidden=8, dtype=jnp.float32, remat=True)
+    )(params, x)
+    np.testing.assert_allclose(float(v_r), float(v0), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_r), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+    # bf16_stream (+remat, the bench combination): bf16 rounding only
+    v_s, g_s = loss_fn(
+        FusedBiLSTMLayer(
+            hidden=8, dtype=jnp.bfloat16, bf16_stream=True, remat=True
+        )
+    )(params, x)
+    np.testing.assert_allclose(float(v_s), float(v0), rtol=2e-2)
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b), rtol=0.15, atol=0.5
+        )
+    # and the direction-semantics invariant holds on the flagged path
+    flagged = FusedBiLSTMLayer(
+        hidden=8, dtype=jnp.float32, bf16_stream=True, remat=True
+    )
+    tied = jax.tree.map(lambda p: p.at[1].set(p[0]), params)
+    y = flagged.apply({"params": tied}, x)
+    y_rev = flagged.apply({"params": tied}, x[:, ::-1, :])
+    np.testing.assert_allclose(
+        np.asarray(y_rev[..., :8]), np.asarray(y[:, ::-1, 8:]),
+        rtol=1e-5, atol=1e-5,
+    )

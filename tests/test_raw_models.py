@@ -115,3 +115,71 @@ def test_non_canonical_activity_names_keep_parser_order(tmp_path):
     ds = load_dataset(cfg)
     assert ds.class_names == ("Skipping", "Walking")
     assert set(np.unique(ds.labels)) == {0, 1}
+
+
+def test_calibrated_stream_replays_table_statistics():
+    """calibrated_raw_stream windows must reproduce the per-class/axis
+    mean, std and dominant frequency the WISDM table measured — that's
+    the whole calibration contract (VERDICT r3 #4)."""
+    from har_tpu.data.raw_windows import (
+        SAMPLE_HZ,
+        _class_axis_stats,
+        calibrated_raw_stream,
+    )
+    from har_tpu.data.synthetic import synthetic_wisdm
+
+    table = synthetic_wisdm(n_rows=1200, seed=7)
+    stats = _class_axis_stats(table)
+    ds = calibrated_raw_stream(table, n_windows=600, seed=0)
+    assert ds.windows.shape == (600, 200, 3)
+    assert ds.class_names is not None
+
+    for lab, name in enumerate(ds.class_names):
+        wins = ds.windows[ds.labels == lab]
+        if len(wins) < 20:
+            continue
+        target = stats[name]
+        for axis in range(3):
+            vals = wins[:, :, axis]
+            # mean within 0.2 m/s² of the table's AVG statistic
+            assert abs(vals.mean() - target["mean"][axis]) < 0.2, (
+                name, axis
+            )
+            # per-window std within 25% of STDDEV (amplitude jitter ±10%)
+            got_std = np.std(vals, axis=1).mean()
+            want = max(target["std"][axis], 1e-3)
+            assert 0.6 * want < got_std < 1.4 * want, (name, axis)
+
+
+def test_calibrated_stream_is_learnable():
+    """A linear probe on simple window summaries must separate the
+    calibrated classes far above chance — the signal the ≥97% raw-window
+    claim rests on is in the stream, not in a lucky architecture."""
+    from har_tpu.data.raw_windows import calibrated_raw_stream
+    from har_tpu.data.synthetic import synthetic_wisdm
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.logistic_regression import LogisticRegression
+    from har_tpu.ops.metrics import evaluate
+
+    table = synthetic_wisdm(n_rows=1500, seed=11)
+    ds = calibrated_raw_stream(table, n_windows=900, seed=1)
+    # per-axis mean/std/|diff|-mean: 9 features a calibrated stream must
+    # make discriminative (they mirror the table's own summary columns)
+    feats = np.concatenate(
+        [
+            ds.windows.mean(axis=1),
+            ds.windows.std(axis=1),
+            np.abs(np.diff(ds.windows, axis=1)).mean(axis=1),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    n_classes = len(ds.class_names)
+    data = FeatureSet(features=feats, label=ds.labels)
+    train, test = data.split([0.8, 0.2], seed=5)
+    model = LogisticRegression(
+        max_iter=60, reg_param=0.01, num_classes=n_classes
+    ).fit(train)
+    acc = evaluate(test.label, model.transform(test).raw, n_classes)[
+        "accuracy"
+    ]
+    assert acc > 0.85, acc

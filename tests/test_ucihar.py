@@ -101,3 +101,54 @@ def test_pipeline_runs_on_ucihar_shape():
     model = LogisticRegression(max_iter=20, reg_param=0.01).fit(train)
     acc = evaluate(test.label, model.transform(test).raw, 6)["accuracy"]
     assert acc > 0.9, acc  # synthetic Gaussians are separable
+
+
+def test_parity_lane_skips_without_dataset(monkeypatch, tmp_path):
+    """No tree anywhere → skipped marker with guidance, never a number."""
+    from har_tpu.parity import ucihar_parity_lane
+
+    monkeypatch.delenv("HAR_TPU_UCIHAR_ROOT", raising=False)
+    monkeypatch.chdir(tmp_path)  # no ./train or ./data here
+    monkeypatch.setenv("HOME", str(tmp_path))  # ~/data probe isolated too
+    out = ucihar_parity_lane()
+    assert "skipped" in out and "HAR_TPU_UCIHAR_ROOT" in out["skipped"]
+    assert out["expected"]["fig2_accuracy"] == 0.919
+    assert "accuracy" not in out
+
+
+@pytest.mark.slow
+def test_parity_lane_runs_on_fixture_tree(tmp_path, monkeypatch):
+    """End-to-end over a byte-faithful fixture tree: the lane must load,
+    split, CV-fit and report — proving it would run on the real archive.
+    (No 0.91 assertion: the fixture's synthetic Gaussians are not UCI-HAR;
+    they're near-perfectly separable, which the lane must report honestly.)
+    """
+    from har_tpu.parity import ucihar_parity_lane
+
+    base = write_ucihar_fixture(
+        str(tmp_path), n_train=400, n_test=160, seed=3, num_features=64
+    )
+    monkeypatch.setenv("HAR_TPU_UCIHAR_ROOT", base)
+    out = ucihar_parity_lane()
+    assert out["root"] == base
+    assert out["n_train"] + out["n_test"] == 560
+    assert 0.0 <= out["accuracy"] <= 1.0
+    assert "within_tolerance" in out and "weighted_f1" in out
+
+
+@pytest.mark.skipif(
+    __import__("har_tpu.data.ucihar", fromlist=["resolve_ucihar_root"])
+    .resolve_ucihar_root() is None,
+    reason=(
+        "real 'UCI HAR Dataset' tree not present — set HAR_TPU_UCIHAR_ROOT "
+        "to assert the paper's ≈0.91 LR+CV accuracy"
+    ),
+)
+@pytest.mark.slow
+def test_parity_lane_matches_paper_on_real_data():
+    """THE falsifiable claim (VERDICT r3 #5): on the published archive,
+    LR+CV must land in the paper's 0.9102-0.919 band (±0.02)."""
+    from har_tpu.parity import ucihar_parity_lane
+
+    out = ucihar_parity_lane()
+    assert out["within_tolerance"], out
