@@ -141,12 +141,14 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     wps = [float(r.history["windows_per_sec"]) for r in results]
     times = [float(r.history["train_time_s"]) for r in results]
 
+    from har_tpu.utils.mfu import steady_state_fit
+
     steps_per_epoch = -(-len(train_set) // config.batch_size)
     steps_full = steps_per_epoch * config.epochs
     steps_short = steps_per_epoch * epochs_short
     t_full = min(times)
-    step_s = max(
-        (t_full - t_short) / max(steps_full - steps_short, 1), 1e-9
+    step_s, overhead_s = steady_state_fit(
+        t_short, t_full, steps_short, steps_full
     )
     program_flops = per_step_flops * steps_full
     stats = {
@@ -169,9 +171,7 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
         "train_time_s_median": round(float(np.median(times)), 4),
         "program_flops": program_flops,
         "steady_state_step_ms": round(step_s * 1e3, 3),
-        "dispatch_overhead_ms": round(
-            max(t_short - steps_short * step_s, 0.0) * 1e3, 1
-        ),
+        "dispatch_overhead_ms": round(overhead_s * 1e3, 1),
     }
     if per_step_flops:
         stats["achieved_tflops"] = round(
@@ -502,19 +502,28 @@ def main() -> None:
     # devices are fixed at backend init, so the measurement owns its
     # process); embedded here with provenance so the bench line carries
     # the multi-device data point
-    cv_scaling = None
-    scaling_path = (
-        pathlib.Path(__file__).resolve().parent
-        / "artifacts" / "cv_scaling.json"
-    )
-    if scaling_path.exists():
-        try:
-            cv_scaling = json.loads(scaling_path.read_text())
-            cv_scaling["source"] = (
-                "artifacts/cv_scaling.json (scripts/cv_scaling.py)"
-            )
-        except (OSError, ValueError):
-            cv_scaling = None
+    from har_tpu.utils.artifacts import load_artifact
+
+    cv_scaling = load_artifact("cv_scaling.json")
+    if cv_scaling is not None:
+        cv_scaling["source"] = (
+            "artifacts/cv_scaling.json (scripts/cv_scaling.py)"
+        )
+
+    # Which histogram path the tree lanes ran (VERDICT r3 #6b): the
+    # auto policy resolves from the measured comparison in
+    # artifacts/hist_bench.json (scripts/hist_bench.py)
+    from har_tpu.models.tree import auto_pallas_hist
+
+    hist_doc = load_artifact("hist_bench.json") or {}
+    tree_hist = {
+        "path_used": (
+            "pallas" if auto_pallas_hist(None) else "matmul_onehot"
+        ),
+        "measured": hist_doc.get("rows"),
+        "auto_policy": hist_doc.get("auto_policy"),
+        "source": "artifacts/hist_bench.json (scripts/hist_bench.py)",
+    }
 
     best_acc = max(acc, gb_acc)
     best_wps = max(windows_per_sec, cnn_wps, bilstm_wps, tfm_wps)
@@ -559,6 +568,7 @@ def main() -> None:
         "raw_synthetic_n_windows": len(cal),
         "ucihar_parity": ucihar,
         "cv_sweep_scaling": cv_scaling,
+        "tree_histogram": tree_hist,
         "n_train": len(train),
         "split": "spark-exact",
         "backend": jax.default_backend(),

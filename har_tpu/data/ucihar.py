@@ -71,14 +71,18 @@ def _to_table(
 
 
 def _resolve_root(root: str) -> str:
-    """Accept the dir holding train/test or the published zip's nesting."""
-    if os.path.isdir(os.path.join(root, "train")):
-        return root
-    nested = os.path.join(root, "UCI HAR Dataset")
-    if os.path.isdir(os.path.join(nested, "train")):
-        return nested
+    """Accept the dir holding train/test or the published zip's nesting.
+
+    The marker is train/X_train.txt, not a bare train/ directory — any
+    ML-style checkout has a train/ folder, and a false positive here
+    turns resolve_ucihar_root's graceful skip into a FileNotFoundError
+    deep inside the parity lane.
+    """
+    for cand in (root, os.path.join(root, "UCI HAR Dataset")):
+        if os.path.isfile(os.path.join(cand, "train", "X_train.txt")):
+            return cand
     raise FileNotFoundError(
-        f"no UCI-HAR train/ directory under {root!r} "
+        f"no UCI-HAR train/X_train.txt under {root!r} "
         "(or its 'UCI HAR Dataset' subdirectory)"
     )
 

@@ -21,10 +21,14 @@ import jax.numpy as jnp
 from har_tpu.ops.flash_attention import flash_attention, pick_block
 from har_tpu.parallel.ring_attention import full_attention, ring_attention
 
-# sequence length at which fused attention starts paying for itself (the
-# unfused path's (B,H,T,T) f32 score tensor reaches HBM scale; it OOMs a
-# 16G chip around T=8192)
-_FLASH_AUTO_T = 2048
+# sequence length at which the Pallas streaming kernel takes over from
+# XLA's fused attention on a single chip.  Measured crossover
+# (artifacts/long_context_bench.json, r4): XLA is a few percent faster
+# below 8k tokens, the kernel is >=1.0x from 8k and the only path that
+# still compiles once the fused attention's working set outgrows HBM
+# (attention-only probe: XLA stops at T=16384 x 8 heads; the kernel
+# runs to T=65536).
+_FLASH_AUTO_T = 8192
 
 
 def sinusoidal_positions(t: int, dim: int, offset) -> jax.Array:
@@ -42,9 +46,9 @@ class EncoderBlock(nn.Module):
     num_heads: int
     dtype: jnp.dtype
     sp_axis: str | None
-    # None = auto: Pallas flash attention for T >= _FLASH_AUTO_T (where
-    # XLA's unfused path materializes (B,H,T,T) scores in HBM and OOMs by
-    # T=8192); plain XLA below it (faster at short T, same numerics family)
+    # None = auto: Pallas flash attention for T >= _FLASH_AUTO_T (the
+    # measured crossover — see _FLASH_AUTO_T's comment); plain XLA below
+    # it (faster at short T, same numerics family)
     use_flash: bool | None = None
 
     @nn.compact

@@ -43,6 +43,23 @@ def chip_peak_flops(device=None) -> float | None:
     return None
 
 
+def steady_state_fit(
+    t_short: float, t_full: float, steps_short: int, steps_full: int
+) -> tuple[float, float]:
+    """(step_seconds, dispatch_overhead_seconds) from two fit timings.
+
+    The two-point split: slope = in-program step time, intercept = fixed
+    dispatch/transfer latency.  The single definition shared by bench.py's
+    neural_lane and scripts/mfu_tune.py so the bench's steady MFU and the
+    tuning sweep's can never drift apart.
+    """
+    step_s = max(
+        (t_full - t_short) / max(steps_full - steps_short, 1), 1e-9
+    )
+    overhead_s = max(t_short - steps_short * step_s, 0.0)
+    return step_s, overhead_s
+
+
 def mfu_fields(
     prefix: str, history: dict, peak: float | None
 ) -> dict[str, float]:

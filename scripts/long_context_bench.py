@@ -185,34 +185,43 @@ def main() -> None:
         results.append(row)
         print(json.dumps(row))
 
-    out_dir = os.path.join(
+    out_path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "artifacts",
+        "long_context_bench.json",
     )
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "long_context_bench.json"), "w") as f:
-        json.dump(
-            {
-                "backend": jax.default_backend(),
-                "note": (
-                    "per-forward time, median of 3 x 50-iteration "
-                    "compiled loops (dispatch amortized), Transformer1D "
-                    "embed 128 x 2 layers; flash = Pallas "
-                    "streaming-softmax kernel (r4: K/V streamed on the "
-                    "grid with VMEM scratch accumulators, bf16 MXU "
-                    "matmuls with f32 accumulation — the r3 kernel "
-                    "upcast to f32/HIGHEST and lost 0.66-0.99x).  "
-                    "Where XLA's own fused attention still compiles it "
-                    "is a close match; past its ceiling (OOM rows) the "
-                    "streaming kernel is the only single-chip option, "
-                    "and it is also the building block ring attention "
-                    "(parallel/ring_attention.py) runs per shard"
-                ),
-                "rows": results,
-            },
-            f,
-            indent=2,
-        )
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    # merge-preserve: the --attention-only probe writes its rows into
+    # this same artifact from its own process; a fresh main() sweep must
+    # update its keys without destroying that evidence
+    doc = {}
+    if os.path.exists(out_path):
+        try:
+            doc = json.load(open(out_path))
+        except ValueError:
+            doc = {}
+    doc.update(
+        {
+            "backend": jax.default_backend(),
+            "note": (
+                "per-forward time, median of 3 x 50-iteration "
+                "compiled loops (dispatch amortized), Transformer1D "
+                "embed 128 x 2 layers; flash = Pallas "
+                "streaming-softmax kernel (r4: K/V streamed on the "
+                "grid with VMEM scratch accumulators, bf16 MXU "
+                "matmuls with f32 accumulation — the r3 kernel "
+                "upcast to f32/HIGHEST and lost 0.66-0.99x).  "
+                "Where XLA's own fused attention still compiles it "
+                "is a close match; past its ceiling (OOM rows) the "
+                "streaming kernel is the only single-chip option, "
+                "and it is also the building block ring attention "
+                "(parallel/ring_attention.py) runs per shard"
+            ),
+            "rows": results,
+        }
+    )
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
     print("wrote artifacts/long_context_bench.json")
 
 

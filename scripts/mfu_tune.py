@@ -71,10 +71,13 @@ def measure(
     ]
     t_full = min(float(r.history["train_time_s"]) for r in fulls)
 
+    from har_tpu.utils.mfu import steady_state_fit
+
     steps_per_epoch = -(-len(train_set) // batch)
-    d_steps = steps_per_epoch * (epochs_full - epochs_short)
-    d_t = max(t_full - t_short, 1e-9)
-    step_s = d_t / d_steps
+    step_s, overhead_s = steady_state_fit(
+        t_short, t_full,
+        steps_per_epoch * epochs_short, steps_per_epoch * epochs_full,
+    )
     peak = chip_peak_flops()
     steady = per_step_flops / step_s
     total_flops = per_step_flops * steps_per_epoch * epochs_full
@@ -86,9 +89,7 @@ def measure(
         "t_short_s": round(t_short, 4),
         "t_full_s": round(t_full, 4),
         "steady_step_ms": round(step_s * 1e3, 3),
-        "dispatch_overhead_s": round(
-            max(t_short - steps_per_epoch * epochs_short * step_s, 0.0), 3
-        ),
+        "dispatch_overhead_s": round(overhead_s, 3),
         "per_step_gflops": round(per_step_flops / 1e9, 2),
         "steady_tflops": round(steady / 1e12, 2),
         "windows_per_sec": round(len(train_set) * epochs_full / t_full, 1),
