@@ -18,7 +18,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from har_tpu.ops.flash_attention import flash_attention, pick_block
+from har_tpu.ops.flash_attention import (
+    MIN_HEAD_DIM,
+    flash_attention,
+    pick_block,
+)
 from har_tpu.parallel.ring_attention import full_attention, ring_attention
 
 # sequence length at which the Pallas streaming kernel takes over from
@@ -67,10 +71,13 @@ class EncoderBlock(nn.Module):
             attn = ring_attention(q, k, v, self.sp_axis)
         else:
             flash = (
-                # auto mode requires a real TPU: off-TPU the Pallas kernel
-                # runs in interpret mode, which is serial and far slower
-                # than XLA's fused attention
-                t >= _FLASH_AUTO_T and jax.default_backend() == "tpu"
+                # auto mode requires a real TPU (off-TPU the Pallas
+                # kernel runs in interpret mode, far slower than XLA's
+                # fused attention) and head_dim >= 32 (sub-lane head
+                # dims fault the kernel — flash_attention refuses them)
+                t >= _FLASH_AUTO_T
+                and jax.default_backend() == "tpu"
+                and head_dim >= MIN_HEAD_DIM
                 if self.use_flash is None
                 else self.use_flash
             )

@@ -30,6 +30,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# Minimum head dim the Mosaic-compiled kernel supports: sub-lane head
+# dims (observed at d=16) deterministically fault the TPU worker on
+# v5e.  flash_attention refuses smaller; Transformer1D's auto mode
+# imports this so the gate and the guard cannot drift apart.
+MIN_HEAD_DIM = 32
+
+
 def pick_block(t: int, max_block: int = 512) -> int:
     """Largest divisor of ``t`` that is ≤ max_block (kernel needs uniform
     blocks; returns 0 when only degenerate divisors exist)."""
@@ -220,6 +227,12 @@ def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128):
     `full_attention` under `jax.grad`.
     """
     b, t, h, d = q.shape
+    if d < MIN_HEAD_DIM:
+        raise ValueError(
+            f"flash_attention requires head_dim >= {MIN_HEAD_DIM}, "
+            f"got {d} (sub-lane head dims fault the TPU kernel; use "
+            "full_attention)"
+        )
     if t % block_q or t % block_k:
         # a non-dividing block would silently attend over only
         # (t // block) * block positions — refuse loudly instead

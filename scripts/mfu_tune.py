@@ -148,13 +148,22 @@ def main(argv):
             # Pallas flash attention at T=200 (single 200-block): the
             # unfused path's (B,H,T,T) f32 scores are the HBM hog at
             # these shapes — measure whether fusing pays below the
-            # _FLASH_AUTO_T=2048 threshold too
+            # flash auto threshold too (measured loser here: 10.6% vs
+            # 20.8% steady — which is why _FLASH_AUTO_T sits at 8192)
             dict(batch=1024, epochs_short=10, epochs_full=60,
                  model_kwargs={"embed_dim": 256, "num_heads": 8,
                                "use_flash": True}),
-            dict(batch=1024, epochs_short=20, epochs_full=100,
-                 model_kwargs={"embed_dim": 128, "num_heads": 8,
-                               "use_flash": True}),
+            # (embed 128 x 8 heads + use_flash is NOT in the grid: head
+            # dim 16 is below the kernel's supported minimum — it
+            # deterministically faults the TPU worker; flash_attention
+            # now refuses such shapes loudly)
+            # head shape: 4 x 64-dim heads vs 8 x 32-dim at the same
+            # embed — fatter heads tile the MXU's 128-lane contraction
+            # better in the attention matmuls
+            dict(batch=1024, epochs_short=10, epochs_full=60,
+                 model_kwargs={"embed_dim": 256, "num_heads": 4}),
+            dict(batch=1024, epochs_short=10, epochs_full=60,
+                 model_kwargs={"embed_dim": 512, "num_heads": 8}),
         ],
         "bilstm": [
             dict(batch=2048, epochs_short=10, epochs_full=60,

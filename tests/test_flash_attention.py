@@ -14,7 +14,7 @@ from har_tpu.ops.flash_attention import (
 from har_tpu.parallel.ring_attention import full_attention
 
 
-def _qkv(b=2, t=64, h=2, d=16, seed=0, dtype=jnp.float32):
+def _qkv(b=2, t=64, h=2, d=32, seed=0, dtype=jnp.float32):
     rng = np.random.default_rng(seed)
     mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
     return mk(), mk(), mk()
@@ -140,6 +140,14 @@ def test_non_dividing_block_raises():
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
+def test_sub_lane_head_dim_raises():
+    """head_dim < MIN_HEAD_DIM faults the TPU worker (observed at d=16)
+    — the kernel must refuse before it reaches Mosaic."""
+    q, k, v = _qkv(d=16)
+    with pytest.raises(ValueError, match="head_dim"):
+        flash_attention(q, k, v, block_q=32, block_k=32)
+
+
 @pytest.mark.slow
 def test_transformer_flash_matches_xla_path():
     from har_tpu.models.transformer import Transformer1D
@@ -147,8 +155,8 @@ def test_transformer_flash_matches_xla_path():
     x = jnp.asarray(
         np.random.default_rng(0).normal(size=(2, 64, 3)), jnp.float32
     )
-    kw = dict(
-        num_classes=6, embed_dim=16, num_heads=2, num_layers=1,
+    kw = dict(  # head_dim 32: the kernel's supported minimum
+        num_classes=6, embed_dim=64, num_heads=2, num_layers=1,
         dtype=jnp.float32,
     )
     flash = Transformer1D(**kw, use_flash=True)
