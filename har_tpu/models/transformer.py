@@ -23,7 +23,11 @@ from har_tpu.ops.flash_attention import (
     flash_attention,
     pick_block,
 )
-from har_tpu.parallel.ring_attention import full_attention, ring_attention
+from har_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention,
+    ring_flash_attention,
+)
 
 # sequence length at which the Pallas streaming kernel takes over from
 # XLA's fused attention on a single chip.  Measured crossover
@@ -68,7 +72,30 @@ class EncoderBlock(nn.Module):
         k = k.reshape(b, t, h, head_dim)
         v = v.reshape(b, t, h, head_dim)
         if self.sp_axis is not None:
-            attn = ring_attention(q, k, v, self.sp_axis)
+            # per-hop local attention: the einsum ring materializes a
+            # (B, H, T_local, T_local) score tile per hop; once the
+            # local block crosses the same threshold as the single-chip
+            # path, run the Pallas kernel per hop instead and merge
+            # hops by logaddexp (ring_flash_attention — exact)
+            if self.use_flash and head_dim < MIN_HEAD_DIM:
+                # same contract as the single-chip path: an explicit
+                # flash request for a shape the kernel refuses must fail
+                # loudly, not silently run the score-materializing ring
+                raise ValueError(
+                    f"use_flash=True requires head_dim >= {MIN_HEAD_DIM}"
+                    f", got {head_dim}"
+                )
+            ring_flash = (
+                t >= _FLASH_AUTO_T
+                and jax.default_backend() == "tpu"
+                and head_dim >= MIN_HEAD_DIM
+                if self.use_flash is None
+                else self.use_flash
+            ) and pick_block(t) > 0
+            if ring_flash:
+                attn = ring_flash_attention(q, k, v, self.sp_axis)
+            else:
+                attn = ring_attention(q, k, v, self.sp_axis)
         else:
             flash = (
                 # auto mode requires a real TPU (off-TPU the Pallas

@@ -78,6 +78,19 @@ def _flash_kernel(
         den_ref[...] = jnp.zeros_like(den_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    _online_softmax_step(
+        q_ref, k_ref, v_ref, m_ref, den_ref, acc_ref, scale
+    )
+
+    @pl.when(j == n_kb - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / den_ref[...]).astype(o_ref.dtype)
+
+
+def _online_softmax_step(
+    q_ref, k_ref, v_ref, m_ref, den_ref, acc_ref, scale: float
+):
+    """Fold one K/V block into the running-softmax scratch state."""
     q = q_ref[0]  # (TQ, D)
     s = jax.lax.dot_general(
         q, k_ref[0], (((1,), (1,)), ((), ())),
@@ -94,28 +107,71 @@ def _flash_kernel(
         preferred_element_type=jnp.float32,
     )
 
+
+def _flash_kernel_lse(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, den_ref, acc_ref,
+    *, n_kb: int, scale: float,
+):
+    """_flash_kernel + per-query log-sum-exp output.
+
+    The LSE is what lets partial attention results combine exactly:
+    ring attention runs this kernel on each hop's LOCAL K/V block and
+    merges hops with a logaddexp reweighting (ring_flash_attention) —
+    softmax over the full ring without any hop materializing scores.
+    The shared body is _online_softmax_step; only the finish differs.
+    """
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        den_ref[...] = jnp.zeros_like(den_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    _online_softmax_step(
+        q_ref, k_ref, v_ref, m_ref, den_ref, acc_ref, scale
+    )
+
     @pl.when(j == n_kb - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / den_ref[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(den_ref[...])
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k"))
-def _flash_bht(q, k, v, block_q: int, block_k: int):
-    """(BH, T, D) fused attention."""
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "with_lse")
+)
+def _flash_bht(q, k, v, block_q: int, block_k: int, with_lse: bool = False):
+    """(BH, T, D) fused attention; with_lse adds a (BH, T, 1) f32 output.
+
+    One pallas_call plumbing for both kernels — grid, BlockSpecs and
+    scratch are identical; only the out list and the finish differ.
+    """
     bh, t, d = q.shape
     scale = d**-0.5
     n_kb = t // block_k
-    kernel = functools.partial(_flash_kernel, n_kb=n_kb, scale=scale)
+    kernel = functools.partial(
+        _flash_kernel_lse if with_lse else _flash_kernel,
+        n_kb=n_kb, scale=scale,
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
+    out_specs = [q_spec]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, 1), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+        )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=out_shape if with_lse else out_shape[0],
         grid=(bh, t // block_q, n_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            q_spec,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=out_specs if with_lse else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # denominator
@@ -128,6 +184,77 @@ def _flash_bht(q, k, v, block_q: int, block_k: int):
         ),
         interpret=jax.default_backend() != "tpu",
     )(q, k, v)
+
+
+def _attention_with_lse_ref(q, k, v):
+    """(out, lse) via plain XLA — the differentiable recompute twin of
+    the lse kernel (f32 scores; materializes (B,H,T,Tk) in the backward
+    only, which at ring-hop block sizes is the per-hop score tile)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B,H,Tq)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(
+    q, k, v, block_q: int = 128, block_k: int = 128
+):
+    """Fused attention returning (out (B,T,H,D), lse (B,H,T) float32).
+
+    ``lse[b,h,t] = log Σ_k exp(q·k/√d)`` — the per-query normalizer that
+    makes partial results over disjoint key sets exactly mergeable
+    (ring_flash_attention).  Gradients flow through an XLA recompute of
+    both outputs (lse included: the ring merge differentiates through
+    its softmax weights).
+    """
+    b, t, h, d = q.shape
+    if d < MIN_HEAD_DIM:
+        raise ValueError(
+            f"flash_attention requires head_dim >= {MIN_HEAD_DIM}, "
+            f"got {d}"
+        )
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"block_q={block_q}/block_k={block_k} must divide T={t} "
+            "(use pick_block)"
+        )
+    to_bht = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out, lse = _flash_bht(
+        to_bht(q), to_bht(k), to_bht(v), block_q, block_k, with_lse=True
+    )
+    return (
+        out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, t),
+    )
+
+
+def _flash_lse_fwd(q, k, v, block_q, block_k):
+    out, lse = flash_attention_with_lse(q, k, v, block_q, block_k)
+    return (out, lse), (q, k, v, out)
+
+
+def _flash_lse_bwd(block_q, block_k, residuals, g):
+    q, k, v, out = residuals
+    g_out, g_lse = g
+    if q.shape[1] <= _BWD_FULL_T:
+        _, vjp = jax.vjp(_attention_with_lse_ref, q, k, v)
+        return vjp((g_out, g_lse))
+    # past the full-recompute threshold the score tile must never be
+    # materialized — exactly the regime ring_flash_attention auto-selects
+    return _chunked_attention_bwd(
+        q, k, v, out, g_out, block_k, g_lse=g_lse
+    )
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _attention_reference(q, k, v):
@@ -147,7 +274,7 @@ def _attention_reference(q, k, v):
 _BWD_FULL_T = 1024
 
 
-def _chunked_attention_bwd(q, k, v, out, g, block_k: int):
+def _chunked_attention_bwd(q, k, v, out, g, block_k: int, g_lse=None):
     """Flash-style backward: O(T·block) memory, never materializes scores.
 
     Standard decomposition (dV = Pᵀ dO; dS = P ∘ (dP − D) with
@@ -155,6 +282,10 @@ def _chunked_attention_bwd(q, k, v, out, g, block_k: int):
     `lax.scan`, with the softmax normalizer recomputed by an online
     logsumexp pass — the same recurrence the forward kernel runs.
     All inputs (B, T, H, D); f32 internally; returns grads in input dtype.
+
+    ``g_lse`` (B, H, T) is the cotangent of the log-sum-exp output when
+    backpropagating through flash_attention_with_lse: ∂lse/∂s_k = p_k,
+    so it folds into the same bracket — dS = P ∘ (dP − D + g_lse).
     """
     in_dtype = q.dtype
     bhtd = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
@@ -185,6 +316,8 @@ def _chunked_attention_bwd(q, k, v, out, g, block_k: int):
     (m, l), _ = jax.lax.scan(lse_step, (m0, l0), kb)
     lse = m + jnp.log(l)  # (B, H, T, 1)
     d_vec = (gh * oh).sum(-1, keepdims=True)  # rowsum(dO ∘ O)
+    if g_lse is not None:
+        d_vec = d_vec - g_lse.astype(jnp.float32)[..., None]
 
     def bwd_step(dq, blk):
         kblk, vblk = blk

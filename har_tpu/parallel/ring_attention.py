@@ -85,3 +85,65 @@ def ring_attention(
     )
     out = (num / den[..., None]).astype(q.dtype)  # (B,H,Tq,D)
     return out.transpose(0, 2, 1, 3)  # (B,Tq,H,D)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    block: int = 0,
+) -> jax.Array:
+    """Ring attention whose per-hop LOCAL block runs the Pallas kernel.
+
+    `ring_attention` materializes a (B, H, Tq_local, Tk_local) score
+    tensor per hop — fine at small local blocks, the HBM hog once
+    T_local grows.  Here each hop computes its local contribution with
+    `flash_attention_with_lse` (O(block) VMEM, scores never leave the
+    chip) and hops merge by exact logaddexp reweighting:
+
+        out = Σ_i out_i · exp(lse_i − L),  L = log Σ_i exp(lse_i)
+
+    which is the same online-softmax algebra the kernel runs internally,
+    applied once per ring hop.  ``block=0`` picks the largest usable
+    block (pick_block).  Must be called inside `shard_map` like
+    `ring_attention`; gradients flow via recompute of this forward
+    (jax.checkpoint-friendly: everything is jittable collectives).
+    """
+    from har_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        pick_block,
+    )
+
+    axis_size = jax.lax.axis_size(axis_name)
+    b, t_q, h, d = q.shape
+    blk = block or pick_block(k.shape[1])
+    if not blk:
+        raise ValueError(
+            f"no usable flash block for local T={k.shape[1]}; pass "
+            "block= or use ring_attention"
+        )
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, _):
+        k_blk, v_blk, out_acc, lse_acc = carry
+        out_i, lse_i = flash_attention_with_lse(
+            q, k_blk, v_blk, block_q=min(blk, t_q), block_k=blk
+        )  # (B,T,H,D), (B,H,T)
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        w_old = jnp.exp(lse_acc - lse_new)  # (B,H,T)
+        w_new = jnp.exp(lse_i - lse_new)
+        reweigh = lambda w: w.transpose(0, 2, 1)[..., None]  # (B,T,H,1)
+        out_acc = (
+            out_acc * reweigh(w_old)
+            + out_i.astype(jnp.float32) * reweigh(w_new)
+        )
+        k_blk, v_blk = jax.lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_blk, v_blk, out_acc, lse_new), None
+
+    out0 = jnp.zeros((b, t_q, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    (_, _, out, _), _ = jax.lax.scan(
+        step, (k, v, out0, lse0), None, length=axis_size
+    )
+    return out.astype(q.dtype)
