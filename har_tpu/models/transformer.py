@@ -77,21 +77,29 @@ class EncoderBlock(nn.Module):
             # local block crosses the same threshold as the single-chip
             # path, run the Pallas kernel per hop instead and merge
             # hops by logaddexp (ring_flash_attention — exact)
-            if self.use_flash and head_dim < MIN_HEAD_DIM:
+            if self.use_flash:
                 # same contract as the single-chip path: an explicit
                 # flash request for a shape the kernel refuses must fail
                 # loudly, not silently run the score-materializing ring
-                raise ValueError(
-                    f"use_flash=True requires head_dim >= {MIN_HEAD_DIM}"
-                    f", got {head_dim}"
-                )
+                if head_dim < MIN_HEAD_DIM:
+                    raise ValueError(
+                        "use_flash=True requires head_dim >= "
+                        f"{MIN_HEAD_DIM}, got {head_dim}"
+                    )
+                if not pick_block(t):
+                    raise ValueError(
+                        f"use_flash=True: local T={t} has no usable "
+                        "flash block (pick_block); pad the sequence or "
+                        "drop use_flash"
+                    )
             ring_flash = (
                 t >= _FLASH_AUTO_T
                 and jax.default_backend() == "tpu"
                 and head_dim >= MIN_HEAD_DIM
+                and pick_block(t) > 0
                 if self.use_flash is None
                 else self.use_flash
-            ) and pick_block(t) > 0
+            )
             if ring_flash:
                 attn = ring_flash_attention(q, k, v, self.sp_axis)
             else:

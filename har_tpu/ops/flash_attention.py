@@ -48,8 +48,8 @@ def pick_block(t: int, max_block: int = 512) -> int:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, den_ref, acc_ref,
-    *, n_kb: int, scale: float,
+    q_ref, k_ref, v_ref, o_ref, *refs,
+    n_kb: int, scale: float,
 ):
     """One (bh, q-block, k-block) grid step.
 
@@ -59,6 +59,12 @@ def _flash_kernel(
     T=32768), and Mosaic pipelines the next K/V fetch behind this step's
     matmuls.  Softmax state (running max / denominator / f32 numerator)
     lives in scratch across those steps.
+
+    ``refs`` is (m, den, acc) scratch, optionally preceded by an lse
+    output ref (with_lse in _flash_bht): the per-query log-sum-exp is
+    what lets partial attention results over disjoint key sets combine
+    exactly — ring attention runs this kernel per hop and merges with a
+    logaddexp reweighting (ring_flash_attention).
 
     Matmul inputs stay in the model dtype (bf16) with f32 MXU
     accumulation — the same numerics family as XLA's fused attention.
@@ -70,6 +76,8 @@ def _flash_kernel(
     the (sublane, lane) layout and miscompile reductions on some Mosaic
     versions — 2-D keepdims reductions are the supported path.
     """
+    lse_ref = refs[0] if len(refs) == 4 else None
+    m_ref, den_ref, acc_ref = refs[-3:]
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -85,6 +93,8 @@ def _flash_kernel(
     @pl.when(j == n_kb - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / den_ref[...]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = m_ref[...] + jnp.log(den_ref[...])
 
 
 def _online_softmax_step(
@@ -108,36 +118,6 @@ def _online_softmax_step(
     )
 
 
-def _flash_kernel_lse(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, den_ref, acc_ref,
-    *, n_kb: int, scale: float,
-):
-    """_flash_kernel + per-query log-sum-exp output.
-
-    The LSE is what lets partial attention results combine exactly:
-    ring attention runs this kernel on each hop's LOCAL K/V block and
-    merges hops with a logaddexp reweighting (ring_flash_attention) —
-    softmax over the full ring without any hop materializing scores.
-    The shared body is _online_softmax_step; only the finish differs.
-    """
-    j = pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        den_ref[...] = jnp.zeros_like(den_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    _online_softmax_step(
-        q_ref, k_ref, v_ref, m_ref, den_ref, acc_ref, scale
-    )
-
-    @pl.when(j == n_kb - 1)
-    def _finish():
-        o_ref[0] = (acc_ref[...] / den_ref[...]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[...] + jnp.log(den_ref[...])
-
-
 @functools.partial(
     jax.jit, static_argnames=("block_q", "block_k", "with_lse")
 )
@@ -150,10 +130,7 @@ def _flash_bht(q, k, v, block_q: int, block_k: int, with_lse: bool = False):
     bh, t, d = q.shape
     scale = d**-0.5
     n_kb = t // block_k
-    kernel = functools.partial(
-        _flash_kernel_lse if with_lse else _flash_kernel,
-        n_kb=n_kb, scale=scale,
-    )
+    kernel = functools.partial(_flash_kernel, n_kb=n_kb, scale=scale)
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
     out_specs = [q_spec]
@@ -189,7 +166,8 @@ def _flash_bht(q, k, v, block_q: int, block_k: int, with_lse: bool = False):
 def _attention_with_lse_ref(q, k, v):
     """(out, lse) via plain XLA — the differentiable recompute twin of
     the lse kernel (f32 scores; materializes (B,H,T,Tk) in the backward
-    only, which at ring-hop block sizes is the per-hop score tile)."""
+    only, which at ring-hop block sizes is the per-hop score tile).
+    `_attention_reference` is this function's out half — one body."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -238,19 +216,21 @@ def flash_attention_with_lse(
 
 def _flash_lse_fwd(q, k, v, block_q, block_k):
     out, lse = flash_attention_with_lse(q, k, v, block_q, block_k)
-    return (out, lse), (q, k, v, out)
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_lse_bwd(block_q, block_k, residuals, g):
-    q, k, v, out = residuals
+    q, k, v, out, lse = residuals
     g_out, g_lse = g
     if q.shape[1] <= _BWD_FULL_T:
         _, vjp = jax.vjp(_attention_with_lse_ref, q, k, v)
         return vjp((g_out, g_lse))
     # past the full-recompute threshold the score tile must never be
-    # materialized — exactly the regime ring_flash_attention auto-selects
+    # materialized — exactly the regime ring_flash_attention auto-selects.
+    # The forward's lse rides the residuals, sparing the backward its
+    # logsumexp recompute scan.
     return _chunked_attention_bwd(
-        q, k, v, out, g_out, block_k, g_lse=g_lse
+        q, k, v, out, g_out, block_k, g_lse=g_lse, lse=lse
     )
 
 
@@ -258,13 +238,10 @@ flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _attention_reference(q, k, v):
-    """XLA attention on (B, T, H, D), f32 internally — the vjp recompute."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+    """XLA attention on (B, T, H, D), f32 internally — the vjp recompute.
+    The lse output gets a zero cotangent through the [0], so the vjp is
+    identical to the pre-lse body."""
+    return _attention_with_lse_ref(q, k, v)[0]
 
 
 # below this T the full-recompute backward (one fused XLA attention vjp) is
@@ -274,7 +251,9 @@ def _attention_reference(q, k, v):
 _BWD_FULL_T = 1024
 
 
-def _chunked_attention_bwd(q, k, v, out, g, block_k: int, g_lse=None):
+def _chunked_attention_bwd(
+    q, k, v, out, g, block_k: int, g_lse=None, lse=None
+):
     """Flash-style backward: O(T·block) memory, never materializes scores.
 
     Standard decomposition (dV = Pᵀ dO; dS = P ∘ (dP − D) with
@@ -286,6 +265,8 @@ def _chunked_attention_bwd(q, k, v, out, g, block_k: int, g_lse=None):
     ``g_lse`` (B, H, T) is the cotangent of the log-sum-exp output when
     backpropagating through flash_attention_with_lse: ∂lse/∂s_k = p_k,
     so it folds into the same bracket — dS = P ∘ (dP − D + g_lse).
+    ``lse`` (B, H, T), when the caller saved the forward kernel's value,
+    skips the online-logsumexp recompute scan (one QKᵀ pass per block).
     """
     in_dtype = q.dtype
     bhtd = lambda x: x.transpose(0, 2, 1, 3).astype(jnp.float32)
@@ -298,23 +279,26 @@ def _chunked_attention_bwd(q, k, v, out, g, block_k: int, g_lse=None):
     )
     kb, vb = blocked(kh), blocked(vh)  # (n, B, H, bk, D)
 
-    def lse_step(carry, kblk):
-        m, l = carry
-        s = jnp.einsum(
-            "bhtd,bhkd->bhtk", qh, kblk,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        blk_max = s.max(-1, keepdims=True)
-        new_m = jnp.maximum(m, blk_max)
-        l = l * jnp.exp(m - new_m) + jnp.exp(s - new_m).sum(
-            -1, keepdims=True
-        )
-        return (new_m, l), None
+    if lse is not None:
+        lse = lse.astype(jnp.float32)[..., None]  # (B, H, T, 1)
+    else:
+        def lse_step(carry, kblk):
+            m, l = carry
+            s = jnp.einsum(
+                "bhtd,bhkd->bhtk", qh, kblk,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            blk_max = s.max(-1, keepdims=True)
+            new_m = jnp.maximum(m, blk_max)
+            l = l * jnp.exp(m - new_m) + jnp.exp(s - new_m).sum(
+                -1, keepdims=True
+            )
+            return (new_m, l), None
 
-    m0 = jnp.full((b, h, t, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
-    (m, l), _ = jax.lax.scan(lse_step, (m0, l0), kb)
-    lse = m + jnp.log(l)  # (B, H, T, 1)
+        m0 = jnp.full((b, h, t, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+        (m, l), _ = jax.lax.scan(lse_step, (m0, l0), kb)
+        lse = m + jnp.log(l)  # (B, H, T, 1)
     d_vec = (gh * oh).sum(-1, keepdims=True)  # rowsum(dO ∘ O)
     if g_lse is not None:
         d_vec = d_vec - g_lse.astype(jnp.float32)[..., None]

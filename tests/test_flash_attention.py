@@ -168,3 +168,35 @@ def test_transformer_flash_matches_xla_path():
         rtol=2e-4,
         atol=2e-5,
     )
+
+
+def test_chunked_backward_with_lse_cotangent():
+    """The O(T·block) backward of flash_attention_with_lse — the
+    exactness-critical path for T_local >= _FLASH_AUTO_T ring training —
+    must be grad-exact INCLUDING the lse cotangent term
+    (dS = P∘(dP − D + g_lse)), and must accept the saved forward lse."""
+    import har_tpu.ops.flash_attention as fa
+
+    q, k, v = _qkv(t=64)
+
+    def loss_flash(q, k, v):
+        o, lse = fa.flash_attention_with_lse(
+            q, k, v, block_q=16, block_k=16
+        )
+        return (o ** 2).sum() + (jnp.sin(lse) * 0.1).sum()
+
+    def loss_ref(q, k, v):
+        o, lse = fa._attention_with_lse_ref(q, k, v)
+        return (o ** 2).sum() + (jnp.sin(lse) * 0.1).sum()
+
+    orig = fa._BWD_FULL_T
+    fa._BWD_FULL_T = 0  # force the chunked path at test-size T
+    try:
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa._BWD_FULL_T = orig
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
