@@ -49,13 +49,38 @@ class ConvBlock(nn.Module):
     features: int
     kernel: int
     dtype: jnp.dtype
+    # TPU knobs (measured in scripts/mfu_tune.py): "max" pool is a pure
+    # bandwidth pass over the full (B,T,C) activation; "stride" folds
+    # the 2x downsample into the conv itself (stride-2), removing that
+    # pass.  LayerNorm is two more bandwidth passes; "rms" halves its
+    # reductions, "none" removes them (relu-only).
+    pool: str = "max"        # "max" | "stride"
+    norm: str = "layer"      # "layer" | "rms" | "none"
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, (self.kernel,), dtype=self.dtype)(x)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # refuse typo'd knobs loudly: a fall-through would silently run
+        # a different architecture (no downsample / no norm) and record
+        # mislabeled bench numbers
+        if self.pool not in ("max", "stride"):
+            raise ValueError(f"pool={self.pool!r}; use 'max' or 'stride'")
+        if self.norm not in ("layer", "rms", "none"):
+            raise ValueError(
+                f"norm={self.norm!r}; use 'layer', 'rms' or 'none'"
+            )
+        stride = 2 if self.pool == "stride" else 1
+        x = nn.Conv(
+            self.features, (self.kernel,), strides=(stride,),
+            dtype=self.dtype,
+        )(x)
+        if self.norm == "layer":
+            x = nn.LayerNorm(dtype=self.dtype)(x)
+        elif self.norm == "rms":
+            x = nn.RMSNorm(dtype=self.dtype)(x)
         x = nn.relu(x)
-        return nn.max_pool(x, (2,), strides=(2,))
+        if self.pool == "max":
+            x = nn.max_pool(x, (2,), strides=(2,))
+        return x
 
 
 class CNN1D(nn.Module):
@@ -67,12 +92,17 @@ class CNN1D(nn.Module):
     kernel: int = 5
     dropout_rate: float = 0.3
     dtype: jnp.dtype = jnp.bfloat16
+    pool: str = "max"
+    norm: str = "layer"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         x = x.astype(self.dtype)
         for ch in self.channels:
-            x = ConvBlock(ch, self.kernel, self.dtype)(x)
+            x = ConvBlock(
+                ch, self.kernel, self.dtype,
+                pool=self.pool, norm=self.norm,
+            )(x)
         x = x.mean(axis=-2)  # global average pool over time
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(128, dtype=self.dtype)(x)

@@ -133,6 +133,18 @@ def main(argv):
                  model_kwargs={"channels": (256, 256, 256)}),
             dict(batch=4096, epochs_short=15, epochs_full=100,
                  model_kwargs={"channels": (512, 512, 512)}),
+            # bandwidth knobs at the lane shape: stride-2 convs fold the
+            # downsample into the MXU pass (no max-pool sweep) and
+            # rms/none trims LayerNorm's reduction passes
+            dict(batch=2048, epochs_short=30, epochs_full=300,
+                 model_kwargs={"channels": (256, 256, 256),
+                               "pool": "stride"}),
+            dict(batch=2048, epochs_short=30, epochs_full=300,
+                 model_kwargs={"channels": (256, 256, 256),
+                               "pool": "stride", "norm": "rms"}),
+            dict(batch=2048, epochs_short=30, epochs_full=300,
+                 model_kwargs={"channels": (256, 256, 256),
+                               "pool": "stride", "norm": "none"}),
         ],
         "transformer": [
             dict(batch=512, epochs_short=30, epochs_full=150,

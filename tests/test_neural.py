@@ -375,3 +375,49 @@ def test_fused_bilstm_bf16_stream_and_remat_match_baseline():
         np.asarray(y_rev[..., :8]), np.asarray(y[:, ::-1, 8:]),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_cnn1d_stride_rms_options_train():
+    """The r4 lane config (stride-2 convs + RMSNorm) must train and
+    halve the temporal length per stage exactly like the pooled path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from har_tpu.models.neural import CNN1D
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, 200, 3)), jnp.float32
+    )
+    for kw in (
+        {"pool": "stride", "norm": "rms"},
+        {"pool": "stride", "norm": "none"},
+    ):
+        model = CNN1D(num_classes=6, channels=(8, 8, 8), **kw)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        out = model.apply({"params": params}, x)
+        assert out.shape == (4, 6)
+        g = jax.grad(
+            lambda p: (model.apply({"params": p}, x) ** 2).sum()
+        )(params)
+        assert all(
+            bool(jnp.isfinite(leaf).all()) for leaf in jax.tree.leaves(g)
+        )
+
+
+def test_cnn1d_rejects_unknown_pool_norm():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from har_tpu.models.neural import CNN1D
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 16, 3)), jnp.float32
+    )
+    for kw in ({"pool": "maxpool"}, {"norm": "rmsnorm"}):
+        with pytest.raises(ValueError):
+            CNN1D(num_classes=6, channels=(4,), **kw).init(
+                jax.random.PRNGKey(0), x
+            )
