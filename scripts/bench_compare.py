@@ -37,7 +37,9 @@ def _fmt(v) -> str:
 def _load(path: str) -> dict:
     doc = json.load(open(path))
     # the round driver wraps the bench line: {"cmd":..., "parsed": {...}}
-    return doc.get("parsed", doc)
+    # — and records "parsed": null when the JSON line fell outside its
+    # stdout tail window (BENCH_r03), so fall through on null too
+    return doc.get("parsed") or doc
 
 
 def compare(old_path: str, new_path: str) -> int:
@@ -94,6 +96,10 @@ def compare(old_path: str, new_path: str) -> int:
         "lr_cv_mllib_objective_test_accuracy",
         "dt_parity_test_accuracy",
         "gbdt_test_accuracy",
+        "raw_synthetic_accuracy",
+        "cnn_steady_mfu_pct",
+        "bilstm_steady_mfu_pct",
+        "transformer_steady_mfu_pct",
         "saturation_mfu_pct",
         "saturation_steady_mfu_pct",
     ):
