@@ -222,9 +222,15 @@ def main() -> None:
     from har_tpu.models.logistic_regression import LogisticRegression
     from har_tpu.ops.metrics import evaluate
     from har_tpu.train.trainer import TrainerConfig
-    from har_tpu.utils.mfu import chip_peak_flops
+    from har_tpu.utils.mfu import chip_peak_flops, chip_state_probe
 
     peak = chip_peak_flops()
+
+    # Chip-state probe (~3s, har_tpu.utils.mfu.chip_state_probe): lets
+    # a reader of one bench draw tell a state-limited run from a code
+    # regression — the remote chip/tunnel has session-scale states.
+    chip_probe = chip_state_probe() if peak else None
+
     table, is_real_data = load_table()
     # the reference's exact 3,793/1,625 rows — one membership, every view
     asm = assemble_rows(table)
@@ -624,6 +630,7 @@ def main() -> None:
         "split": "spark-exact",
         "backend": jax.default_backend(),
         "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "chip_state_probe": chip_probe,
         # north-star scorecard (BASELINE.json): report the gap honestly
         "north_star": {
             "accuracy_target": NORTH_STAR_ACCURACY,

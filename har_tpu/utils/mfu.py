@@ -43,6 +43,46 @@ def chip_peak_flops(device=None) -> float | None:
     return None
 
 
+def chip_state_probe(n: int = 4096, iters: int = 200, reps: int = 3):
+    """{matmul_tflops, pct_of_peak} from a pure bf16 matmul chain.
+
+    Isolates the chip from every framework concern (no input pipeline,
+    optimizer, or dispatch-amortization question): a healthy chip lands
+    at 85-95% of peak; meaningfully below that, the session's bench
+    draws are state-limited, not code-limited (the remote chip/tunnel
+    has session-scale states — pure-matmul draws from 90% of peak down
+    to 7% observed within one day).  Best of ``reps`` timed runs; None
+    on failure.  pct_of_peak is None when the chip's peak is unknown —
+    that means "cannot judge", not "degraded".
+    """
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+        f = jax.jit(
+            lambda x: jax.lax.fori_loop(0, iters, lambda _, a: a @ x, x)
+        )
+        np.asarray(f(x))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            best = min(best, time.perf_counter() - t0)
+    except Exception:
+        return None
+    flops = iters * 2 * n**3
+    peak = chip_peak_flops()
+    return {
+        "matmul_tflops": round(flops / best / 1e12, 1),
+        "pct_of_peak": (
+            round(100 * flops / best / peak, 1) if peak else None
+        ),
+    }
+
+
 def steady_state_fit(
     t_short: float, t_full: float, steps_short: int, steps_full: int
 ) -> tuple[float, float]:

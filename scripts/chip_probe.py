@@ -1,0 +1,48 @@
+"""One-number chip-state probe: % of bf16 peak on a pure matmul loop.
+
+The remote chip/tunnel has session-scale performance states — whole-bench
+slowdowns of 30-40% (occasionally far worse) with every lane moving in
+lockstep.  Before reading a bench draw as a regression, run this; the
+probe itself lives in har_tpu.utils.mfu.chip_state_probe (bench.py
+embeds the same number as extra["chip_state_probe"] so every draw
+self-documents the state it was taken in).
+
+    python scripts/chip_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
+
+    from har_tpu.utils.mfu import chip_state_probe
+
+    probe = chip_state_probe()
+    if probe is None:
+        print(json.dumps({"error": "probe failed to run"}))
+        return
+    pct = probe.get("pct_of_peak")
+    out = {
+        **probe,
+        "backend": jax.default_backend(),
+        "verdict": (
+            "unknown chip peak — cannot judge" if pct is None
+            else "healthy" if pct > 70.0
+            else "DEGRADED chip/tunnel state — treat this session's "
+                 "bench draws as state-limited"
+        ),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
