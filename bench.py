@@ -92,7 +92,7 @@ def load_features(table, tr, te, asm=None):
 
 
 def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
-                peak=None):
+                peak=None, steady_ok=True):
     """(model, stats) — stats carries the lane's full config and run
     variance so consecutive bench runs are comparable lane-for-lane
     (VERDICT r2 weak #4: a bench that can't distinguish a regression
@@ -162,8 +162,16 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     # the two-point slope only resolves lanes whose in-program time
     # rises measurably between the fits; for sub-second models the
     # difference drowns in the tunnel's overhead jitter and a clamped
-    # near-zero slope would report absurd steady MFU — omit instead
-    steady_valid = (t_full - t_short) > max(0.25, 0.05 * t_full)
+    # near-zero slope would report absurd steady MFU — omit instead.
+    # steady_ok=False (degraded-chip mode) suppresses the fields
+    # entirely: reduced epochs + single runs make the slope noise-
+    # dominated exactly when chip jitter is worst, and equal short/full
+    # step counts (epochs reduced to 1) would "fit" pure jitter.
+    steady_valid = (
+        steady_ok
+        and steps_full > steps_short
+        and (t_full - t_short) > max(0.25, 0.05 * t_full)
+    )
     program_flops = per_step_flops * steps_full
     stats = {
         "model": name,
@@ -234,6 +242,32 @@ def main() -> None:
     chip_probe = (
         chip_state_probe(iters=100, reps=2) if peak else None
     )
+    # Severely degraded chip (<12% of peak on a pure matmul chain —
+    # observed pinned at 3-12% for hours under external contention):
+    # full-size lanes would overrun the driver's budget and record
+    # NOTHING.  Scale the neural lanes down and say so in the draw —
+    # a reduced, honestly-labeled number beats a timeout.
+    probe_pct = (chip_probe or {}).get("pct_of_peak")
+    # tiers: <12% of peak → epochs/3, <4% → epochs/6 (a /3 run at a
+    # 1.7% chip still measured 554s — one tier is not enough at the
+    # bottom of the observed state distribution)
+    reduction = (
+        6 if probe_pct is not None and probe_pct < 4.0
+        else 3 if probe_pct is not None and probe_pct < 12.0
+        else 1
+    )
+    degraded = reduction > 1
+    if degraded:
+        print(
+            f"warning: degraded chip state ({probe_pct}% of peak) — "
+            f"running lanes at epochs/{reduction}",
+            file=sys.stderr,
+        )
+
+    def lane_epochs(e: int) -> int:
+        return max(1, e // reduction)
+
+    lane_runs = 1 if degraded else 2
 
     table, is_real_data = load_table()
     # the reference's exact 3,793/1,625 rows — one membership, every view
@@ -274,11 +308,12 @@ def main() -> None:
         "mlp",
         train,
         TrainerConfig(
-            batch_size=512, epochs=epochs, learning_rate=3e-3,
-            weight_decay=1e-4, seed=0,
+            batch_size=512, epochs=lane_epochs(epochs),
+            learning_rate=3e-3, weight_decay=1e-4, seed=0,
         ),
-        runs=2,
+        runs=lane_runs,
         peak=peak,
+        steady_ok=not degraded,
     )
     windows_per_sec = mlp_stats["windows_per_sec_best"]
     train_time = mlp_stats["train_time_s_best"]
@@ -311,12 +346,15 @@ def main() -> None:
     _, cnn_stats = neural_lane(
         "cnn1d",
         raw_train,
-        TrainerConfig(batch_size=2048, epochs=150, learning_rate=2e-3),
+        TrainerConfig(
+            batch_size=2048, epochs=lane_epochs(150), learning_rate=2e-3
+        ),
         model_kwargs={
             "channels": (256, 256, 256), "pool": "stride", "norm": "rms",
         },
-        runs=2,
+        runs=lane_runs,
         peak=peak,
+        steady_ok=not degraded,
     )
     cnn_wps = cnn_stats["windows_per_sec_best"]
     cnn_time = cnn_stats["train_time_s_best"]
@@ -333,10 +371,13 @@ def main() -> None:
     _, bilstm_stats = neural_lane(
         "bilstm",
         raw_train,
-        TrainerConfig(batch_size=8192, epochs=60, learning_rate=2e-3),
+        TrainerConfig(
+            batch_size=8192, epochs=lane_epochs(60), learning_rate=2e-3
+        ),
         model_kwargs={"bf16_stream": True, "remat": True},
-        runs=2,
+        runs=lane_runs,
         peak=peak,
+        steady_ok=not degraded,
     )
     bilstm_wps = bilstm_stats["windows_per_sec_best"]
     bilstm_time = bilstm_stats["train_time_s_best"]
@@ -354,10 +395,13 @@ def main() -> None:
         # latency (at 20 epochs the e2e MFU straddled the 15% target
         # run-to-run; steady_mfu_pct is the state-independent number —
         # the tunnel's per-fit overhead swings 2-13s between sessions)
-        TrainerConfig(batch_size=1024, epochs=25, learning_rate=1e-3),
+        TrainerConfig(
+            batch_size=1024, epochs=lane_epochs(25), learning_rate=1e-3
+        ),
         model_kwargs={"embed_dim": 256, "num_heads": 8},
-        runs=2,
+        runs=lane_runs,
         peak=peak,
+        steady_ok=not degraded,
     )
     tfm_wps = tfm_stats["windows_per_sec_best"]
     tfm_time = tfm_stats["train_time_s_best"]
@@ -378,10 +422,14 @@ def main() -> None:
     _, sat_stats = neural_lane(
         "transformer",
         sat_train,
-        TrainerConfig(batch_size=sat_batch, epochs=5, learning_rate=1e-3),
+        TrainerConfig(
+            batch_size=sat_batch, epochs=lane_epochs(5),
+            learning_rate=1e-3,
+        ),
         model_kwargs=sat_kwargs,
-        runs=2,
+        runs=lane_runs,
         peak=peak,
+        steady_ok=not degraded,
     )
     sat_stats["mfu_target_pct"] = 30.0
     sat_t_full = sat_stats["train_time_s_best"]
@@ -525,7 +573,11 @@ def main() -> None:
         cal_est = NeuralClassifier(
             "cnn1d",
             config=TrainerConfig(
-                batch_size=1024, epochs=40, learning_rate=2e-3, seed=0
+                # floor at 13 epochs: this lane's ≥0.97 measurement is
+                # its whole point (13 measured 0.979; 6 undertrains to
+                # 0.75) and even a floored run costs ~20s worst-case
+                batch_size=1024, epochs=max(13, lane_epochs(40)),
+                learning_rate=2e-3, seed=0,
             ),
             model_kwargs={"channels": (128, 128, 128)},
         )
@@ -589,7 +641,7 @@ def main() -> None:
     best_wps = max(windows_per_sec, cnn_wps, bilstm_wps, tfm_wps)
     extra = {
         "mlp_train_time_s": round(train_time, 4),
-        "mlp_epochs": epochs,
+        "mlp_epochs": lane_epochs(epochs),
         "mlp_test_accuracy": round(acc, 4),
         "gbdt_test_accuracy": round(gb_acc, 4),
         "gbdt_train_time_s": round(gb_time, 4),
@@ -635,6 +687,7 @@ def main() -> None:
         "backend": jax.default_backend(),
         "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "chip_state_probe": chip_probe,
+        "degraded_state_mode": degraded,
         # north-star scorecard (BASELINE.json): report the gap honestly
         "north_star": {
             "accuracy_target": NORTH_STAR_ACCURACY,
@@ -695,6 +748,10 @@ def main() -> None:
         "value": round(windows_per_sec, 1),
         "unit": "windows/s",
         "vs_baseline": round(windows_per_sec / REFERENCE_ROWS_PER_SEC, 2),
+        # adjacent to the numbers it qualifies: a degraded-chip draw's
+        # headline must carry its own label, not bury it in extra
+        "degraded_chip_state": degraded,
+        "chip_pct_of_peak": probe_pct,
         "extra": extra,
     }
     # Durable copy FIRST (VERDICT r3 weak #5): the round driver keeps only
