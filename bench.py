@@ -44,6 +44,79 @@ def _r4(v):
     return None if v is None else round(v, 4)
 
 
+# Pure-matmul probe %-of-peak at/above which a draw's perf numbers are
+# state-trustworthy.  Observed session states cluster either >=40% (healthy)
+# or <=12% (externally contended); 25 splits the gap with margin.
+HEALTHY_CHIP_PCT = 25.0
+
+
+def healthy_summary(result: dict) -> dict:
+    """Compact cross-reference view of a full bench result dict."""
+    extra = result.get("extra", {})
+    lanes = {}
+    for name, stats in (extra.get("lanes") or {}).items():
+        lanes[name] = {
+            k: stats[k]
+            for k in (
+                "windows_per_sec_best",
+                "windows_per_sec_median",
+                "steady_mfu_pct",
+                "mfu_pct",
+            )
+            if k in stats
+        }
+    return {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "chip_pct_of_peak": result.get("chip_pct_of_peak"),
+        "captured_at": result.get("captured_at"),
+        "lanes": lanes,
+        "north_star": extra.get("north_star"),
+        "note": (
+            "most recent full bench draw taken at a healthy chip state "
+            f"(pure-matmul probe >= {HEALTHY_CHIP_PCT}% of peak); compare "
+            "a state-limited draw's lanes against these numbers"
+        ),
+    }
+
+
+def update_healthy_reference(result: dict, path: pathlib.Path) -> None:
+    """Maintain the healthy-state cross-reference draw.
+
+    The chip/tunnel has session-scale performance states (see
+    chip_state_probe); a draw taken in a degraded state must never be the
+    only evidence a reader sees.  A healthy draw (probe >=
+    HEALTHY_CHIP_PCT% of peak) refreshes ``path`` with its full result;
+    EVERY draw then attaches that file's summary under
+    extra["healthy_state_reference"] — so a degraded round-end bench line
+    carries the last healthy-state numbers alongside its own, each
+    labeled with the chip state it was measured at.  Mutates ``result``.
+    """
+    pct = result.get("chip_pct_of_peak")
+    if (
+        pct is not None
+        and pct >= HEALTHY_CHIP_PCT
+        and not result.get("degraded_chip_state")
+    ):
+        try:
+            path.parent.mkdir(exist_ok=True)
+            path.write_text(json.dumps(result, indent=1))
+        except OSError as e:  # read-only checkout: cross-ref still works
+            print(
+                f"warning: could not write {path.name}: {e}",
+                file=sys.stderr,
+            )
+    try:
+        stored = json.loads(path.read_text())
+    except (OSError, ValueError):
+        stored = None
+    result.setdefault("extra", {})["healthy_state_reference"] = (
+        healthy_summary(stored) if stored is not None else None
+    )
+
+
 def load_table():
     """(table, is_real_data): one CSV parse serves every lane — the
     feature views and the one-hot pipeline each select only the columns
@@ -130,19 +203,24 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     # (compile-inflated: trainer's t0 starts before tracing, so this
     # sample is usually discarded) and TWO clean post-compile fits;
     # one clean sample alone can catch the tunnel's 2-13 s overhead
-    # swing and silently flatter the metric.
-    short_est = NeuralClassifier(
-        name,
-        config=dataclasses.replace(config, epochs=epochs_short),
-        model_kwargs=kwargs,
-    )
-    t_short = min(
-        float(warm_short.history["train_time_s"]),
-        *(
-            float(short_est.fit(train_set).history["train_time_s"])
-            for _ in range(2)
-        ),
-    )
+    # swing and silently flatter the metric.  In degraded-chip mode
+    # (steady_ok=False) the slope is discarded anyway, so skip the two
+    # clean fits — on the worst states they'd nearly double lane cost
+    # for a number that is never reported.
+    t_short = float(warm_short.history["train_time_s"])
+    if steady_ok:
+        short_est = NeuralClassifier(
+            name,
+            config=dataclasses.replace(config, epochs=epochs_short),
+            model_kwargs=kwargs,
+        )
+        t_short = min(
+            t_short,
+            *(
+                float(short_est.fit(train_set).history["train_time_s"])
+                for _ in range(2)
+            ),
+        )
 
     est = NeuralClassifier(name, config=config, model_kwargs=kwargs)
     est.fit(train_set)  # warmup: compile the full program
@@ -687,7 +765,6 @@ def main() -> None:
         "backend": jax.default_backend(),
         "chip_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "chip_state_probe": chip_probe,
-        "degraded_state_mode": degraded,
         # north-star scorecard (BASELINE.json): report the gap honestly
         "north_star": {
             "accuracy_target": NORTH_STAR_ACCURACY,
@@ -752,14 +829,19 @@ def main() -> None:
         # headline must carry its own label, not bury it in extra
         "degraded_chip_state": degraded,
         "chip_pct_of_peak": probe_pct,
+        "captured_at": int(time.time()),
         "extra": extra,
     }
+    art = pathlib.Path(__file__).resolve().parent / "artifacts"
+    # Healthy-state cross-reference: a state-limited draw must carry the
+    # last healthy draw's numbers alongside its own (see
+    # update_healthy_reference).
+    update_healthy_reference(result, art / "bench_healthy.json")
     # Durable copy FIRST (VERDICT r3 weak #5): the round driver keeps only
     # the last 2000 bytes of stdout, which truncated r3's parity keys out
     # of existence.  The full dict always lands in artifacts/ so no number
     # depends on the tail window; bench_compare accepts this file as-is.
     try:
-        art = pathlib.Path(__file__).resolve().parent / "artifacts"
         art.mkdir(exist_ok=True)
         (art / "bench_latest.json").write_text(json.dumps(result, indent=1))
     except OSError as e:  # a read-only checkout must not kill the print

@@ -46,7 +46,8 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                dataset: str | None = None,
                synthetic_rows: int | None = None,
                drop_binned: bool | None = None,
-               split_method: str | None = None) -> str:
+               split_method: str | None = None,
+               input_shape: tuple | None = None) -> str:
     """Persist a trained neural classifier (params + scaler + config).
 
     ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks,
@@ -76,6 +77,12 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
         meta["drop_binned"] = drop_binned
     if split_method is not None:
         meta["split_method"] = split_method
+    if input_shape is not None:
+        # per-example feature shape the params were trained on — e.g.
+        # (200, 3) for raw windows; serving validates its window/channel
+        # geometry against this (a pooled CNN would otherwise accept any
+        # window length and silently emit distribution-shifted output)
+        meta["input_shape"] = [int(d) for d in input_shape]
     if model.scaler is not None:
         meta["scaler"] = {
             "mean": np.asarray(model.scaler.mean).tolist(),
@@ -86,10 +93,16 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
     return path
 
 
+def load_model_meta(path: str) -> dict:
+    """The checkpoint's recorded provenance (model name/kwargs, dataset,
+    input_shape, ...) without restoring the parameters."""
+    with open(os.path.join(_abspath(path), _META)) as f:
+        return json.load(f)
+
+
 def load_model(path: str) -> NeuralClassifierModel:
+    meta = load_model_meta(path)
     path = _abspath(path)
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
     with ocp.PyTreeCheckpointer() as ckptr:
         params = ckptr.restore(os.path.join(path, "params"))
     module = build_model(

@@ -631,7 +631,8 @@ def _mesh_from_config(config: RunConfig):
 
 
 def _save_fitted(
-    base_dir: str, job_name: str, model, est, config: RunConfig, pipe_model
+    base_dir: str, job_name: str, model, est, config: RunConfig, pipe_model,
+    input_shape: tuple | None = None,
 ):
     """Persist one fitted model under ``base_dir/job_name``.
 
@@ -660,6 +661,7 @@ def _save_fitted(
             synthetic_rows=synthetic_rows,
             drop_binned=config.data.drop_binned,
             split_method=split_method,
+            input_shape=input_shape,
         )
     return save_classical_model(
         path,
@@ -760,7 +762,8 @@ def run(
         results.append(result)
         if save_models_dir:
             _save_fitted(
-                save_models_dir, name, model, est, config, pipe_model
+                save_models_dir, name, model, est, config, pipe_model,
+                input_shape=np.asarray(train.features).shape[1:],
             )
         if with_cv:
             tuning = config.tuning
@@ -794,6 +797,7 @@ def run(
                 _save_fitted(
                     save_models_dir, f"{name}_cv", cv_model.best_model,
                     tuned, config, pipe_model,
+                    input_shape=np.asarray(train.features).shape[1:],
                 )
 
     if with_eda and not is_raw:

@@ -1,0 +1,340 @@
+"""Real-time streaming inference: sliding-window HAR classification.
+
+The reference has no serving story — it scores one static test DataFrame
+in batch (`Main/main.py:122-130`) and its models die with the process
+(no persistence, SURVEY §5.4).  A deployed activity-recognition system
+consumes a *live* 20 Hz accelerometer stream; this module is the
+TPU-native serving path for that gap:
+
+  ``StreamingClassifier``  — ring-buffer sliding windows over an
+    incremental sample stream; one fixed-shape compiled predict per hop
+    (XLA traces a single ``(1, window, C)`` program once, every later
+    hop reuses it — no retracing on the hot path), plus probability
+    smoothing (EMA or k-window majority vote), because single-window
+    flips are the dominant error mode of deployed HAR.
+
+  ``classify_session``  — offline replay of a recorded stream at full
+    batch throughput: strided window view → one batched ``transform``.
+    Bit-identical to streaming the same samples with smoothing off
+    (tested: tests/test_serving.py).
+
+TPU design notes:
+  - Static shapes everywhere: window length, hop and channel count are
+    construction-time constants; ``push`` never changes a traced shape.
+  - The ring buffer lives on host (numpy).  At 20 Hz the device round
+    trip per hop IS the latency floor; a ``(window, 3)`` f32 window is
+    ~2.4 KB — transfer-irrelevant.  What matters is never re-tracing
+    and never re-compiling, which fixed shapes guarantee.
+  - Catch-up bursts (a transport hiccup delivers seconds of samples at
+    once) drain through the same compiled program hop by hop; each call
+    is sub-ms on chip, so burst draining is bounded by dispatch, not
+    compute.  For bulk re-scoring of recorded sessions use
+    ``classify_session``, which amortizes dispatch over the whole
+    recording.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamEvent:
+    """One classification emitted at a hop boundary."""
+
+    t_index: int  # stream sample index of the window END (exclusive)
+    label: int  # smoothed class decision
+    raw_label: int  # this window's own argmax (pre-smoothing)
+    probability: np.ndarray  # (C,) decision distribution: EMA-smoothed
+    #   probs ("ema"), trailing vote fractions ("vote"), or the window's
+    #   own probs ("none"); probability[label] is the decision confidence
+    latency_ms: float  # wall-clock of the predict for this window
+
+
+def _percentile(values: Sequence[float], q: float) -> float:
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class StreamingClassifier:
+    """Sliding-window online classifier over an incremental stream.
+
+    Parameters
+    ----------
+    model:
+        Any fitted model with ``transform(x) -> Predictions`` over
+        ``(n, window, channels)`` raw windows — a
+        ``NeuralClassifierModel`` (scaler applied inside) or a bare
+        ``NeuralModel``.
+    window, hop:
+        Window length and emission stride in samples.  The WISDM
+        protocol is 200-sample (10 s @ 20 Hz) windows; ``hop=20`` emits
+        one decision per second.
+    smoothing:
+        ``"ema"`` — exponential moving average over class probabilities
+        (``ema_alpha`` = weight of the newest window);
+        ``"vote"`` — majority vote over the last ``vote_depth`` raw
+        labels (ties break toward the newest);
+        ``"none"`` — every event reports its own window verbatim.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        window: int = 200,
+        hop: int = 20,
+        channels: int = 3,
+        smoothing: str = "ema",
+        ema_alpha: float = 0.4,
+        vote_depth: int = 5,
+        class_names: Sequence[str] | None = None,
+    ):
+        if window <= 0 or hop <= 0:
+            raise ValueError("window and hop must be positive")
+        if smoothing not in ("ema", "vote", "none"):
+            raise ValueError(f"unknown smoothing {smoothing!r}")
+        if smoothing == "ema" and not (0.0 < ema_alpha <= 1.0):
+            raise ValueError("ema_alpha must be in (0, 1]")
+        if smoothing == "vote" and vote_depth < 1:
+            raise ValueError("vote_depth must be >= 1")
+        self.model = model
+        self.window = int(window)
+        self.hop = int(hop)
+        self.channels = int(channels)
+        self.smoothing = smoothing
+        self.ema_alpha = float(ema_alpha)
+        self.vote_depth = int(vote_depth)
+        self.class_names = list(class_names) if class_names else None
+        self.reset()
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kwargs) -> "StreamingClassifier":
+        """Serve a saved neural checkpoint (har_tpu.checkpoint layout).
+
+        Window geometry defaults to the checkpoint's recorded
+        ``input_shape`` and a conflicting explicit ``window``/``channels``
+        is rejected: a pooled CNN runs at any window length, so a
+        mismatch would not error — it would silently emit predictions on
+        a distribution the params never saw.  ``None`` kwargs mean
+        "unset" (use the checkpoint's geometry).
+        """
+        from har_tpu.checkpoint import load_model, load_model_meta
+
+        kwargs = {k: v for k, v in kwargs.items() if v is not None}
+        try:
+            shape = load_model_meta(path).get("input_shape")
+        except OSError:
+            shape = None
+        if shape and len(shape) == 2:
+            trained = {"window": int(shape[0]), "channels": int(shape[1])}
+            for name, value in trained.items():
+                asked = kwargs.get(name)
+                if asked is not None and asked != value:
+                    raise ValueError(
+                        f"checkpoint records input_shape={shape} "
+                        f"({name}={value}); serving with {name}={asked} "
+                        "would feed the model windows it was never "
+                        "trained on"
+                    )
+                kwargs.setdefault(name, value)
+        return cls(load_model(path), **kwargs)
+
+    def reset(self) -> None:
+        """Drop buffered samples and smoothing state (stream restart)."""
+        # ring buffer of the newest `window` samples; decisions fire at
+        # sample counts window, window+hop, window+2*hop, ...
+        self._ring = np.zeros((self.window, self.channels), np.float32)
+        self._n_seen = 0
+        self._next_emit = self.window
+        self._ema: np.ndarray | None = None
+        self._votes: deque[int] = deque(maxlen=self.vote_depth)
+        self._latencies: list[float] = []
+        # the first predict EVER pays compilation; a reset() on a warm
+        # classifier starts a session whose first sample is already fast
+        self._session_starts_cold = not getattr(
+            self, "_ever_predicted", False
+        )
+
+    # ---------------------------------------------------------- streaming
+
+    def push(self, samples: np.ndarray) -> list[StreamEvent]:
+        """Feed ``(n, channels)`` samples; return events for every hop
+        boundary they complete.  Chunking is irrelevant: pushing a
+        recording sample-by-sample or all at once yields identical
+        events (the test suite pins this)."""
+        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        if samples.shape[-1] != self.channels:
+            raise ValueError(
+                f"expected (n, {self.channels}) samples, got "
+                f"{samples.shape}"
+            )
+        events: list[StreamEvent] = []
+        pos = 0
+        n = len(samples)
+        while pos < n:
+            # advance at most to the next emission boundary, so no
+            # boundary inside a large chunk is skipped
+            take = min(self._next_emit - self._n_seen, n - pos)
+            chunk = samples[pos : pos + take]
+            # roll the ring by `take`: cheap at stream chunk sizes, and
+            # keeps the window contiguous for the device transfer
+            if take >= self.window:
+                self._ring[:] = chunk[-self.window :]
+            else:
+                self._ring[: self.window - take] = self._ring[take:]
+                self._ring[self.window - take :] = chunk
+            self._n_seen += take
+            pos += take
+            if self._n_seen == self._next_emit:
+                events.append(self._emit())
+                self._next_emit += self.hop
+        return events
+
+    def _emit(self) -> StreamEvent:
+        t0 = time.perf_counter()
+        preds = self.model.transform(self._ring[None])
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        self._latencies.append(latency_ms)
+        self._ever_predicted = True
+        probs = np.asarray(preds.probability[0], np.float64)
+        raw_label = int(probs.argmax())
+        if self.smoothing == "ema":
+            self._ema = (
+                probs
+                if self._ema is None
+                else self.ema_alpha * probs
+                + (1.0 - self.ema_alpha) * self._ema
+            )
+            smoothed = self._ema
+            label = int(smoothed.argmax())
+        elif self.smoothing == "vote":
+            self._votes.append(raw_label)
+            counts = np.bincount(
+                np.asarray(self._votes), minlength=probs.shape[0]
+            )
+            best = counts.max()
+            # ties break toward the newest label that achieves the max
+            label = next(
+                v for v in reversed(self._votes) if counts[v] == best
+            )
+            # the event's probability must describe the DECISION, so in
+            # vote mode it is the trailing vote distribution (the raw
+            # window's own distribution stays reachable via raw_label);
+            # probability[label] is then the vote confidence
+            smoothed = counts.astype(np.float64) / counts.sum()
+        else:
+            smoothed = probs
+            label = raw_label
+        return StreamEvent(
+            t_index=self._n_seen,
+            label=label,
+            raw_label=raw_label,
+            probability=smoothed.copy(),
+            latency_ms=latency_ms,
+        )
+
+    # ---------------------------------------------------------- reporting
+
+    def latency_stats(self) -> dict:
+        """Per-inference wall-clock distribution (ms) since reset()."""
+        if not self._latencies:
+            return {"count": 0}
+        lat = self._latencies
+        # steady = samples after compilation; only the classifier's very
+        # first session pays it, and with a single (cold) sample there is
+        # no steady evidence at all — report None, not the compile time
+        steady = lat[1:] if self._session_starts_cold else lat
+        return {
+            "count": len(lat),
+            "p50_ms": round(_percentile(lat, 50), 3),
+            "p95_ms": round(_percentile(lat, 95), 3),
+            "max_ms": round(max(lat), 3),
+            "steady_p50_ms": (
+                round(_percentile(steady, 50), 3) if steady else None
+            ),
+        }
+
+    def label_name(self, label: int) -> str:
+        if self.class_names and 0 <= label < len(self.class_names):
+            return self.class_names[label]
+        return str(label)
+
+
+def classify_session(
+    model,
+    samples: np.ndarray,
+    *,
+    window: int = 200,
+    hop: int = 20,
+) -> "SessionResult":
+    """Offline sliding-window classification of a full recording.
+
+    Builds the strided ``(k, window, C)`` view (zero-copy) and scores it
+    in one batched ``transform`` — the throughput path; equals the
+    streaming path's raw labels exactly.
+    """
+    samples = np.ascontiguousarray(np.asarray(samples, np.float32))
+    if samples.ndim != 2:
+        raise ValueError(f"expected (n, channels) samples, got {samples.shape}")
+    n = len(samples)
+    if n < window:
+        raise ValueError(f"recording shorter ({n}) than one window ({window})")
+    k = (n - window) // hop + 1
+    stride0 = samples.strides[0]
+    windows = np.lib.stride_tricks.as_strided(
+        samples,
+        shape=(k, window, samples.shape[1]),
+        strides=(hop * stride0, stride0, samples.strides[1]),
+        writeable=False,
+    )
+    preds = model.transform(windows)
+    ends = window + hop * np.arange(k)
+    return SessionResult(
+        t_index=ends,
+        labels=np.asarray(preds.prediction, np.int32),
+        probability=np.asarray(preds.probability),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionResult:
+    """classify_session output: one row per emitted window."""
+
+    t_index: np.ndarray  # (k,) window-end sample indices
+    labels: np.ndarray  # (k,)
+    probability: np.ndarray  # (k, C)
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def segments(self) -> list[tuple[int, int, int]]:
+        """Run-length merge: [(start_t, end_t, label)] over the session,
+        the activity timeline a monitoring UI renders (the paper's
+        stated use case is elderly-activity monitoring)."""
+        if not len(self.labels):
+            return []
+        out = []
+        start = 0
+        for i in range(1, len(self.labels)):
+            if self.labels[i] != self.labels[start]:
+                out.append(
+                    (
+                        int(self.t_index[start]),
+                        int(self.t_index[i - 1]),
+                        int(self.labels[start]),
+                    )
+                )
+                start = i
+        out.append(
+            (
+                int(self.t_index[start]),
+                int(self.t_index[-1]),
+                int(self.labels[start]),
+            )
+        )
+        return out

@@ -49,6 +49,34 @@ def compare(old_path: str, new_path: str) -> int:
 
     print(f"headline: {old_doc.get('value')} -> {new_doc.get('value')} "
           f"{old_doc.get('unit', '')}")
+    # chip state first: a "regression" between draws in different chip
+    # states is a state delta, not a code delta.  Threshold mirrors
+    # bench.HEALTHY_CHIP_PCT (duplicated: scripts/ is not on bench's
+    # import path when run from elsewhere).
+    healthy_pct = 25.0
+
+    def _state(doc):
+        pct = doc.get("chip_pct_of_peak")
+        if pct is None:
+            return None, "no probe"
+        if doc.get("degraded_chip_state"):
+            return pct, "DEGRADED — lanes ran at reduced epochs"
+        if pct < healthy_pct:
+            return pct, "below healthy threshold — treat deltas as state"
+        return pct, "healthy"
+
+    for tag, doc in (("old", old_doc), ("new", new_doc)):
+        pct, label = _state(doc)
+        if pct is not None:
+            print(f"  chip state ({tag}): {pct}% of peak ({label})")
+    new_pct, _ = _state(new_doc)
+    ref = new_doc.get("extra", {}).get("healthy_state_reference")
+    if ref and new_pct is not None and new_pct < healthy_pct:
+        print(
+            f"  last healthy draw: {ref.get('value')} {ref.get('unit', '')} "
+            f"at {ref.get('chip_pct_of_peak')}% of peak — compare lanes "
+            "against artifacts/bench_healthy.json, not this draw"
+        )
     if not old_lanes or not new_lanes:
         print(
             "note: one side predates per-lane stats (r03+); only the "

@@ -1,0 +1,101 @@
+"""Healthy-state bench cross-reference (bench.update_healthy_reference).
+
+The remote chip has session-scale performance states; the round driver
+runs bench.py at an arbitrary point in that distribution.  These tests
+pin the contract that a degraded draw always carries the last
+healthy-state draw's numbers alongside its own.
+"""
+
+import json
+
+from bench import (
+    HEALTHY_CHIP_PCT,
+    healthy_summary,
+    update_healthy_reference,
+)
+
+
+def _draw(pct, value, degraded=False, captured_at=1000):
+    return {
+        "metric": "wisdm_mlp_train_throughput",
+        "value": value,
+        "unit": "windows/s",
+        "vs_baseline": round(value / 8372.0, 2),
+        "degraded_chip_state": degraded,
+        "chip_pct_of_peak": pct,
+        "captured_at": captured_at,
+        "extra": {
+            "lanes": {
+                "cnn1d": {
+                    "windows_per_sec_best": value * 1.5,
+                    "steady_mfu_pct": 40.0,
+                    "batch_size": 2048,  # must be trimmed from summary
+                }
+            },
+            "north_star": {"throughput_met": True},
+        },
+    }
+
+
+def test_healthy_draw_writes_reference(tmp_path):
+    path = tmp_path / "bench_healthy.json"
+    result = _draw(pct=45.0, value=200_000.0)
+    update_healthy_reference(result, path)
+
+    stored = json.loads(path.read_text())
+    assert stored["value"] == 200_000.0
+    assert stored["chip_pct_of_peak"] == 45.0
+    # the healthy draw cross-references itself (it IS the newest healthy)
+    ref = result["extra"]["healthy_state_reference"]
+    assert ref["value"] == 200_000.0
+    assert ref["captured_at"] == 1000
+
+
+def test_degraded_draw_attaches_last_healthy(tmp_path):
+    path = tmp_path / "bench_healthy.json"
+    healthy = _draw(pct=45.0, value=200_000.0, captured_at=1000)
+    update_healthy_reference(healthy, path)
+
+    degraded = _draw(
+        pct=3.0, value=40_000.0, degraded=True, captured_at=2000
+    )
+    update_healthy_reference(degraded, path)
+
+    ref = degraded["extra"]["healthy_state_reference"]
+    assert ref["value"] == 200_000.0
+    assert ref["chip_pct_of_peak"] == 45.0
+    assert ref["captured_at"] == 1000
+    # the degraded draw must NOT overwrite the healthy reference
+    assert json.loads(path.read_text())["value"] == 200_000.0
+    # lane summary keeps throughput/MFU keys, drops config noise
+    lane = ref["lanes"]["cnn1d"]
+    assert lane["windows_per_sec_best"] == 300_000.0
+    assert "batch_size" not in lane
+
+
+def test_borderline_pct_does_not_refresh(tmp_path):
+    path = tmp_path / "bench_healthy.json"
+    update_healthy_reference(
+        _draw(pct=45.0, value=200_000.0, captured_at=1000), path
+    )
+    # epochs-reduced draw flagged degraded even if probe were high
+    flagged = _draw(pct=50.0, value=60_000.0, degraded=True)
+    update_healthy_reference(flagged, path)
+    assert json.loads(path.read_text())["value"] == 200_000.0
+    # just-below-threshold probe does not refresh either
+    below = _draw(pct=HEALTHY_CHIP_PCT - 0.1, value=70_000.0)
+    update_healthy_reference(below, path)
+    assert json.loads(path.read_text())["value"] == 200_000.0
+
+
+def test_no_reference_file_yields_null(tmp_path):
+    result = _draw(pct=3.0, value=40_000.0, degraded=True)
+    update_healthy_reference(result, tmp_path / "missing.json")
+    assert result["extra"]["healthy_state_reference"] is None
+
+
+def test_summary_has_explanatory_note(tmp_path):
+    path = tmp_path / "bench_healthy.json"
+    update_healthy_reference(_draw(pct=45.0, value=200_000.0), path)
+    summary = healthy_summary(json.loads(path.read_text()))
+    assert "healthy chip state" in summary["note"]

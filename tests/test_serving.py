@@ -1,0 +1,329 @@
+"""Streaming inference (har_tpu.serving).
+
+Pins the three contracts the serving path stands on:
+  1. chunking-invariance — an event stream must not depend on how the
+     transport batched the samples;
+  2. offline/online equivalence — classify_session's labels equal the
+     streaming raw labels on the same recording;
+  3. smoothing — EMA/vote suppress single-window flips without
+     changing the steady-state decision.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.serving import StreamingClassifier, classify_session
+
+
+class _StubModel:
+    """Deterministic stand-in: class = sign pattern of the window mean.
+
+    Keeps the tests about the *streaming machinery*, not about training
+    a real net; real-model integration is covered at the end.
+    """
+
+    num_classes = 3
+
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x)
+        m = x.mean(axis=(1, 2))
+        raw = np.stack([-m, m, np.zeros_like(m)], axis=-1)
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return Predictions.from_raw(raw, e / e.sum(axis=-1, keepdims=True))
+
+
+def _recording(n=1000, seed=0, channels=3):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, channels)).astype(np.float32)
+
+
+def test_event_schedule():
+    sc = StreamingClassifier(
+        _StubModel(), window=200, hop=20, smoothing="none"
+    )
+    events = sc.push(_recording(1000))
+    # boundaries at 200, 220, ..., 1000
+    assert [e.t_index for e in events] == list(range(200, 1001, 20))
+    assert all(e.probability.shape == (3,) for e in events)
+    assert all(
+        abs(e.probability.sum() - 1.0) < 1e-6 for e in events
+    )
+
+
+def test_chunking_invariance():
+    rec = _recording(777)
+    whole = StreamingClassifier(
+        _StubModel(), window=200, hop=30, smoothing="none"
+    )
+    ev_whole = whole.push(rec)
+
+    chunked = StreamingClassifier(
+        _StubModel(), window=200, hop=30, smoothing="none"
+    )
+    ev_chunked = []
+    rng = np.random.default_rng(1)
+    pos = 0
+    while pos < len(rec):
+        step = int(rng.integers(1, 97))
+        ev_chunked.extend(chunked.push(rec[pos : pos + step]))
+        pos += step
+
+    assert [e.t_index for e in ev_whole] == [e.t_index for e in ev_chunked]
+    assert [e.raw_label for e in ev_whole] == [
+        e.raw_label for e in ev_chunked
+    ]
+    for a, b in zip(ev_whole, ev_chunked):
+        np.testing.assert_allclose(a.probability, b.probability, rtol=1e-6)
+
+
+def test_offline_equals_online():
+    rec = _recording(1500, seed=3)
+    sc = StreamingClassifier(
+        _StubModel(), window=200, hop=50, smoothing="none"
+    )
+    online = sc.push(rec)
+    offline = classify_session(_StubModel(), rec, window=200, hop=50)
+    assert len(offline) == len(online)
+    np.testing.assert_array_equal(
+        offline.labels, [e.raw_label for e in online]
+    )
+    np.testing.assert_array_equal(
+        offline.t_index, [e.t_index for e in online]
+    )
+
+
+def test_ema_smoothing_suppresses_single_flip():
+    class Flipper:
+        """Confident class 0 except one outlier window."""
+
+        num_classes = 2
+
+        def __init__(self):
+            self.calls = 0
+
+        def transform(self, x):
+            from har_tpu.models.base import Predictions
+
+            self.calls += 1
+            p = np.array([[0.9, 0.1]] if self.calls != 5 else [[0.2, 0.8]])
+            return Predictions.from_raw(np.log(p), p)
+
+    sc = StreamingClassifier(
+        Flipper(), window=10, hop=10, smoothing="ema", ema_alpha=0.4
+    )
+    events = sc.push(_recording(100))
+    assert len(events) == 10
+    assert events[4].raw_label == 1  # the outlier window itself
+    assert all(e.label == 0 for e in events)  # smoothed decision holds
+
+
+def test_vote_smoothing_and_tiebreak():
+    class Seq:
+        num_classes = 2
+
+        def __init__(self, labels):
+            self.labels = list(labels)
+            self.i = 0
+
+        def transform(self, x):
+            from har_tpu.models.base import Predictions
+
+            lab = self.labels[self.i]
+            self.i += 1
+            p = np.zeros((1, 2))
+            p[0, lab] = 0.9
+            p[0, 1 - lab] = 0.1
+            return Predictions.from_raw(np.log(p), p)
+
+    sc = StreamingClassifier(
+        Seq([0, 1, 1, 0, 1]),
+        window=10,
+        hop=10,
+        smoothing="vote",
+        vote_depth=3,
+    )
+    events = sc.push(_recording(50))
+    # votes over the trailing 3: [0]->0, [0,1]->tie->newest=1, [0,1,1]->1,
+    # [1,1,0]->1, [1,0,1]->1
+    assert [e.label for e in events] == [0, 1, 1, 1, 1]
+    # probability describes the DECISION: vote fractions, with
+    # probability[label] the vote confidence
+    np.testing.assert_allclose(events[2].probability, [1 / 3, 2 / 3])
+    assert all(
+        e.probability[e.label] == e.probability.max() for e in events
+    )
+
+
+def test_reset_and_latency_stats():
+    sc = StreamingClassifier(
+        _StubModel(), window=100, hop=100, smoothing="none"
+    )
+    assert sc.latency_stats() == {"count": 0}
+    sc.push(_recording(300))
+    stats = sc.latency_stats()
+    assert stats["count"] == 3
+    assert stats["p50_ms"] >= 0
+    sc.reset()
+    assert sc.latency_stats() == {"count": 0}
+    # after reset the schedule restarts at t=window
+    assert [e.t_index for e in sc.push(_recording(100))] == [100]
+    # a warm session's single sample IS steady evidence (no compile)
+    assert sc.latency_stats()["steady_p50_ms"] is not None
+
+
+def test_single_cold_sample_has_no_steady_latency():
+    sc = StreamingClassifier(
+        _StubModel(), window=100, hop=100, smoothing="none"
+    )
+    sc.push(_recording(100))
+    # one inference, and it paid tracing: no steady evidence exists
+    assert sc.latency_stats()["count"] == 1
+    assert sc.latency_stats()["steady_p50_ms"] is None
+
+
+def test_from_checkpoint_window_provenance(tmp_path):
+    """A checkpoint recording input_shape drives (and guards) serving
+    geometry: defaults adopted, explicit mismatch rejected."""
+    from har_tpu.checkpoint import save_model
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (8,)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (8,)},
+               input_shape=raw.windows.shape[1:])
+
+    sc = StreamingClassifier.from_checkpoint(ckpt, hop=50)
+    assert sc.window == 200 and sc.channels == 3
+    # None means unset, not a conflict
+    sc = StreamingClassifier.from_checkpoint(ckpt, window=None)
+    assert sc.window == 200
+    with pytest.raises(ValueError, match="input_shape"):
+        StreamingClassifier.from_checkpoint(ckpt, window=100)
+
+
+def test_input_validation():
+    sc = StreamingClassifier(_StubModel(), window=10, hop=5)
+    with pytest.raises(ValueError, match="expected"):
+        sc.push(np.zeros((4, 2)))
+    with pytest.raises(ValueError, match="smoothing"):
+        StreamingClassifier(_StubModel(), smoothing="mean")
+    with pytest.raises(ValueError, match="shorter"):
+        classify_session(_StubModel(), np.zeros((5, 3)), window=10)
+
+
+def test_segments_merging():
+    rec = _recording(400, seed=5)
+    res = classify_session(_StubModel(), rec, window=100, hop=50)
+    segs = res.segments()
+    # segments tile the session and carry the per-window labels
+    assert segs[0][0] == 100
+    assert segs[-1][1] == res.t_index[-1]
+    rebuilt = []
+    for start, end, label in segs:
+        k = (end - start) // 50 + 1
+        rebuilt.extend([label] * k)
+    np.testing.assert_array_equal(rebuilt, res.labels)
+
+
+def test_cli_stream_from_checkpoint(tmp_path, capsys):
+    """`har stream`: checkpoint → synthetic demo recording → timeline."""
+    import json
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=256, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=128, epochs=4, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (16, 16)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16, 16)})
+
+    events_csv = str(tmp_path / "events.csv")
+    rc = main(
+        [
+            "stream",
+            "--checkpoint", ckpt,
+            "--hop", "100",
+            "--events-csv", events_csv,
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n_events"] == (out["n_samples"] - 200) // 100 + 1
+    assert out["latency"]["count"] == out["n_events"]
+    assert out["timeline"][0]["from_t"] == 200
+    with open(events_csv) as f:
+        header = f.readline().strip().split(",")
+    assert header[:4] == ["t_index", "label", "raw_label", "latency_ms"]
+    assert sum(1 for _ in open(events_csv)) == out["n_events"] + 1
+
+
+def test_real_model_end_to_end():
+    """A real trained CNN serves a synthetic stream: compile once,
+    classify a continuous recording built from known-class segments."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=512, seed=0)
+    est = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=128, epochs=8, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (32, 32)},
+    )
+    model = est.fit(
+        FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32))
+    )
+
+    # a continuous recording: three known-activity stretches
+    cls_windows = [
+        raw.windows[raw.labels == c] for c in range(len(raw.class_names))
+    ]
+    rec = np.concatenate(
+        [
+            cls_windows[0][:3].reshape(-1, 3),
+            cls_windows[1][:3].reshape(-1, 3),
+            cls_windows[0][3:6].reshape(-1, 3),
+        ]
+    )
+    sc = StreamingClassifier(
+        model,
+        window=200,
+        hop=200,
+        smoothing="none",
+        class_names=raw.class_names,
+    )
+    events = sc.push(rec)
+    assert len(events) == 9
+    # interior windows (not straddling an activity change) must classify
+    # to their segment's class
+    labels = [e.label for e in events]
+    assert labels[0] == 0 and labels[1] == 0
+    assert labels[3] == 1 and labels[4] == 1
+    assert labels[7] == 0 and labels[8] == 0
+    assert sc.label_name(events[0].label) == raw.class_names[0]
+    # the compiled predict is reused: steady latency well under the
+    # first (compiling) call
+    stats = sc.latency_stats()
+    assert stats["steady_p50_ms"] <= stats["max_ms"]
