@@ -167,6 +167,22 @@ def _parser() -> argparse.ArgumentParser:
                     help="write per-event rows (t_index,label,raw_label,"
                          "latency_ms,probabilities...)")
 
+    ex = sub.add_parser(
+        "export",
+        help="export a saved neural checkpoint as a self-contained "
+             "StableHLO predict artifact (params baked in, symbolic "
+             "batch dim, multi-platform) — deployable without har_tpu",
+    )
+    ex.add_argument("--checkpoint", required=True)
+    ex.add_argument("--output", required=True,
+                    help="artifact directory (predict.stablehlo + meta)")
+    ex.add_argument("--platforms", nargs="+", default=["tpu", "cpu"],
+                    help="lowerings to embed (default: tpu cpu)")
+    ex.add_argument("--example-shape", nargs="+", type=int, default=None,
+                    help="per-example feature shape (e.g. 200 3) for "
+                         "checkpoints that record neither a scaler nor "
+                         "input_shape")
+
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
     pa = sub.add_parser(
@@ -254,6 +270,29 @@ def main(argv=None) -> int:
                     train_fraction=args.train_fraction,
                     seed=args.seed,
                 )
+            )
+        )
+        return 0
+
+    if args.command == "export":
+        import os as _os
+
+        from har_tpu.export import _BLOB, export_checkpoint
+
+        out = export_checkpoint(
+            args.checkpoint, args.output,
+            platforms=tuple(args.platforms),
+            example_shape=(
+                tuple(args.example_shape) if args.example_shape else None
+            ),
+        )
+        print(
+            json.dumps(
+                {
+                    "artifact": out,
+                    "bytes": _os.path.getsize(_os.path.join(out, _BLOB)),
+                    "platforms": args.platforms,
+                }
             )
         )
         return 0

@@ -1,0 +1,200 @@
+"""Portable compiled-model export (StableHLO via ``jax.export``).
+
+The reference has no deployment story: its models live and die inside
+the Spark driver process (`Main/main.py:115-130`; nothing is ever
+persisted — SURVEY §5.4).  har_tpu's orbax/npz checkpoints already make
+parameters durable, but restoring them still requires the Python model
+classes.  This module removes that dependency too: it exports the whole
+*compiled predict function* — scaler, forward pass and softmax fused
+into one StableHLO program with the trained parameters baked in as
+constants — as a self-contained artifact.
+
+  - ``export_model(model, path)`` — serialize a fitted neural model's
+    predict to ``path/predict.stablehlo`` + a small provenance JSON.
+  - ``export_checkpoint(ckpt, path)`` — same, straight from a saved
+    har_tpu checkpoint directory.
+  - ``load_exported(path)`` — an ``ExportedPredictor`` implementing the
+    ClassifierModel protocol (``transform`` → Predictions), so an
+    exported artifact drops into evaluation, batch predict, or
+    ``serving.StreamingClassifier`` unchanged.
+
+TPU design notes:
+  - The batch dimension is exported *symbolically* (shape polymorphism),
+    so one artifact serves any batch size without retracing — the
+    serving path's (1, T, C) hop and a bulk (8192, T, C) replay run the
+    same program.
+  - Multi-platform lowering: by default the artifact embeds both
+    ``tpu`` and ``cpu`` lowerings, so the same file deploys to a TPU
+    server or an edge/CPU box.
+  - StableHLO serialization carries jax.export's versioned
+    compatibility guarantees — the artifact outlives the Python code
+    that produced it (no flax/har_tpu needed to run it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+_BLOB = "predict.stablehlo"
+_META = "export_meta.json"
+
+
+def _predict_fn(module, params, scaler):
+    """The end-to-end predict: standardize → forward → (logits, probs).
+
+    Scaler statistics and trained parameters enter as closure constants,
+    so the exported program is fully self-contained.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    mean = None if scaler is None else jnp.asarray(scaler.mean)
+    std = None if scaler is None else jnp.asarray(scaler.std)
+
+    def predict(x):
+        x = x.astype(jnp.float32)
+        if mean is not None:
+            x = (x - mean) / std
+        logits = module.apply({"params": params}, x).astype(jnp.float32)
+        return logits, jax.nn.softmax(logits, axis=-1)
+
+    return predict
+
+
+def export_model(
+    model,
+    path: str,
+    *,
+    platforms: tuple[str, ...] = ("tpu", "cpu"),
+    example_shape: tuple[int, ...] | None = None,
+    extra_meta: dict | None = None,
+) -> str:
+    """Serialize a fitted neural model's predict as a StableHLO artifact.
+
+    ``model`` is a ``NeuralClassifierModel`` (scaler folded in) or a bare
+    ``NeuralModel``.  ``example_shape`` is the per-example feature shape;
+    it defaults to the scaler's statistics shape when a scaler is
+    present (the scaler is fit on the training features, so its mean
+    carries exactly that shape).
+    """
+    import jax
+    from jax import export as jax_export
+
+    inner = getattr(model, "inner", model)  # NeuralClassifierModel or bare
+    scaler = getattr(model, "scaler", None)
+    if example_shape is None:
+        if scaler is None:
+            raise ValueError(
+                "example_shape is required when the model has no scaler "
+                "(nothing else records the per-example feature shape)"
+            )
+        example_shape = tuple(int(d) for d in np.asarray(scaler.mean).shape)
+
+    predict = _predict_fn(inner.module, inner.params, scaler)
+    (batch,) = jax_export.symbolic_shape("b")
+    spec = jax.ShapeDtypeStruct((batch, *example_shape), np.float32)
+    exported = jax_export.export(jax.jit(predict), platforms=platforms)(spec)
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _BLOB), "wb") as f:
+        f.write(exported.serialize())
+    meta = {
+        "num_classes": int(model.num_classes),
+        "example_shape": list(example_shape),
+        "platforms": list(platforms),
+        "jax_version": jax.__version__,
+        "outputs": ["logits", "probability"],
+        **(extra_meta or {}),
+    }
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def export_checkpoint(
+    checkpoint_path: str,
+    path: str,
+    *,
+    platforms: tuple[str, ...] = ("tpu", "cpu"),
+    example_shape: tuple[int, ...] | None = None,
+) -> str:
+    """Export a saved har_tpu neural checkpoint directory (orbax layout)
+    as a StableHLO artifact; provenance (model name/kwargs, dataset,
+    input_shape) carries over from the checkpoint's metadata."""
+    from har_tpu.checkpoint import load_model, load_model_meta
+
+    meta = load_model_meta(checkpoint_path)
+    if meta.get("format") == "classical":
+        raise ValueError(
+            "StableHLO export covers the neural families; classical "
+            "models (LR/DT/RF/GBDT) are already portable as npz+JSON "
+            "via save_classical_model"
+        )
+    model = load_model(checkpoint_path)
+    carry = {
+        k: meta[k]
+        for k in ("model_name", "model_kwargs", "dataset", "input_shape")
+        if k in meta
+    }
+    if example_shape is None and meta.get("input_shape"):
+        example_shape = tuple(meta["input_shape"])
+    return export_model(
+        model,
+        path,
+        platforms=platforms,
+        example_shape=example_shape,
+        extra_meta=carry,
+    )
+
+
+@dataclasses.dataclass
+class ExportedPredictor:
+    """A loaded StableHLO predict artifact.
+
+    Implements the ClassifierModel protocol (``transform`` →
+    Predictions), so it drops into ``ops.metrics.evaluate`` scoring or
+    ``serving.StreamingClassifier`` exactly like a live model — without
+    the model classes, flax, or the checkpoint that produced it.
+    """
+
+    exported: object  # jax.export.Exported
+    num_classes: int
+    example_shape: tuple[int, ...]
+    meta: dict
+
+    def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(logits, probability) for a (n, *example_shape) batch."""
+        x = np.asarray(x, np.float32)
+        if tuple(x.shape[1:]) != self.example_shape:
+            raise ValueError(
+                f"artifact was exported for per-example shape "
+                f"{self.example_shape}; got {tuple(x.shape[1:])}"
+            )
+        logits, probs = self.exported.call(x)
+        return np.asarray(logits), np.asarray(probs)
+
+    def transform(self, data):
+        from har_tpu.models.base import Predictions
+
+        x = data.features if hasattr(data, "features") else data
+        logits, probs = self.predict(x)
+        return Predictions.from_raw(logits, probs)
+
+
+def load_exported(path: str) -> ExportedPredictor:
+    from jax import export as jax_export
+
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, _BLOB), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return ExportedPredictor(
+        exported=exported,
+        num_classes=int(meta["num_classes"]),
+        example_shape=tuple(meta["example_shape"]),
+        meta=meta,
+    )

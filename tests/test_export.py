@@ -1,0 +1,170 @@
+"""StableHLO model export (har_tpu.export).
+
+The exported artifact must (1) reproduce the live model's outputs
+exactly at any batch size (symbolic batch dim), (2) run with no model
+classes in the loop (ClassifierModel protocol via ExportedPredictor),
+and (3) carry checkpoint provenance through export_checkpoint.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.export import export_checkpoint, export_model, load_exported
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.neural_classifier import NeuralClassifier
+from har_tpu.train.trainer import TrainerConfig
+
+
+@pytest.fixture(scope="module")
+def raw_model():
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+
+    raw = synthetic_raw_stream(n_windows=128, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=3, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (16, 16)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    return model, raw
+
+
+def test_export_round_trip_any_batch(raw_model, tmp_path):
+    model, raw = raw_model
+    path = export_model(model, str(tmp_path / "art"))
+    pred = load_exported(path)
+    assert pred.num_classes == model.num_classes
+    assert pred.example_shape == (200, 3)
+    # symbolic batch: one artifact, several batch sizes, outputs equal
+    # the live model's
+    for n in (1, 5, 64):
+        x = raw.windows[:n]
+        logits, probs = pred.predict(x)
+        live = model.transform(x)
+        np.testing.assert_allclose(logits, live.raw, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            probs, live.probability, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_exported_predictor_is_a_classifier_model(raw_model, tmp_path):
+    from har_tpu.models.base import ClassifierModel
+    from har_tpu.ops.metrics import evaluate
+
+    model, raw = raw_model
+    pred = load_exported(export_model(model, str(tmp_path / "art")))
+    assert isinstance(pred, ClassifierModel)
+    live = evaluate(
+        raw.labels.astype(np.int32),
+        model.transform(raw.windows).raw,
+        model.num_classes,
+    )
+    exported = evaluate(
+        raw.labels.astype(np.int32),
+        pred.transform(raw.windows).raw,
+        pred.num_classes,
+    )
+    assert exported["accuracy"] == pytest.approx(live["accuracy"], abs=1e-9)
+
+
+def test_exported_artifact_serves_streams(raw_model, tmp_path):
+    from har_tpu.serving import StreamingClassifier
+
+    model, raw = raw_model
+    pred = load_exported(export_model(model, str(tmp_path / "art")))
+    rec = raw.windows[:6].reshape(-1, 3)
+    live_events = StreamingClassifier(
+        model, window=200, hop=100, smoothing="none"
+    ).push(rec)
+    exp_events = StreamingClassifier(
+        pred, window=200, hop=100, smoothing="none"
+    ).push(rec)
+    assert [e.raw_label for e in live_events] == [
+        e.raw_label for e in exp_events
+    ]
+
+
+def test_export_checkpoint_provenance(raw_model, tmp_path):
+    from har_tpu.checkpoint import save_model
+
+    model, raw = raw_model
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16, 16)},
+               dataset="wisdm_raw", input_shape=(200, 3))
+    path = export_checkpoint(ckpt, str(tmp_path / "art"))
+    pred = load_exported(path)
+    assert pred.meta["model_name"] == "cnn1d"
+    assert pred.meta["dataset"] == "wisdm_raw"
+    assert pred.meta["input_shape"] == [200, 3]
+    logits, _ = pred.predict(raw.windows[:4])
+    np.testing.assert_allclose(
+        logits, model.transform(raw.windows[:4]).raw, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_cli_export(raw_model, tmp_path, capsys):
+    import json
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+
+    model, raw = raw_model
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16, 16)},
+               input_shape=(200, 3))
+    out_dir = str(tmp_path / "art")
+    rc = main(["export", "--checkpoint", ckpt, "--output", out_dir])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["bytes"] > 0
+    pred = load_exported(out_dir)
+    logits, _ = pred.predict(raw.windows[:2])
+    np.testing.assert_allclose(
+        logits, model.transform(raw.windows[:2]).raw, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_export_classical_checkpoint_rejected(tmp_path):
+    from har_tpu.checkpoint import save_classical_model
+    from har_tpu.data.synthetic import synthetic_wisdm
+    from har_tpu.data.wisdm import numeric_feature_view
+    from har_tpu.features.string_indexer import StringIndexer
+    from har_tpu.models.tree import DecisionTreeClassifier
+
+    table = synthetic_wisdm(n_rows=200, seed=0)
+    x, _ = numeric_feature_view(table)
+    y = np.asarray(
+        StringIndexer("ACTIVITY", "label").fit(table).transform(table)[
+            "label"
+        ],
+        np.int32,
+    )
+    model = DecisionTreeClassifier(max_depth=2).fit(
+        FeatureSet(features=x, label=y)
+    )
+    ckpt = str(tmp_path / "dt")
+    save_classical_model(ckpt, model)
+    with pytest.raises(ValueError, match="classical"):
+        export_checkpoint(ckpt, str(tmp_path / "art"))
+
+
+def test_shape_validation(raw_model, tmp_path):
+    model, _ = raw_model
+    pred = load_exported(export_model(model, str(tmp_path / "art")))
+    with pytest.raises(ValueError, match="exported for"):
+        pred.predict(np.zeros((2, 100, 3), np.float32))
+
+
+def test_export_without_scaler_needs_shape(raw_model, tmp_path):
+    model, _ = raw_model
+    bare = model.inner  # NeuralModel: no scaler attached
+    with pytest.raises(ValueError, match="example_shape"):
+        export_model(bare, str(tmp_path / "art"))
+    path = export_model(
+        bare, str(tmp_path / "art2"), example_shape=(200, 3)
+    )
+    logits, _ = load_exported(path).predict(
+        np.zeros((2, 200, 3), np.float32)
+    )
+    assert logits.shape == (2, model.num_classes)
