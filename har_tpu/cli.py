@@ -182,6 +182,10 @@ def _parser() -> argparse.ArgumentParser:
                     help="per-example feature shape (e.g. 200 3) for "
                          "checkpoints that record neither a scaler nor "
                          "input_shape")
+    ex.add_argument("--quantize", default=None, choices=["int8"],
+                    help="weight-only int8 quantization before export "
+                         "(per-output-channel scales; weights ship int8 "
+                         "in the artifact)")
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
@@ -277,7 +281,7 @@ def main(argv=None) -> int:
     if args.command == "export":
         import os as _os
 
-        from har_tpu.export import _BLOB, export_checkpoint
+        from har_tpu.export import _BLOB, _META, export_checkpoint
 
         out = export_checkpoint(
             args.checkpoint, args.output,
@@ -285,13 +289,23 @@ def main(argv=None) -> int:
             example_shape=(
                 tuple(args.example_shape) if args.example_shape else None
             ),
+            quantize=args.quantize,
         )
+        with open(_os.path.join(out, _META)) as f:
+            art_meta = json.load(f)
         print(
             json.dumps(
                 {
                     "artifact": out,
-                    "bytes": _os.path.getsize(_os.path.join(out, _BLOB)),
+                    "bytes": sum(
+                        _os.path.getsize(_os.path.join(out, f))
+                        for f in _os.listdir(out)
+                    ),
+                    "program_bytes": _os.path.getsize(
+                        _os.path.join(out, _BLOB)
+                    ),
                     "platforms": args.platforms,
+                    "quantized": art_meta.get("quantization"),
                 }
             )
         )

@@ -41,6 +41,7 @@ import numpy as np
 
 _BLOB = "predict.stablehlo"
 _META = "export_meta.json"
+_WEIGHTS = "weights.npz"
 
 
 def _predict_fn(module, params, scaler):
@@ -84,7 +85,6 @@ def export_model(
     import jax
     from jax import export as jax_export
 
-    inner = getattr(model, "inner", model)  # NeuralClassifierModel or bare
     scaler = getattr(model, "scaler", None)
     if example_shape is None:
         if scaler is None:
@@ -94,12 +94,32 @@ def export_model(
             )
         example_shape = tuple(int(d) for d in np.asarray(scaler.mean).shape)
 
-    predict = _predict_fn(inner.module, inner.params, scaler)
     (batch,) = jax_export.symbolic_shape("b")
     spec = jax.ShapeDtypeStruct((batch, *example_shape), np.float32)
-    exported = jax_export.export(jax.jit(predict), platforms=platforms)(spec)
-
     os.makedirs(path, exist_ok=True)
+    weights = None
+    if hasattr(model, "export_parts"):
+        # models whose weights must enter the artifact in their stored
+        # dtype (quantize.QuantizedModel: int8 — baking them as closure
+        # constants would dequantize at trace time and re-embed f32).
+        # The program takes the weight leaves as inputs; they ship
+        # alongside as an npz in that dtype.
+        predict, weights = model.export_parts()
+        w_specs = [jax.ShapeDtypeStruct(w.shape, w.dtype) for w in weights]
+        exported = jax_export.export(jax.jit(predict), platforms=platforms)(
+            w_specs, spec
+        )
+        np.savez(
+            os.path.join(path, _WEIGHTS),
+            **{f"w{i}": w for i, w in enumerate(weights)},
+        )
+    else:
+        inner = getattr(model, "inner", model)
+        predict = _predict_fn(inner.module, inner.params, scaler)
+        exported = jax_export.export(jax.jit(predict), platforms=platforms)(
+            spec
+        )
+
     with open(os.path.join(path, _BLOB), "wb") as f:
         f.write(exported.serialize())
     meta = {
@@ -108,6 +128,7 @@ def export_model(
         "platforms": list(platforms),
         "jax_version": jax.__version__,
         "outputs": ["logits", "probability"],
+        "weight_inputs": weights is not None,
         **(extra_meta or {}),
     }
     with open(os.path.join(path, _META), "w") as f:
@@ -121,10 +142,16 @@ def export_checkpoint(
     *,
     platforms: tuple[str, ...] = ("tpu", "cpu"),
     example_shape: tuple[int, ...] | None = None,
+    quantize: str | None = None,
 ) -> str:
     """Export a saved har_tpu neural checkpoint directory (orbax layout)
     as a StableHLO artifact; provenance (model name/kwargs, dataset,
-    input_shape) carries over from the checkpoint's metadata."""
+    input_shape) carries over from the checkpoint's metadata.
+
+    ``quantize="int8"`` applies weight-only int8 quantization first
+    (har_tpu.quantize); the artifact then ships int8 weights and its
+    meta records the size report under ``quantization``.
+    """
     from har_tpu.checkpoint import load_model, load_model_meta
 
     meta = load_model_meta(checkpoint_path)
@@ -140,6 +167,16 @@ def export_checkpoint(
         for k in ("model_name", "model_kwargs", "dataset", "input_shape")
         if k in meta
     }
+    if quantize == "int8":
+        from har_tpu.quantize import quantize_model
+
+        model = quantize_model(model)
+        carry["quantization"] = {
+            "scheme": "int8_weight_only",
+            **model.size_report(),
+        }
+    elif quantize is not None:
+        raise ValueError(f"unknown quantize scheme {quantize!r}")
     if example_shape is None and meta.get("input_shape"):
         example_shape = tuple(meta["input_shape"])
     return export_model(
@@ -165,6 +202,7 @@ class ExportedPredictor:
     num_classes: int
     example_shape: tuple[int, ...]
     meta: dict
+    weights: list | None = None  # weight-input artifacts (int8 export)
 
     def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(logits, probability) for a (n, *example_shape) batch."""
@@ -174,7 +212,10 @@ class ExportedPredictor:
                 f"artifact was exported for per-example shape "
                 f"{self.example_shape}; got {tuple(x.shape[1:])}"
             )
-        logits, probs = self.exported.call(x)
+        if self.weights is not None:
+            logits, probs = self.exported.call(self.weights, x)
+        else:
+            logits, probs = self.exported.call(x)
         return np.asarray(logits), np.asarray(probs)
 
     def transform(self, data):
@@ -192,9 +233,21 @@ def load_exported(path: str) -> ExportedPredictor:
         meta = json.load(f)
     with open(os.path.join(path, _BLOB), "rb") as f:
         exported = jax_export.deserialize(f.read())
+    weights = None
+    if meta.get("weight_inputs"):
+        import jax
+
+        with np.load(os.path.join(path, _WEIGHTS)) as z:
+            # device-resident once at load: every predict (e.g. a 20 Hz
+            # serving hop) reuses the buffers instead of re-uploading
+            # the weight set per call
+            weights = [
+                jax.device_put(z[f"w{i}"]) for i in range(len(z.files))
+            ]
     return ExportedPredictor(
         exported=exported,
         num_classes=int(meta["num_classes"]),
         example_shape=tuple(meta["example_shape"]),
         meta=meta,
+        weights=weights,
     )

@@ -1,0 +1,170 @@
+"""Weight-only int8 quantization (har_tpu.quantize).
+
+Contracts: near-float accuracy (per-channel scales), ~4x kernel-byte
+shrink, ClassifierModel protocol conformance, and composition with
+StableHLO export (artifact shrinks because int8 constants stay int8).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.neural_classifier import NeuralClassifier
+from har_tpu.quantize import quantize_model
+from har_tpu.train.trainer import TrainerConfig
+
+
+@pytest.fixture(scope="module")
+def trained():
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+
+    raw = synthetic_raw_stream(n_windows=512, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=128, epochs=8, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (32, 32)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    return model, raw
+
+
+def test_quantized_accuracy_near_float(trained):
+    from har_tpu.ops.metrics import evaluate
+
+    model, raw = trained
+    q = quantize_model(model)
+    y = raw.labels.astype(np.int32)
+    float_acc = evaluate(y, model.transform(raw.windows).raw, 6)["accuracy"]
+    q_acc = evaluate(y, q.transform(raw.windows).raw, 6)["accuracy"]
+    # per-channel int8 rounding must not cost more than a point
+    assert q_acc >= float_acc - 0.01
+    # and the distributions stay close, not just the argmax
+    np.testing.assert_allclose(
+        q.transform(raw.windows[:64]).probability,
+        model.transform(raw.windows[:64]).probability,
+        atol=0.05,
+    )
+
+
+def test_size_report(trained):
+    model, _ = trained
+    q = quantize_model(model)
+    rep = q.size_report()
+    assert rep["quantized_kernels"] == 4  # 2 convs + 2 dense
+    # kernels dominate this model, so total storage lands near 1/4
+    assert rep["ratio"] < 0.35
+    assert rep["quantized_bytes"] < rep["float_bytes"]
+
+
+def test_quantized_kernels_are_int8(trained):
+    model, _ = trained
+    q = quantize_model(model)
+    kinds = [s.kind for s in q.stored]
+    assert kinds.count("q8") == 4
+    for s in q.stored:
+        if s.kind == "q8":
+            assert s.value.dtype == np.int8
+            assert s.scale.dtype == np.float32
+            # per-OUTPUT-channel scales (last axis of the kernel)
+            assert s.scale.shape == (s.value.shape[-1],)
+            assert np.abs(s.value).max() <= 127
+
+
+def test_quantized_model_serves_and_streams(trained):
+    from har_tpu.serving import StreamingClassifier
+
+    model, raw = trained
+    q = quantize_model(model)
+    rec = raw.windows[:6].reshape(-1, 3)
+    events = StreamingClassifier(
+        q, window=200, hop=200, smoothing="none"
+    ).push(rec)
+    assert len(events) == 6
+    live = StreamingClassifier(
+        model, window=200, hop=200, smoothing="none"
+    ).push(rec)
+    # int8 rounding may flip a genuinely ambiguous window; on this
+    # easy stream the labels should agree
+    assert [e.raw_label for e in events] == [e.raw_label for e in live]
+
+
+def test_quantized_export_shrinks_artifact(tmp_path):
+    """Artifact size: the win scales with weight bytes, so measure on a
+    realistically-wide model (the toy fixture's ~10K params are program-
+    overhead-dominated); 1 epoch — size does not care about accuracy."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.export import export_model
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, seed=0),
+        model_kwargs={"channels": (128, 128)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+
+    def _dir_bytes(p):
+        return sum(
+            os.path.getsize(os.path.join(p, f)) for f in os.listdir(p)
+        )
+
+    fpath = export_model(model, str(tmp_path / "f32"))
+    qpath = export_model(quantize_model(model), str(tmp_path / "int8"))
+    # ~100K kernel params.  Weight BYTES shrink 4x, but the StableHLO
+    # bytecode stores f32 constants in ~2 B/param serialized form, so
+    # the whole-directory win is ~1.7x (measured: 217KB → 126KB);
+    # assert the measured reality with margin, not the naive 4x
+    assert _dir_bytes(qpath) < _dir_bytes(fpath) * 0.7, (
+        _dir_bytes(fpath), _dir_bytes(qpath),
+    )
+    assert os.path.exists(os.path.join(qpath, "weights.npz"))
+
+
+def test_cli_export_quantized(trained, tmp_path, capsys):
+    import json
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+    from har_tpu.export import load_exported
+
+    model, raw = trained
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (32, 32)},
+               input_shape=(200, 3))
+    out_dir = str(tmp_path / "art")
+    rc = main(["export", "--checkpoint", ckpt, "--output", out_dir,
+               "--quantize", "int8"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["quantized"]["quantized_kernels"] == 4
+    assert os.path.exists(os.path.join(out_dir, "weights.npz"))
+    pred = load_exported(out_dir)
+    assert pred.meta["model_name"] == "cnn1d"
+    np.testing.assert_allclose(
+        pred.predict(raw.windows[:8])[1],
+        model.transform(raw.windows[:8]).probability,
+        atol=0.05,
+    )
+
+
+def test_quantized_exported_outputs_match_live(trained, tmp_path):
+    from har_tpu.export import export_model, load_exported
+
+    model, raw = trained
+    pred = load_exported(
+        export_model(quantize_model(model), str(tmp_path / "int8"))
+    )
+    logits, probs = pred.predict(raw.windows[:16])
+    np.testing.assert_allclose(
+        probs,
+        model.transform(raw.windows[:16]).probability,
+        atol=0.05,
+    )
+    # and exactly equal to the live QUANTIZED model (same math)
+    np.testing.assert_allclose(
+        logits,
+        quantize_model(model).transform(raw.windows[:16]).raw,
+        rtol=1e-5,
+        atol=1e-5,
+    )
