@@ -44,11 +44,14 @@ _META = "export_meta.json"
 _WEIGHTS = "weights.npz"
 
 
-def _predict_fn(module, params, scaler):
-    """The end-to-end predict: standardize → forward → (logits, probs).
+def make_predict_core(module, scaler):
+    """The ONE standardize → forward → (logits, probs) implementation.
 
-    Scaler statistics and trained parameters enter as closure constants,
-    so the exported program is fully self-contained.
+    Every predict surface — float export (params as closure constants),
+    quantized live serving (dequantized closure constants), quantized
+    export (weights as program inputs) — wraps this core with its own
+    params resolution, so the contract cannot silently diverge between
+    the live path and an exported artifact.
     """
     import jax
     import jax.numpy as jnp
@@ -56,14 +59,20 @@ def _predict_fn(module, params, scaler):
     mean = None if scaler is None else jnp.asarray(scaler.mean)
     std = None if scaler is None else jnp.asarray(scaler.std)
 
-    def predict(x):
+    def core(params, x):
         x = x.astype(jnp.float32)
         if mean is not None:
             x = (x - mean) / std
         logits = module.apply({"params": params}, x).astype(jnp.float32)
         return logits, jax.nn.softmax(logits, axis=-1)
 
-    return predict
+    return core
+
+
+def _predict_fn(module, params, scaler):
+    """x → (logits, probs) with params baked in as closure constants."""
+    core = make_predict_core(module, scaler)
+    return lambda x: core(params, x)
 
 
 def export_model(

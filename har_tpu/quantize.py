@@ -87,30 +87,18 @@ class QuantizedModel:
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
     def predict_fn(self):
-        """x -> (logits, probs), scaler folded in — the export hook
-        (har_tpu.export._resolve_predict) and the transform core."""
-        import jax
-        import jax.numpy as jnp
+        """x -> (logits, probs), scaler folded in — the transform core.
 
-        mean = (
-            None if self.scaler is None else jnp.asarray(self.scaler.mean)
-        )
-        std = None if self.scaler is None else jnp.asarray(self.scaler.std)
+        Uses export.make_predict_core so the contract is shared with
+        every exported artifact.  See dequantized_params: in this LIVE
+        path the dequant folds to f32 constants at trace time; int8
+        persists end-to-end only through export_parts' weight-input
+        form.
+        """
+        from har_tpu.export import make_predict_core
 
-        def predict(x):
-            x = x.astype(jnp.float32)
-            if mean is not None:
-                x = (x - mean) / std
-            # see dequantized_params: in the LIVE path the dequant folds
-            # to f32 constants at trace time; int8 persists end-to-end
-            # only through export_parts' weight-input form
-            params = self.dequantized_params()
-            logits = self.module.apply({"params": params}, x).astype(
-                jnp.float32
-            )
-            return logits, jax.nn.softmax(logits, axis=-1)
-
-        return predict
+        core = make_predict_core(self.module, self.scaler)
+        return lambda x: core(self.dequantized_params(), x)
 
     def export_parts(self):
         """(predict(weights, x), weights) for har_tpu.export.
@@ -125,13 +113,11 @@ class QuantizedModel:
         import jax
         import jax.numpy as jnp
 
-        mean = (
-            None if self.scaler is None else jnp.asarray(self.scaler.mean)
-        )
-        std = None if self.scaler is None else jnp.asarray(self.scaler.std)
+        from har_tpu.export import make_predict_core
+
+        core = make_predict_core(self.module, self.scaler)
         stored = self.stored
         treedef = self.treedef
-        module = self.module
 
         def predict(weight_leaves, x):
             leaves = []
@@ -142,12 +128,9 @@ class QuantizedModel:
                     )
                 else:
                     leaves.append(w)
-            params = jax.tree_util.tree_unflatten(treedef, leaves)
-            x = x.astype(jnp.float32)
-            if mean is not None:
-                x = (x - mean) / std
-            logits = module.apply({"params": params}, x).astype(jnp.float32)
-            return logits, jax.nn.softmax(logits, axis=-1)
+            return core(
+                jax.tree_util.tree_unflatten(treedef, leaves), x
+            )
 
         return predict, [s.value for s in self.stored]
 

@@ -1,0 +1,65 @@
+"""End-to-end execution of the WHOLE bench in smoke mode.
+
+The round driver runs bench.py exactly once, on real hardware, at the
+end of the round — so a refactor that breaks the extras assembly or the
+final print is only discovered when it has already cost the round its
+bench line (r3 lost its parity keys that way; r4 nearly lost the whole
+line to a budget overrun).  HAR_TPU_BENCH_SMOKE=1 shrinks every lane to
+seconds; this test runs main() end to end on the CPU mesh and pins the
+output contract.
+"""
+
+import json
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("HAR_TPU_BENCH_SMOKE", "1")
+    monkeypatch.setenv("HAR_TPU_BENCH_ARTIFACT_DIR", str(tmp_path))
+    # tiny budget: the CPU-expensive throughput lanes deadline-skip
+    # (their skip markers ARE the assembly path under test); the
+    # unguarded core lanes still execute in full
+    monkeypatch.setenv("HAR_TPU_BENCH_BUDGET_S", "60")
+    # hermetic: force the synthetic fallback so the test needs no
+    # reference mount (parity keys then present-but-null by design)
+    monkeypatch.setenv("HAR_TPU_WISDM_CSV", "/nonexistent")
+
+    import bench
+
+    bench.main()
+
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+
+    # the driver's contract: one JSON line with these keys
+    assert result["metric"] == "wisdm_mlp_train_throughput"
+    assert result["unit"] == "windows/s"
+    assert result["value"] > 0
+    assert result["smoke_mode"] is True
+
+    extra = result["extra"]
+    # every lane must be present (ran or carried a skip/error marker)
+    assert set(extra["lanes"]) == {
+        "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
+    }
+    # parity keys exist even on the synthetic fallback (null, not absent)
+    for key in (
+        "lr_parity_test_accuracy",
+        "rf_parity_test_accuracy",
+        "lr_cv_mllib_objective_test_accuracy",
+    ):
+        assert key in extra
+    assert "dt_parity_test_accuracy" in extra
+    assert "serving_latency_ms" in extra
+    assert "north_star" in extra
+    # smoke draws are throwaway: they must not touch (or carry) the
+    # healthy-state cross-reference machinery
+    assert "healthy_state_reference" not in extra
+
+    # durable artifact written where pointed; smoke must NOT mint a
+    # healthy-state reference
+    stored = json.loads((tmp_path / "bench_latest.json").read_text())
+    assert stored["value"] == result["value"]
+    assert not (tmp_path / "bench_healthy.json").exists()
