@@ -108,6 +108,15 @@ def healthy_summary(result: dict) -> dict:
             )
             if k in stats
         }
+    note = (
+        "most recent full bench draw taken at a healthy chip state "
+        f"(pure-matmul probe >= {HEALTHY_CHIP_PCT}% of peak); compare "
+        "a state-limited draw's lanes against these numbers"
+    )
+    if result.get("provenance"):
+        # hand-seeded reference (e.g. a pre-probe draw recovered from
+        # git history): carry its provenance instead of implying a probe
+        note = result["provenance"]
     return {
         "metric": result.get("metric"),
         "value": result.get("value"),
@@ -117,11 +126,7 @@ def healthy_summary(result: dict) -> dict:
         "captured_at": result.get("captured_at"),
         "lanes": lanes,
         "north_star": extra.get("north_star"),
-        "note": (
-            "most recent full bench draw taken at a healthy chip state "
-            f"(pure-matmul probe >= {HEALTHY_CHIP_PCT}% of peak); compare "
-            "a state-limited draw's lanes against these numbers"
-        ),
+        "note": note,
     }
 
 
@@ -397,7 +402,11 @@ def main() -> None:
     )
     if smoke:
         reduction = max(reduction, 20)
-    degraded = reduction > 1
+    # `degraded` is a measured chip-state CLAIM (label + warning);
+    # smoke's epoch cut is not one — `reduced` covers both for the
+    # run-count/steady-slope decisions
+    degraded = reduction > 1 and not smoke
+    reduced = degraded or smoke
     if degraded:
         print(
             f"warning: degraded chip state ({probe_pct}% of peak) — "
@@ -408,7 +417,7 @@ def main() -> None:
     def lane_epochs(e: int) -> int:
         return max(1, e // reduction)
 
-    lane_runs = 1 if degraded else 2
+    lane_runs = 1 if reduced else 2
 
     table, is_real_data = load_table()
     # the reference's exact 3,793/1,625 rows — one membership, every view
@@ -454,7 +463,7 @@ def main() -> None:
         ),
         runs=lane_runs,
         peak=peak,
-        steady_ok=not degraded,
+        steady_ok=not reduced,
     )
     windows_per_sec = mlp_stats["windows_per_sec_best"]
     train_time = mlp_stats["train_time_s_best"]
@@ -546,14 +555,18 @@ def main() -> None:
     # With a uniform penalty (standardize=False) a single converged LR
     # beats the reference's CV headline outright:
     lr_u = LogisticRegression(
-        max_iter=100, reg_param=0.1, standardize=False
+        max_iter=10 if smoke else 100, reg_param=0.1, standardize=False
     ).fit(lr_train)
     lr_u_acc = evaluate(
         lr_test.label, lr_u.transform(lr_test).raw, lr_u.num_classes
     )["accuracy"]
 
-    grid = param_grid(
-        reg_param=[0.1, 0.3, 0.5], elastic_net_param=[0.0, 0.1, 0.2]
+    grid = (
+        param_grid(reg_param=[0.1])
+        if smoke  # 1-point grid: the 45-fit sweep is NOT a seconds lane
+        else param_grid(
+            reg_param=[0.1, 0.3, 0.5], elastic_net_param=[0.0, 0.1, 0.2]
+        )
     )
 
     # CV parity headline (VERDICT r1 missing #1): 5-fold CV over the
@@ -564,7 +577,7 @@ def main() -> None:
     cv_parity = CrossValidator(
         estimator=LogisticRegression(standardize=False),
         grid=grid,
-        num_folds=5,
+        num_folds=2 if smoke else 5,
         seed=2018,
     )
     t0 = time.perf_counter()
@@ -614,7 +627,7 @@ def main() -> None:
             },
             runs=lane_runs,
             peak=peak,
-            steady_ok=not degraded,
+            steady_ok=not reduced,
         ),
     )
     cnn_wps = cnn_stats.get("windows_per_sec_best")
@@ -640,7 +653,7 @@ def main() -> None:
             model_kwargs={"bf16_stream": True, "remat": True},
             runs=lane_runs,
             peak=peak,
-            steady_ok=not degraded,
+            steady_ok=not reduced,
         ),
     )
     bilstm_wps = bilstm_stats.get("windows_per_sec_best")
@@ -668,7 +681,7 @@ def main() -> None:
             model_kwargs={"embed_dim": 256, "num_heads": 8},
             runs=lane_runs,
             peak=peak,
-            steady_ok=not degraded,
+            steady_ok=not reduced,
         ),
     )
     tfm_wps = tfm_stats.get("windows_per_sec_best")
@@ -766,7 +779,7 @@ def main() -> None:
         try:
             from har_tpu.serving import StreamingClassifier
 
-            n_hops = 12 if degraded else 30
+            n_hops = 12 if reduced else 30
             sc = StreamingClassifier(
                 cal_model, window=200, hop=200, smoothing="none"
             )
@@ -810,7 +823,7 @@ def main() -> None:
             model_kwargs=sat_kwargs,
             runs=lane_runs,
             peak=peak,
-            steady_ok=not degraded,
+            steady_ok=not reduced,
         )
 
     # last in line on purpose: at a degraded state its MFU number is

@@ -121,3 +121,41 @@ def test_summary_has_explanatory_note(tmp_path):
     update_healthy_reference(_draw(pct=45.0, value=200_000.0), path)
     summary = healthy_summary(json.loads(path.read_text()))
     assert "healthy chip state" in summary["note"]
+
+
+def test_seeded_reference_carries_provenance(tmp_path):
+    """A hand-seeded pre-probe reference (recovered from git history)
+    must surface its provenance instead of implying a probe ran."""
+    path = tmp_path / "bench_healthy.json"
+    seeded = _draw(pct=None, value=600_000.0)
+    seeded["provenance"] = "recovered from git history (commit X)"
+    path.write_text(json.dumps(seeded))
+
+    degraded = _draw(pct=2.0, value=40_000.0, degraded=True)
+    update_healthy_reference(degraded, path)
+    ref = degraded["extra"]["healthy_state_reference"]
+    assert ref["value"] == 600_000.0
+    assert ref["note"] == "recovered from git history (commit X)"
+    # the degraded draw must not displace the seed
+    assert json.loads(path.read_text())["value"] == 600_000.0
+
+
+def test_repo_seed_artifact_is_consistent():
+    """The committed artifacts/bench_healthy.json seed: healthy-scale
+    numbers + explicit provenance (it predates the chip probe)."""
+    import pathlib
+
+    seed_path = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "artifacts"
+        / "bench_healthy.json"
+    )
+    seed = json.loads(seed_path.read_text())
+    summary = healthy_summary(seed)
+    if seed.get("provenance"):
+        assert "git history" in summary["note"]
+        assert seed.get("chip_pct_of_peak") is None
+    else:
+        # a real probe->=25% draw has replaced the seed — even better
+        assert seed["chip_pct_of_peak"] >= 25.0
+    assert summary["value"] > 100_000  # healthy-scale headline
