@@ -166,6 +166,10 @@ def _parser() -> argparse.ArgumentParser:
     st.add_argument("--events-csv", default=None,
                     help="write per-event rows (t_index,label,raw_label,"
                          "latency_ms,probabilities...)")
+    st.add_argument("--monitor", action="store_true",
+                    help="input-drift detection against the checkpoint's "
+                         "training statistics; events are stamped and the "
+                         "summary carries the final drift report")
 
     ex = sub.add_parser(
         "export",
@@ -316,12 +320,16 @@ def main(argv=None) -> int:
 
         from har_tpu.serving import StreamingClassifier
 
-        sc = StreamingClassifier.from_checkpoint(
-            args.checkpoint,
-            window=args.window,
-            hop=args.hop,
-            smoothing=args.smoothing,
-        )
+        try:
+            sc = StreamingClassifier.from_checkpoint(
+                args.checkpoint,
+                window=args.window,
+                hop=args.hop,
+                smoothing=args.smoothing,
+                monitor="auto" if args.monitor else None,
+            )
+        except ValueError as e:
+            raise SystemExit(str(e))  # clean message, not a traceback
         if args.input is not None:
             rec = np.loadtxt(args.input, delimiter=",", dtype=np.float32)
         else:
@@ -369,6 +377,17 @@ def main(argv=None) -> int:
             {"from_t": a, "to_t": b, "label": lab}
             for a, b, lab in sr.segments()
         ]
+        drift = None
+        if args.monitor and sc.drift_report is not None:
+            rep = sc.drift_report
+            drift = {
+                "drifting": rep.drifting,
+                "events_flagged": sum(1 for e in events if e.drift),
+                "location_z": [round(float(z), 3) for z in rep.location_z],
+                "scale_log_ratio": [
+                    round(float(r), 3) for r in rep.scale_log_ratio
+                ],
+            }
         print(
             json.dumps(
                 {
@@ -376,6 +395,7 @@ def main(argv=None) -> int:
                     "n_events": len(events),
                     "timeline": timeline,
                     "latency": sc.latency_stats(),
+                    "drift": drift,
                     "events_csv": args.events_csv,
                 }
             )

@@ -1,0 +1,172 @@
+"""Input-drift monitoring for deployed streaming inference.
+
+The reference's stated use case is continuous monitoring of elderly
+people from a worn accelerometer (paper §1; the pipeline itself is a
+one-shot batch script, `Main/main.py`).  A deployed recognizer fails
+silently when its INPUT distribution moves — a re-mounted sensor, a
+changed orientation, gain drift, a different wearer — while the model
+keeps emitting confident labels.  This module watches for exactly that:
+
+  ``DriftMonitor`` — per-channel exponentially-weighted running
+    mean/std over the sample stream, compared against the training
+    distribution (taken from a fitted scaler, training windows, or
+    explicit stats).  ``update(samples)`` returns a ``DriftReport``
+    with per-channel z-scores (location) and log-scale ratios (spread),
+    plus a debounced ``drifting`` verdict.
+
+  ``StreamingClassifier(..., monitor=...)`` feeds it automatically:
+    every ``StreamEvent`` then carries ``drift=True`` while the stream
+    is out of distribution, so a timeline consumer can grey out
+    decisions it should not trust.
+
+Host-side numpy by design: the statistics are O(channels) EWMAs over
+samples already in host memory for the ring buffer — putting them on
+the TPU would cost a dispatch round-trip per chunk to accelerate
+nine multiply-adds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftReport:
+    """One update()'s verdict."""
+
+    drifting: bool  # debounced out-of-distribution verdict
+    location_z: np.ndarray  # (C,) |ewma_mean - ref_mean| / ref_std
+    scale_log_ratio: np.ndarray  # (C,) log(ewma_std / ref_std)
+    n_samples: int  # total samples absorbed so far
+
+    @property
+    def worst_channel(self) -> int:
+        return int(
+            np.argmax(
+                np.maximum(self.location_z, np.abs(self.scale_log_ratio))
+            )
+        )
+
+
+class DriftMonitor:
+    """EWMA location/scale drift detector against training statistics.
+
+    Parameters
+    ----------
+    ref_mean, ref_std:
+        Per-channel training-distribution statistics, shape ``(C,)``.
+    halflife:
+        EWMA halflife in samples (default 400 = 20 s at 20 Hz): the
+    	window over which old evidence decays to half weight.
+    z_threshold:
+        Location shift (in training standard deviations) or scale
+        log-ratio magnitude (``|log(std_new/std_ref)|``; 0.69 = 2x)
+        that counts as drifted.
+    patience:
+        Consecutive over-threshold updates before ``drifting`` flips
+        (debounce: one noisy chunk is not a re-mounted sensor).
+    """
+
+    def __init__(
+        self,
+        ref_mean,
+        ref_std,
+        *,
+        halflife: float = 400.0,
+        z_threshold: float = 3.0,
+        scale_threshold: float = 0.69,
+        patience: int = 3,
+    ):
+        self.ref_mean = np.asarray(ref_mean, np.float64).reshape(-1)
+        self.ref_std = np.asarray(ref_std, np.float64).reshape(-1)
+        if self.ref_mean.shape != self.ref_std.shape:
+            raise ValueError("ref_mean and ref_std must have equal shape")
+        self.ref_std = np.where(self.ref_std > 0, self.ref_std, 1.0)
+        if halflife <= 0:
+            raise ValueError("halflife must be positive")
+        self.halflife = float(halflife)
+        self.z_threshold = float(z_threshold)
+        self.scale_threshold = float(scale_threshold)
+        self.patience = int(patience)
+        self.reset()
+
+    @classmethod
+    def from_model(cls, model, **kwargs) -> "DriftMonitor":
+        """Training stats from a fitted model's scaler.
+
+        Raw-window scalers carry (window, C) statistics — collapsed to
+        per-channel by averaging the location and RMS-averaging the
+        spread over the window axis.
+        """
+        scaler = getattr(model, "scaler", None)
+        if scaler is None:
+            raise ValueError(
+                "model has no fitted scaler; use from_windows or pass "
+                "ref_mean/ref_std explicitly"
+            )
+        mean = np.asarray(scaler.mean, np.float64)
+        std = np.asarray(scaler.std, np.float64)
+        if mean.ndim == 2:  # (window, C) raw-window statistics
+            mean = mean.mean(axis=0)
+            std = np.sqrt((std**2).mean(axis=0))
+        return cls(mean, std, **kwargs)
+
+    @classmethod
+    def from_windows(cls, windows, **kwargs) -> "DriftMonitor":
+        """Training stats from raw ``(n, T, C)`` (or ``(n, C)``) data."""
+        w = np.asarray(windows, np.float64)
+        flat = w.reshape(-1, w.shape[-1])
+        return cls(flat.mean(axis=0), flat.std(axis=0), **kwargs)
+
+    def reset(self) -> None:
+        self._mean = self.ref_mean.copy()
+        self._var = self.ref_std.copy() ** 2
+        self._n = 0
+        self._over = 0
+        self._drifting = False
+
+    def update(self, samples) -> DriftReport:
+        """Absorb ``(n, C)`` samples; return the current verdict."""
+        x = np.atleast_2d(np.asarray(samples, np.float64))
+        if x.shape[-1] != self.ref_mean.shape[0]:
+            raise ValueError(
+                f"expected (n, {self.ref_mean.shape[0]}) samples, got "
+                f"{x.shape}"
+            )
+        n = len(x)
+        if n:
+            # chunk-sized EWMA step: weight of the old state after n
+            # samples is (1/2)^(n/halflife) — order-insensitive within
+            # a chunk, equivalent to per-sample EWMA in the aggregate
+            keep = math.pow(0.5, n / self.halflife)
+            cm = x.mean(axis=0)
+            cv = x.var(axis=0)
+            # total variance: within-chunk + between-means
+            self._var = keep * (
+                self._var + (self._mean - cm) ** 2 * (1 - keep)
+            ) + (1 - keep) * cv
+            self._mean = keep * self._mean + (1 - keep) * cm
+            self._n += n
+
+        z = np.abs(self._mean - self.ref_mean) / self.ref_std
+        ratio = np.log(
+            np.sqrt(np.maximum(self._var, 1e-12)) / self.ref_std
+        )
+        over = bool(
+            (z > self.z_threshold).any()
+            or (np.abs(ratio) > self.scale_threshold).any()
+        )
+        self._over = self._over + 1 if over else 0
+        if self._over >= self.patience:
+            self._drifting = True
+        elif not over:
+            self._drifting = False
+        return DriftReport(
+            drifting=self._drifting,
+            location_z=z,
+            scale_log_ratio=ratio,
+            n_samples=self._n,
+        )

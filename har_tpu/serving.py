@@ -54,6 +54,9 @@ class StreamEvent:
     #   probs ("ema"), trailing vote fractions ("vote"), or the window's
     #   own probs ("none"); probability[label] is the decision confidence
     latency_ms: float  # wall-clock of the predict for this window
+    drift: bool = False  # input stream out of training distribution
+    #   (only when a monitoring.DriftMonitor is attached; see
+    #   StreamingClassifier(monitor=...))
 
 
 def _percentile(values: Sequence[float], q: float) -> float:
@@ -93,6 +96,7 @@ class StreamingClassifier:
         ema_alpha: float = 0.4,
         vote_depth: int = 5,
         class_names: Sequence[str] | None = None,
+        monitor=None,
     ):
         if window <= 0 or hop <= 0:
             raise ValueError("window and hop must be positive")
@@ -110,6 +114,10 @@ class StreamingClassifier:
         self.ema_alpha = float(ema_alpha)
         self.vote_depth = int(vote_depth)
         self.class_names = list(class_names) if class_names else None
+        # optional monitoring.DriftMonitor: fed every pushed sample;
+        # events carry drift=True while the stream is out of the
+        # training distribution
+        self.monitor = monitor
         self.reset()
 
     @classmethod
@@ -142,7 +150,22 @@ class StreamingClassifier:
                         "trained on"
                     )
                 kwargs.setdefault(name, value)
-        return cls(load_model(path), **kwargs)
+        model = load_model(path)
+        if kwargs.get("monitor") == "auto":
+            # drift detection against the checkpoint's own training
+            # statistics (the scaler's mean/std)
+            from har_tpu.monitoring import DriftMonitor
+
+            if getattr(model, "scaler", None) is None:
+                raise ValueError(
+                    "this checkpoint records no training statistics "
+                    "(model trained with standardize=False), so "
+                    "monitor='auto' has nothing to compare against; "
+                    "build DriftMonitor.from_windows(training_windows) "
+                    "and pass it as monitor= instead"
+                )
+            kwargs["monitor"] = DriftMonitor.from_model(model)
+        return cls(model, **kwargs)
 
     def reset(self) -> None:
         """Drop buffered samples and smoothing state (stream restart)."""
@@ -154,6 +177,9 @@ class StreamingClassifier:
         self._ema: np.ndarray | None = None
         self._votes: deque[int] = deque(maxlen=self.vote_depth)
         self._latencies: list[float] = []
+        self._drift_report = None
+        if getattr(self, "monitor", None) is not None:
+            self.monitor.reset()
         # the first predict EVER pays compilation; a reset() on a warm
         # classifier starts a session whose first sample is already fast
         self._session_starts_cold = not getattr(
@@ -181,6 +207,13 @@ class StreamingClassifier:
             # boundary inside a large chunk is skipped
             take = min(self._next_emit - self._n_seen, n - pos)
             chunk = samples[pos : pos + take]
+            if self.monitor is not None and take:
+                # per consumed chunk, NOT per push: a whole recording
+                # pushed at once must step the monitor at the same
+                # cadence live streaming would, or the debounce could
+                # never fire and events would all share one end-of-
+                # recording verdict
+                self._drift_report = self.monitor.update(chunk)
             # roll the ring by `take`: cheap at stream chunk sizes, and
             # keeps the window contiguous for the device transfer
             if take >= self.window:
@@ -236,6 +269,10 @@ class StreamingClassifier:
             raw_label=raw_label,
             probability=smoothed.copy(),
             latency_ms=latency_ms,
+            drift=bool(
+                self._drift_report is not None
+                and self._drift_report.drifting
+            ),
         )
 
     # ---------------------------------------------------------- reporting
@@ -258,6 +295,12 @@ class StreamingClassifier:
                 round(_percentile(steady, 50), 3) if steady else None
             ),
         }
+
+    @property
+    def drift_report(self):
+        """The attached monitor's latest DriftReport (None without a
+        monitor or before the first push)."""
+        return self._drift_report
 
     def label_name(self, label: int) -> str:
         if self.class_names and 0 <= label < len(self.class_names):

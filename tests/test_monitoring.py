@@ -1,0 +1,258 @@
+"""Input-drift monitoring (har_tpu.monitoring).
+
+Contracts: in-distribution streams never alarm; location and scale
+shifts alarm after the debounce; recovery clears the flag; the serving
+integration stamps events with the verdict.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.monitoring import DriftMonitor
+
+
+def _stream(rng, n, mean=(0.0, 0.0, 9.8), std=(1.0, 1.0, 1.0)):
+    return (
+        rng.normal(size=(n, 3)) * np.asarray(std) + np.asarray(mean)
+    ).astype(np.float32)
+
+
+def _monitor(**kw):
+    kw.setdefault("halflife", 100.0)
+    kw.setdefault("patience", 2)
+    return DriftMonitor([0.0, 0.0, 9.8], [1.0, 1.0, 1.0], **kw)
+
+
+def test_in_distribution_never_alarms():
+    mon = _monitor()
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        report = mon.update(_stream(rng, 40))
+    assert not report.drifting
+    assert report.location_z.max() < 1.0
+    assert abs(report.scale_log_ratio).max() < 0.3
+    assert report.n_samples == 2000
+
+
+def test_location_shift_alarms_after_patience():
+    mon = _monitor()
+    rng = np.random.default_rng(1)
+    mon.update(_stream(rng, 200))
+    # sensor re-mount: gravity moves from Z to X
+    verdicts = [
+        mon.update(_stream(rng, 200, mean=(9.8, 0.0, 0.0))).drifting
+        for _ in range(6)
+    ]
+    assert verdicts[-1] is True
+    # debounce: the very first shifted chunk must not flip the flag
+    assert verdicts[0] is False
+    report = mon.update(_stream(rng, 1, mean=(9.8, 0.0, 0.0)))
+    assert report.worst_channel in (0, 2)  # X gained / Z lost gravity
+
+
+def test_scale_shift_alarms():
+    mon = _monitor()
+    rng = np.random.default_rng(2)
+    mon.update(_stream(rng, 200))
+    for _ in range(8):
+        report = mon.update(_stream(rng, 200, std=(4.0, 4.0, 4.0)))
+    assert report.drifting
+    assert abs(report.scale_log_ratio).max() > 0.69
+
+
+def test_recovery_clears_flag():
+    mon = _monitor()
+    rng = np.random.default_rng(3)
+    for _ in range(8):
+        mon.update(_stream(rng, 200, mean=(9.8, 0.0, 0.0)))
+    assert mon.update(_stream(rng, 1, mean=(9.8, 0.0, 0.0))).drifting
+    # back in distribution: EWMA decays, flag clears
+    for _ in range(12):
+        report = mon.update(_stream(rng, 200))
+    assert not report.drifting
+
+
+def test_from_windows_and_from_model_stats():
+    rng = np.random.default_rng(4)
+    windows = rng.normal(size=(32, 200, 3)).astype(np.float32) * 2.0 + 1.0
+    mon = DriftMonitor.from_windows(windows)
+    np.testing.assert_allclose(mon.ref_mean, [1.0] * 3, atol=0.1)
+    np.testing.assert_allclose(mon.ref_std, [2.0] * 3, atol=0.1)
+
+    class _Scaler:
+        mean = np.full((200, 3), 1.0, np.float32)
+        std = np.full((200, 3), 2.0, np.float32)
+
+    class _Model:
+        scaler = _Scaler()
+
+    mon2 = DriftMonitor.from_model(_Model())
+    np.testing.assert_allclose(mon2.ref_mean, [1.0] * 3)
+    np.testing.assert_allclose(mon2.ref_std, [2.0] * 3)
+    with pytest.raises(ValueError, match="scaler"):
+        DriftMonitor.from_model(object())
+
+
+def test_validation():
+    mon = _monitor()
+    with pytest.raises(ValueError, match="expected"):
+        mon.update(np.zeros((5, 2)))
+    with pytest.raises(ValueError, match="halflife"):
+        DriftMonitor([0.0], [1.0], halflife=0)
+    with pytest.raises(ValueError, match="equal shape"):
+        DriftMonitor([0.0, 1.0], [1.0])
+
+
+def test_cli_stream_with_monitor(tmp_path, capsys):
+    import json
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=128, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=2, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (16,)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16,)},
+               input_shape=(200, 3))
+
+    rc = main(["stream", "--checkpoint", ckpt, "--hop", "200",
+               "--monitor"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the demo recording comes from the training distribution: report
+    # present, no drift
+    assert out["drift"] is not None
+    assert out["drift"]["drifting"] is False
+    assert len(out["drift"]["location_z"]) == 3
+
+
+def test_single_push_drifted_recording_flags_events():
+    """Offline replay: one big push must step the monitor per chunk so
+    the debounce can fire inside the recording (the CLI pushes a whole
+    recording in one call)."""
+    from har_tpu.serving import StreamingClassifier
+
+    class _Stub:
+        num_classes = 2
+
+        def transform(self, x):
+            from har_tpu.models.base import Predictions
+
+            p = np.tile([[0.8, 0.2]], (len(x), 1))
+            return Predictions.from_raw(np.log(p), p)
+
+    rng = np.random.default_rng(7)
+    rec = np.concatenate(
+        [_stream(rng, 600), _stream(rng, 1400, mean=(9.8, 0.0, 0.0))]
+    )
+    sc = StreamingClassifier(
+        _Stub(), window=50, hop=50, smoothing="none",
+        monitor=_monitor(),
+    )
+    events = sc.push(rec)  # single push of the whole recording
+    assert len(events) == 40
+    assert not events[0].drift  # in-distribution head
+    assert events[-1].drift  # drifted tail flagged
+    # attribution: the flag flips somewhere after the shift at t=600
+    first_flag = next(i for i, e in enumerate(events) if e.drift)
+    assert events[first_flag].t_index > 600
+
+
+def test_cli_stream_drifted_input(tmp_path, capsys):
+    import json
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=128, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=2, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (16,)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16,)},
+               input_shape=(200, 3))
+
+    # a wildly out-of-distribution recording (sensor re-oriented +
+    # re-scaled)
+    rng = np.random.default_rng(8)
+    rec = rng.normal(size=(3000, 3)) * 30.0 + 50.0
+    rec_csv = str(tmp_path / "rec.csv")
+    np.savetxt(rec_csv, rec, delimiter=",", fmt="%.4f")
+
+    rc = main(["stream", "--checkpoint", ckpt, "--input", rec_csv,
+               "--hop", "100", "--monitor"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["drift"]["drifting"] is True
+    assert out["drift"]["events_flagged"] > 0
+
+
+def test_cli_monitor_without_scaler_is_a_clean_error(tmp_path, capsys):
+    import pytest as _pytest
+
+    from har_tpu.checkpoint import save_model
+    from har_tpu.cli import main
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, seed=0),
+        model_kwargs={"channels": (8,)},
+        standardize=False,
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (8,)},
+               input_shape=(200, 3))
+
+    with _pytest.raises(SystemExit, match="standardize=False"):
+        main(["stream", "--checkpoint", ckpt, "--monitor"])
+
+
+def test_streaming_integration_stamps_events():
+    from har_tpu.serving import StreamingClassifier
+
+    class _Stub:
+        num_classes = 2
+
+        def transform(self, x):
+            from har_tpu.models.base import Predictions
+
+            p = np.tile([[0.8, 0.2]], (len(x), 1))
+            return Predictions.from_raw(np.log(p), p)
+
+    rng = np.random.default_rng(5)
+    sc = StreamingClassifier(
+        _Stub(), window=50, hop=50, smoothing="none",
+        monitor=_monitor(),
+    )
+    in_dist = sc.push(_stream(rng, 400))
+    assert all(not e.drift for e in in_dist)
+    shifted = []
+    for _ in range(6):
+        shifted.extend(sc.push(_stream(rng, 400, mean=(9.8, 0.0, 0.0))))
+    assert shifted[-1].drift
+    assert sc.drift_report is not None and sc.drift_report.drifting
+    # reset clears monitor state with the stream
+    sc.reset()
+    assert sc.drift_report is None
+    assert not sc.push(_stream(rng, 50))[0].drift
