@@ -1,0 +1,123 @@
+"""ZeRO-1 sharded-optimizer data parallelism (har_tpu.parallel.zero1).
+
+The whole value proposition is two claims, both pinned here:
+  1. the update math is IDENTICAL to the replicated trainer (Adam is
+     elementwise, so updating 1/N slices then all-gathering changes
+     nothing);
+  2. the optimizer state actually lives 1/N per data shard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from har_tpu.models.neural import MLP
+from har_tpu.parallel.mesh import create_mesh, create_multihost_mesh
+from har_tpu.parallel.zero1 import Zero1Trainer, make_zero1_fit
+from har_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def _data(n=512, d=13, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes))
+    y = (x @ w).argmax(axis=1).astype(np.int32)
+    return x, y
+
+
+CFG = TrainerConfig(batch_size=128, epochs=25, learning_rate=3e-3, seed=0)
+
+
+def test_zero1_matches_replicated_trainer():
+    x, y = _data()
+    module = MLP(num_classes=4, hidden=(32, 16))
+
+    mesh = create_mesh(dp=8)
+    base = Trainer(module, CFG, mesh=mesh, scan=True).fit(
+        x, y, num_classes=4
+    )
+    z1 = Zero1Trainer(module, CFG, mesh=mesh).fit(x, y, num_classes=4)
+
+    flat_b = jax.flatten_util.ravel_pytree(base.params)[0]
+    flat_z = jax.flatten_util.ravel_pytree(z1.params)[0]
+    np.testing.assert_allclose(flat_z, flat_b, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        z1.history["loss"], base.history["loss"], rtol=1e-4, atol=1e-5
+    )
+    # and the fitted model actually learned signal (equivalence above is
+    # the real claim; 4-class chance is 0.25)
+    acc = (z1.transform(x).prediction == y).mean()
+    assert acc > 0.5
+
+
+def test_zero1_opt_state_is_sharded():
+    x, y = _data(n=256)
+    module = MLP(num_classes=4, hidden=(32,))
+    mesh = create_mesh(dp=8)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.asarray(x[:2]), train=False
+    )["params"]
+    import optax
+
+    optimizer = optax.adamw(1e-3)
+    fit, init_opt_state = make_zero1_fit(
+        module.apply, optimizer, mesh, params
+    )
+    state = init_opt_state()
+    mu = state[0].mu  # scale_by_adam state
+    d = jax.flatten_util.ravel_pytree(params)[0].size
+    dpad = -(-d // 8) * 8
+    assert mu.shape == (dpad,)
+    # the leading axis is split over dp: each device holds 1/8
+    assert "dp" in str(mu.sharding.spec)
+    shard_shapes = {s.data.shape for s in mu.addressable_shards}
+    assert shard_shapes == {(dpad // 8,)}
+
+
+def test_zero1_on_hybrid_multislice_mesh():
+    """dp_dcn x dp mesh: the all-gather's tiled order must match the
+    linear shard order, or params would be scrambled — equality with
+    the flat-mesh result proves the layout."""
+    x, y = _data(n=256)
+    module = MLP(num_classes=4, hidden=(16,))
+    cfg = TrainerConfig(batch_size=64, epochs=2, learning_rate=3e-3,
+                        seed=0)
+
+    flat = Zero1Trainer(module, cfg, mesh=create_mesh(dp=8)).fit(
+        x, y, num_classes=4
+    )
+    hybrid = Zero1Trainer(
+        module, cfg, mesh=create_multihost_mesh(num_slices=2)
+    ).fit(x, y, num_classes=4)
+    np.testing.assert_allclose(
+        jax.flatten_util.ravel_pytree(hybrid.params)[0],
+        jax.flatten_util.ravel_pytree(flat.params)[0],
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+def test_zero1_rejects_unsupported_trainer_features():
+    import pytest
+
+    x, y = _data(n=64)
+    with pytest.raises(ValueError, match="early_stop_patience"):
+        Zero1Trainer(
+            MLP(num_classes=4, hidden=(8,)),
+            TrainerConfig(batch_size=32, epochs=1,
+                          early_stop_patience=3,
+                          validation_fraction=0.2),
+            mesh=create_mesh(dp=8),
+        ).fit(x, y, num_classes=4)
+
+
+def test_zero1_batch_divisibility_guard():
+    import pytest
+
+    x, y = _data(n=64)
+    with pytest.raises(ValueError, match="divisible"):
+        Zero1Trainer(
+            MLP(num_classes=4, hidden=(8,)),
+            TrainerConfig(batch_size=30, epochs=1),
+            mesh=create_mesh(dp=8),
+        ).fit(x, y, num_classes=4)
