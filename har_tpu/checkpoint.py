@@ -47,7 +47,9 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                synthetic_rows: int | None = None,
                drop_binned: bool | None = None,
                split_method: str | None = None,
-               input_shape: tuple | None = None) -> str:
+               input_shape: tuple | None = None,
+               split_seed: int | None = None,
+               train_fraction: float | None = None) -> str:
     """Persist a trained neural classifier (params + scaler + config).
 
     ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks,
@@ -83,6 +85,13 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
         # geometry against this (a pooled CNN would otherwise accept any
         # window length and silently emit distribution-shifted output)
         meta["input_shape"] = [int(d) for d in input_shape]
+    if split_seed is not None:
+        # train/test draw provenance: lets `har finetune` (and future
+        # consumers) re-derive the checkpoint's OWN held-out rows
+        # instead of measuring "held-out" accuracy on training rows
+        meta["split_seed"] = int(split_seed)
+    if train_fraction is not None:
+        meta["train_fraction"] = float(train_fraction)
     if model.scaler is not None:
         meta["scaler"] = {
             "mean": np.asarray(model.scaler.mean).tolist(),

@@ -189,6 +189,8 @@ class Zero1Trainer:
             "save_every_epochs": cfg.save_every_epochs,
             "early_stop_patience": cfg.early_stop_patience,
             "class_weight": cfg.class_weight,
+            "log_every": cfg.log_every,
+            "compute_flops": cfg.compute_flops,
         }
         set_fields = [k for k, v in unsupported.items() if v]
         if set_fields:

@@ -662,6 +662,8 @@ def _save_fitted(
             drop_binned=config.data.drop_binned,
             split_method=split_method,
             input_shape=input_shape,
+            split_seed=config.data.seed,
+            train_fraction=config.data.train_fraction,
         )
     return save_classical_model(
         path,

@@ -18,6 +18,7 @@ import dataclasses
 import time
 from typing import Any, Callable, Iterator
 
+import chex
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -74,7 +75,7 @@ class TrainerConfig:
 
 def _run_fingerprint(
     cfg: TrainerConfig, x: np.ndarray, y: np.ndarray, module, augment=None,
-    params=None,
+    params=None, warm_start_digest=None, optimizer_tag=None,
 ) -> str:
     """Stable id for (model, data, schedule): the checkpoint-slot key.
 
@@ -126,6 +127,17 @@ def _run_fingerprint(
                  cfg.validation_fraction)
             ).encode()
         )
+    if warm_start_digest is not None:
+        # warm starts (transfer.fine_tune) share shapes with from-scratch
+        # runs; the VALUE digest keeps fine-tunes of different checkpoints
+        # (and from-scratch runs) from resuming each other's snapshots
+        h.update(b"warm_start")
+        h.update(warm_start_digest.encode())
+    if optimizer_tag is not None:
+        # a custom optimizer (e.g. a freeze mask) executes a different
+        # run even with identical config/data
+        h.update(b"optimizer")
+        h.update(optimizer_tag.encode())
     return h.hexdigest()[:16]
 
 
@@ -414,6 +426,7 @@ class Trainer:
         mesh: Mesh | None = None,
         scan: bool = True,
         augment: Callable | None = None,
+        optimizer_factory: Callable | None = None,
     ):
         self.module = module
         self.config = config or TrainerConfig()
@@ -424,6 +437,11 @@ class Trainer:
         # augment(key, xb) -> xb, applied inside the compiled train step
         # (scan path); see har_tpu.data.augment
         self.augment = augment
+        # optimizer_factory(cfg, total_steps) -> GradientTransformation;
+        # defaults to make_optimizer.  Lets callers wrap the optimizer
+        # (e.g. transfer.fine_tune masks frozen subtrees) while keeping
+        # the schedule derived from the actual step count.
+        self.optimizer_factory = optimizer_factory
 
     def _open_checkpointer(self, cfg, x, y, params):
         """One slot-derivation for every checkpointing path (chunked and
@@ -435,7 +453,9 @@ class Trainer:
         slot = os.path.join(
             cfg.checkpoint_dir,
             _run_fingerprint(
-                cfg, x, y, self.module, augment=self.augment, params=params
+                cfg, x, y, self.module, augment=self.augment, params=params,
+                warm_start_digest=getattr(self, "_warm_start_digest", None),
+                optimizer_tag=getattr(self, "_optimizer_tag", None),
             ),
         )
         return TrainCheckpointer(slot)
@@ -445,6 +465,7 @@ class Trainer:
         x: np.ndarray,
         y: np.ndarray,
         num_classes: int | None = None,
+        init_params=None,
     ) -> NeuralModel:
         cfg = self.config
         mesh = self.mesh
@@ -485,13 +506,41 @@ class Trainer:
             )
         steps_per_epoch = max(1, -(-n // cfg.batch_size))
         total_steps = steps_per_epoch * cfg.epochs
-        optimizer = make_optimizer(cfg, total_steps)
+        optimizer = (self.optimizer_factory or make_optimizer)(
+            cfg, total_steps
+        )
 
         root = jax.random.PRNGKey(cfg.seed)
         init_rng, step_root = jax.random.split(root)
         params = self.module.init(
             init_rng, jnp.asarray(x[: min(2, n)]), train=False
         )["params"]
+        if init_params is not None:
+            # warm start (transfer.fine_tune): the fresh init above is
+            # the structural template the restored tree must match, so a
+            # checkpoint from a different architecture fails loudly here
+            chex.assert_trees_all_equal_shapes(params, init_params)
+            params = jax.tree.map(jnp.asarray, init_params)
+        # checkpoint-slot fingerprint context: warm starts share shapes
+        # with from-scratch runs and a custom optimizer (freeze mask)
+        # changes the run — both must key the slot (_open_checkpointer)
+        self._warm_start_digest = None
+        if init_params is not None:
+            import hashlib
+
+            hh = hashlib.sha1()
+            for leaf in jax.tree.leaves(init_params):
+                hh.update(np.ascontiguousarray(leaf).tobytes())
+            self._warm_start_digest = hh.hexdigest()
+        self._optimizer_tag = None
+        if self.optimizer_factory is not None:
+            self._optimizer_tag = getattr(
+                self.optimizer_factory,
+                "fingerprint_tag",
+                getattr(
+                    self.optimizer_factory, "__qualname__", "custom"
+                ),
+            )
         opt_state = optimizer.init(params)
 
         host_rng = np.random.default_rng(cfg.seed)

@@ -1,0 +1,132 @@
+"""Transfer learning: fine-tune a saved checkpoint on new data.
+
+The reference trains from scratch every run and persists nothing
+(`Main/main.py:115-130`; SURVEY §5.4) — but the paper's deployment
+story (continuous monitoring of a specific wearer) is exactly the
+setting where a model pretrained on the cohort should be ADAPTED to the
+individual: a few minutes of the wearer's labeled windows, not a
+retrain.  ``fine_tune`` is that path:
+
+  - warm-starts the trainer from the checkpoint's parameters (the
+    fresh-init tree is kept as a structural template, so an
+    architecture mismatch fails loudly);
+  - reuses the checkpoint's OWN scaler — refitting statistics on a
+    small adaptation set would shift the input distribution under the
+    pretrained features;
+  - optionally freezes parameter subtrees (``freeze=("ConvBlock_0",)``)
+    via an ``optax.masked`` wrapper around the standard optimizer, so
+    a small adaptation set tunes the head without washing out the
+    pretrained feature extractor.
+
+Everything else (scanned whole-run program, schedule, SPMD mesh) is the
+ordinary ``train.Trainer`` — fine-tuning is a starting point and a
+gradient mask, not a second training stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def freeze_mask(params, freeze: tuple[str, ...]):
+    """Per-leaf trainability pytree: False under any top-level module
+    named in ``freeze``, True elsewhere."""
+    import jax
+
+    unknown = set(freeze) - set(params.keys())
+    if unknown:
+        raise ValueError(
+            f"freeze names {sorted(unknown)} not in params "
+            f"(top-level modules: {sorted(params.keys())})"
+        )
+    return {
+        k: jax.tree.map(lambda _: k not in freeze, sub)
+        for k, sub in params.items()
+    }
+
+
+def fine_tune(
+    checkpoint_path: str,
+    data,
+    config=None,
+    *,
+    mesh=None,
+    freeze: tuple[str, ...] = (),
+    model=None,
+):
+    """Fine-tuned ``NeuralClassifierModel`` from a saved checkpoint.
+
+    ``data`` is a FeatureSet (or anything with ``features``/``label``)
+    of NEW examples in the checkpoint's input space; ``config`` is a
+    TrainerConfig for the adaptation run (short schedules and lower
+    learning rates are the norm — default: 20 epochs at lr/10).
+    """
+    import jax
+    import optax
+
+    from har_tpu.checkpoint import load_model
+    from har_tpu.models.neural_classifier import NeuralClassifierModel
+    from har_tpu.train.trainer import (
+        Trainer,
+        TrainerConfig,
+        make_optimizer,
+    )
+
+    if model is None:  # caller may pass the already-restored model
+        model = load_model(checkpoint_path)
+    if config is None:
+        config = TrainerConfig(epochs=20, learning_rate=3e-4)
+
+    x = np.asarray(
+        data.features if hasattr(data, "features") else data[0], np.float32
+    )
+    y = np.asarray(
+        data.label if hasattr(data, "label") else data[1], np.int32
+    )
+    if len(y) and (y.max() >= model.num_classes or y.min() < 0):
+        # fail loudly: under jit the one-hot gather would silently CLAMP
+        # out-of-range labels onto the last class and train toward it
+        raise ValueError(
+            f"adaptation labels span [{y.min()}, {y.max()}] but the "
+            f"checkpoint has {model.num_classes} classes"
+        )
+    if model.scaler is not None:
+        # the checkpoint's own statistics — never refit on the small
+        # adaptation set
+        x = model.scaler.transform(x)
+
+    optimizer_factory = None
+    if freeze:
+        mask = freeze_mask(model.inner.params, tuple(freeze))
+
+        def optimizer_factory(cfg, total_steps):
+            # frozen leaves must receive EXACTLY zero updates: masking
+            # the whole optimizer (not just the grads) keeps adamw's
+            # decoupled weight decay and Adam moments off them too
+            return optax.chain(
+                optax.masked(make_optimizer(cfg, total_steps), mask),
+                optax.masked(
+                    optax.set_to_zero(),
+                    jax.tree.map(lambda t: not t, mask),
+                ),
+            )
+
+        # stable checkpoint-fingerprint identity: runs with different
+        # freeze sets must not resume each other's snapshots
+        optimizer_factory.fingerprint_tag = f"freeze:{sorted(freeze)}"
+
+    trained = Trainer(
+        model.inner.module,
+        config,
+        mesh=mesh,
+        optimizer_factory=optimizer_factory,
+    ).fit(
+        x, y,
+        num_classes=model.num_classes,
+        init_params=model.inner.params,
+    )
+    return NeuralClassifierModel(
+        inner=trained,
+        scaler=model.scaler,
+        num_classes=model.num_classes,
+    )

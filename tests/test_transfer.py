@@ -1,0 +1,212 @@
+"""Transfer learning (har_tpu.transfer).
+
+Contracts: warm start actually adapts (beats the zero-shot checkpoint
+on shifted data), frozen subtrees are bit-identical after fine-tuning
+(no grads, no Adam moments, no weight decay), the checkpoint's scaler
+is reused rather than refit, and architecture mismatches fail loudly.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.checkpoint import save_model
+from har_tpu.data.raw_windows import synthetic_raw_stream
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.neural_classifier import NeuralClassifier
+from har_tpu.train.trainer import TrainerConfig
+from har_tpu.transfer import fine_tune, freeze_mask
+
+
+def _shift(windows, seed=9):
+    """A 'new wearer': rotated axes + gain change on the same classes."""
+    rng = np.random.default_rng(seed)
+    theta = 0.5
+    rot = np.array(
+        [
+            [np.cos(theta), -np.sin(theta), 0],
+            [np.sin(theta), np.cos(theta), 0],
+            [0, 0, 1],
+        ],
+        np.float32,
+    )
+    return (windows @ rot.T) * 1.3 + rng.normal(scale=0.05, size=(3,)).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def pretrained(tmp_path_factory):
+    raw = synthetic_raw_stream(n_windows=512, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=128, epochs=10, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (32, 32)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    ckpt = str(tmp_path_factory.mktemp("ckpt") / "cnn1d")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (32, 32)},
+               input_shape=(200, 3))
+    return ckpt, model, raw
+
+
+def test_fine_tune_adapts_to_shifted_wearer(pretrained):
+    ckpt, model, raw = pretrained
+    new = synthetic_raw_stream(n_windows=256, seed=3)
+    shifted = _shift(new.windows)
+    y = new.labels.astype(np.int32)
+    adapt = FeatureSet(features=shifted[:192], label=y[:192])
+    held_x, held_y = shifted[192:], y[192:]
+
+    zero_shot = (model.transform(held_x).prediction == held_y).mean()
+    tuned = fine_tune(
+        ckpt,
+        adapt,
+        TrainerConfig(batch_size=64, epochs=15, learning_rate=5e-4,
+                      seed=0),
+    )
+    adapted = (tuned.transform(held_x).prediction == held_y).mean()
+    assert adapted > zero_shot + 0.05, (zero_shot, adapted)
+    # the checkpoint's scaler came along unchanged (no refit on the
+    # small adaptation set)
+    np.testing.assert_array_equal(tuned.scaler.mean, model.scaler.mean)
+
+
+def test_freeze_keeps_subtrees_bit_identical(pretrained):
+    import jax
+
+    ckpt, model, raw = pretrained
+    new = synthetic_raw_stream(n_windows=128, seed=4)
+    adapt = FeatureSet(
+        features=_shift(new.windows),
+        label=new.labels.astype(np.int32),
+    )
+    frozen_names = ("ConvBlock_0", "ConvBlock_1")
+    tuned = fine_tune(
+        ckpt,
+        adapt,
+        TrainerConfig(batch_size=64, epochs=3, learning_rate=1e-3,
+                      seed=0),
+        freeze=frozen_names,
+    )
+    for name in frozen_names:
+        before = jax.flatten_util.ravel_pytree(
+            model.inner.params[name]
+        )[0]
+        after = jax.flatten_util.ravel_pytree(
+            tuned.inner.params[name]
+        )[0]
+        np.testing.assert_array_equal(np.asarray(after), np.asarray(before))
+    # the head DID move
+    head_b = jax.flatten_util.ravel_pytree(model.inner.params["Dense_1"])[0]
+    head_a = jax.flatten_util.ravel_pytree(tuned.inner.params["Dense_1"])[0]
+    assert not np.array_equal(np.asarray(head_a), np.asarray(head_b))
+
+
+def test_freeze_mask_validation(pretrained):
+    _, model, _ = pretrained
+    with pytest.raises(ValueError, match="not in params"):
+        freeze_mask(model.inner.params, ("NoSuchBlock",))
+    mask = freeze_mask(model.inner.params, ("ConvBlock_0",))
+    import jax
+
+    leaves = jax.tree.leaves(mask["ConvBlock_0"])
+    assert leaves and not any(leaves)
+    assert all(jax.tree.leaves(mask["Dense_1"]))
+
+
+def test_cli_finetune_round_trip(tmp_path, capsys):
+    """`har train --save-models-dir` → `har finetune` end to end on the
+    synthetic dataset, provenance (dataset/rows/split) carried over."""
+    import json
+
+    from har_tpu.cli import main
+
+    models_dir = str(tmp_path / "models")
+    rc = main(
+        [
+            "train", "--dataset", "synthetic", "--models", "mlp",
+            "--epochs", "3", "--no-cv",
+            "--save-models-dir", models_dir,
+            "--output-dir", str(tmp_path / "out"),
+        ]
+    )
+    assert rc == 0
+    capsys.readouterr()
+
+    out_ckpt = str(tmp_path / "tuned")
+    rc = main(
+        [
+            "finetune", "--checkpoint", f"{models_dir}/mlp",
+            "--epochs", "3", "--learning-rate", "1e-3",
+            "--output", out_ckpt,
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert 0.0 <= out["accuracy_before"] <= 1.0
+    assert 0.0 <= out["accuracy_after"] <= 1.0
+    # warm-started adaptation on the same distribution must not
+    # collapse the model
+    assert out["accuracy_after"] >= out["accuracy_before"] - 0.05
+    from har_tpu.checkpoint import load_model_meta
+
+    assert load_model_meta(out_ckpt)["dataset"] == "synthetic"
+
+
+def test_label_range_guard(pretrained):
+    ckpt, model, raw = pretrained
+    bad = FeatureSet(
+        features=raw.windows[:32],
+        label=np.full(32, model.num_classes, np.int32),  # out of range
+    )
+    with pytest.raises(ValueError, match="classes"):
+        fine_tune(ckpt, bad, TrainerConfig(batch_size=32, epochs=1))
+
+
+def test_checkpoint_slot_distinguishes_warm_starts():
+    """Warm starts and freeze masks must key their own checkpoint slots
+    — identical shapes/config would otherwise cross-resume."""
+    from har_tpu.models.neural import MLP
+    from har_tpu.train.trainer import TrainerConfig, _run_fingerprint
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 13)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    cfg = TrainerConfig(batch_size=32, epochs=2)
+    module = MLP(num_classes=4, hidden=(8,))
+
+    scratch = _run_fingerprint(cfg, x, y, module)
+    warm_a = _run_fingerprint(cfg, x, y, module, warm_start_digest="a")
+    warm_b = _run_fingerprint(cfg, x, y, module, warm_start_digest="b")
+    frozen = _run_fingerprint(
+        cfg, x, y, module, warm_start_digest="a",
+        optimizer_tag="freeze:['ConvBlock_0']",
+    )
+    assert len({scratch, warm_a, warm_b, frozen}) == 4
+
+
+def test_architecture_mismatch_fails_loudly(pretrained, tmp_path):
+    ckpt, model, raw = pretrained
+    # a checkpoint with different widths cannot warm-start this module
+    other = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, seed=0),
+        model_kwargs={"channels": (16, 16)},
+    ).fit(
+        FeatureSet(
+            features=raw.windows[:128],
+            label=raw.labels[:128].astype(np.int32),
+        )
+    )
+    from har_tpu.train.trainer import Trainer
+
+    with pytest.raises(AssertionError):
+        Trainer(
+            model.inner.module,
+            TrainerConfig(batch_size=64, epochs=1),
+        ).fit(
+            raw.windows[:128],
+            raw.labels[:128].astype(np.int32),
+            num_classes=model.num_classes,
+            init_params=other.inner.params,
+        )
