@@ -123,8 +123,13 @@ def export_model(
             **{f"w{i}": w for i, w in enumerate(weights)},
         )
     else:
-        inner = getattr(model, "inner", model)
-        predict = _predict_fn(inner.module, inner.params, scaler)
+        if hasattr(model, "predict_fn"):
+            # models that own their predict (e.g. a calibrated wrapper
+            # baking its temperature into the softmax)
+            predict = model.predict_fn()
+        else:
+            inner = getattr(model, "inner", model)
+            predict = _predict_fn(inner.module, inner.params, scaler)
         exported = jax_export.export(jax.jit(predict), platforms=platforms)(
             spec
         )

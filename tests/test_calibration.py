@@ -1,0 +1,178 @@
+"""Confidence calibration (har_tpu.ops.calibration).
+
+Contracts: ECE is ~0 for perfectly calibrated synthetic probabilities
+and large for overconfident ones; temperature scaling recovers a known
+ground-truth T, never changes predictions, and reduces ECE on a real
+overconfident model.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.ops.calibration import (
+    TemperatureScaledModel,
+    calibrate,
+    expected_calibration_error,
+    fit_temperature,
+)
+
+
+def _synthetic_calibrated(n=20_000, classes=4, seed=0):
+    """Labels drawn FROM the predicted distribution → calibrated."""
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(n, classes)) * 1.5
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs = e / e.sum(axis=1, keepdims=True)
+    labels = np.array(
+        [rng.choice(classes, p=p) for p in probs], np.int32
+    )
+    return logits.astype(np.float32), probs, labels
+
+
+def test_ece_near_zero_when_calibrated():
+    _, probs, labels = _synthetic_calibrated()
+    report = expected_calibration_error(probs, labels)
+    assert report["ece"] < 0.02
+    assert report["bin_count"].sum() == len(labels)
+
+
+def test_ece_large_when_overconfident():
+    logits, _, labels = _synthetic_calibrated()
+    sharp = np.exp(logits * 4.0)
+    sharp /= sharp.sum(axis=1, keepdims=True)
+    assert expected_calibration_error(sharp, labels)["ece"] > 0.15
+
+
+def test_fit_temperature_recovers_ground_truth():
+    logits, _, labels = _synthetic_calibrated()
+    # logits were sharpened 4x → the correcting temperature is ~4
+    t = fit_temperature(logits * 4.0, labels)
+    assert 3.3 < t < 4.8, t
+    # already-calibrated logits need T ~ 1
+    t1 = fit_temperature(logits, labels)
+    assert 0.8 < t1 < 1.25, t1
+
+
+class _OverconfidentModel:
+    num_classes = 4
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def transform(self, data):
+        from har_tpu.models.base import Predictions
+
+        e = np.exp(self.logits - self.logits.max(axis=1, keepdims=True))
+        return Predictions.from_raw(
+            self.logits, e / e.sum(axis=1, keepdims=True)
+        )
+
+
+def test_calibrate_improves_ece_and_keeps_predictions():
+    logits, _, labels = _synthetic_calibrated(n=8000)
+
+    class _Set:
+        pass
+
+    data = _Set()
+    data.features = np.zeros((len(labels), 1), np.float32)
+    data.label = labels
+    model = _OverconfidentModel((logits * 5.0).astype(np.float32))
+
+    scaled, report = calibrate(model, data)
+    assert report["ece_after"] < report["ece_before"] - 0.1
+    assert report["temperature"] > 3.0
+    # temperature scaling cannot move the argmax
+    np.testing.assert_array_equal(
+        scaled.transform(data).prediction,
+        model.transform(data).prediction,
+    )
+    assert isinstance(scaled, TemperatureScaledModel)
+    assert scaled.num_classes == 4
+
+
+def test_calibrate_rejects_vote_probability_models():
+    """Forest-style models put vote fractions in raw — softmax over
+    [0,1] values is not calibration and must be refused."""
+    _, probs, labels = _synthetic_calibrated(n=500)
+
+    class _Votes:
+        num_classes = 4
+
+        def transform(self, data):
+            from har_tpu.models.base import Predictions
+
+            return Predictions.from_raw(probs, probs)
+
+    class _Set:
+        features = np.zeros((len(labels), 1), np.float32)
+        label = labels
+
+    with pytest.raises(ValueError, match="votes"):
+        calibrate(_Votes(), _Set())
+
+
+def test_calibrated_model_exports(tmp_path):
+    """The calibrated wrapper exports: T bakes into the artifact's
+    softmax, logits stay raw."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.export import export_model, load_exported
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=128, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=2, learning_rate=2e-3,
+                             seed=0),
+        model_kwargs={"channels": (16,)},
+    ).fit(FeatureSet(features=raw.windows, label=raw.labels.astype(np.int32)))
+    scaled = TemperatureScaledModel(model, 2.5)
+
+    pred = load_exported(export_model(scaled, str(tmp_path / "art")))
+    logits, probs = pred.predict(raw.windows[:8])
+    live = scaled.transform(raw.windows[:8])
+    np.testing.assert_allclose(logits, live.raw, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        probs, live.probability, rtol=1e-5, atol=1e-6
+    )
+    # calibrated probs differ from the base model's (T=2.5 flattens)
+    assert not np.allclose(
+        probs, model.transform(raw.windows[:8]).probability, atol=1e-3
+    )
+
+
+def test_calibrated_real_model_end_to_end():
+    """Train a small CNN, calibrate on held-out windows, serve the
+    calibrated model through the streaming path unchanged."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.serving import StreamingClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=512, seed=0)
+    split = 384
+    train = FeatureSet(
+        features=raw.windows[:split],
+        label=raw.labels[:split].astype(np.int32),
+    )
+    held = FeatureSet(
+        features=raw.windows[split:],
+        label=raw.labels[split:].astype(np.int32),
+    )
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=128, epochs=10,
+                             learning_rate=2e-3, seed=0),
+        model_kwargs={"channels": (32, 32)},
+    ).fit(train)
+
+    scaled, report = calibrate(model, held)
+    assert report["ece_after"] <= report["ece_before"] + 1e-6
+    events = StreamingClassifier(
+        scaled, window=200, hop=200, smoothing="none"
+    ).push(raw.windows[:4].reshape(-1, 3))
+    assert len(events) == 4
+    assert all(abs(e.probability.sum() - 1.0) < 1e-5 for e in events)
