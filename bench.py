@@ -783,7 +783,12 @@ def main() -> None:
             sc = StreamingClassifier(
                 cal_model, window=200, hop=200, smoothing="none"
             )
-            sc.push(cal.windows[:n_hops].reshape(-1, 3))
+            rec = cal.windows[:n_hops].reshape(-1, 3)
+            # hop-sized pushes: this lane measures the LIVE per-hop
+            # dispatch latency (one big push would batch into a single
+            # predict — that's the replay path, not the serving floor)
+            for i in range(0, len(rec), 200):
+                sc.push(rec[i : i + 200])
             serving_latency = sc.latency_stats()
             serving_latency["n_hops"] = n_hops
         except Exception as exc:

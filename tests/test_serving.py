@@ -94,57 +94,50 @@ def test_offline_equals_online():
     )
 
 
-def test_ema_smoothing_suppresses_single_flip():
-    class Flipper:
-        """Confident class 0 except one outlier window."""
+class _ContentLabeler:
+    """Batch-safe stub: a window whose mean exceeds 0.5 is class 1 at
+    0.9 confidence, else class 0 — content-keyed, so batched and
+    hop-by-hop scoring see identical inputs."""
 
-        num_classes = 2
+    num_classes = 2
 
-        def __init__(self):
-            self.calls = 0
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
 
-        def transform(self, x):
-            from har_tpu.models.base import Predictions
+        hot = np.asarray(x).mean(axis=(1, 2)) > 0.5
+        p = np.where(hot[:, None], [[0.1, 0.9]], [[0.9, 0.1]])
+        return Predictions.from_raw(np.log(p), p)
 
-            self.calls += 1
-            p = np.array([[0.9, 0.1]] if self.calls != 5 else [[0.2, 0.8]])
-            return Predictions.from_raw(np.log(p), p)
 
-    sc = StreamingClassifier(
-        Flipper(), window=10, hop=10, smoothing="ema", ema_alpha=0.4
+def _segmented_recording(labels, hop=10, channels=3):
+    """One hop-length constant segment per requested raw label."""
+    return np.concatenate(
+        [np.full((hop, channels), float(lab), np.float32) for lab in labels]
     )
-    events = sc.push(_recording(100))
+
+
+def test_ema_smoothing_suppresses_single_flip():
+    # ten windows, only the fifth is class 1
+    rec = _segmented_recording([0, 0, 0, 0, 1, 0, 0, 0, 0, 0])
+    sc = StreamingClassifier(
+        _ContentLabeler(), window=10, hop=10, smoothing="ema",
+        ema_alpha=0.4,
+    )
+    events = sc.push(rec)
     assert len(events) == 10
     assert events[4].raw_label == 1  # the outlier window itself
     assert all(e.label == 0 for e in events)  # smoothed decision holds
 
 
 def test_vote_smoothing_and_tiebreak():
-    class Seq:
-        num_classes = 2
-
-        def __init__(self, labels):
-            self.labels = list(labels)
-            self.i = 0
-
-        def transform(self, x):
-            from har_tpu.models.base import Predictions
-
-            lab = self.labels[self.i]
-            self.i += 1
-            p = np.zeros((1, 2))
-            p[0, lab] = 0.9
-            p[0, 1 - lab] = 0.1
-            return Predictions.from_raw(np.log(p), p)
-
     sc = StreamingClassifier(
-        Seq([0, 1, 1, 0, 1]),
+        _ContentLabeler(),
         window=10,
         hop=10,
         smoothing="vote",
         vote_depth=3,
     )
-    events = sc.push(_recording(50))
+    events = sc.push(_segmented_recording([0, 1, 1, 0, 1]))
     # votes over the trailing 3: [0]->0, [0,1]->tie->newest=1, [0,1,1]->1,
     # [1,1,0]->1, [1,0,1]->1
     assert [e.label for e in events] == [0, 1, 1, 1, 1]
@@ -161,10 +154,18 @@ def test_reset_and_latency_stats():
         _StubModel(), window=100, hop=100, smoothing="none"
     )
     assert sc.latency_stats() == {"count": 0}
-    sc.push(_recording(300))
+    # one push completing 3 windows = ONE batched predict (catch-up
+    # batching); events carry the amortized per-window share
+    events = sc.push(_recording(300))
+    assert len(events) == 3
     stats = sc.latency_stats()
-    assert stats["count"] == 3
+    assert stats["count"] == 1
     assert stats["p50_ms"] >= 0
+    assert all(e.latency_ms <= stats["max_ms"] + 1e-9 for e in events)
+    # hop-by-hop pushes sample one predict per hop (the live cadence)
+    sc.push(_recording(100))
+    sc.push(_recording(100))
+    assert sc.latency_stats()["count"] == 3
     sc.reset()
     assert sc.latency_stats() == {"count": 0}
     # after reset the schedule restarts at t=window
