@@ -463,7 +463,9 @@ def main(argv=None) -> int:
                 for c in (0, 1, 0)
             ]
             rec = np.concatenate(thirds)
-        events = sc.push(rec)
+        # live cadence + device-vs-tunnel latency split: see
+        # StreamingClassifier.replay
+        events = sc.replay(rec)
         if args.events_csv:
             import csv as _csv
 

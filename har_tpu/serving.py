@@ -179,6 +179,10 @@ class StreamingClassifier:
         self._ema: np.ndarray | None = None
         self._votes: deque[int] = deque(maxlen=self.vote_depth)
         self._latencies: list[float] = []
+        # device-only calibration results keyed by batch size; survives
+        # reset() would be wrong — a restarted stream may follow a
+        # checkpoint swap, so measurements restart with the session
+        self._device_ms: dict[int, dict] = {}
         self._drift_report = None
         if getattr(self, "monitor", None) is not None:
             self.monitor.reset()
@@ -322,15 +326,106 @@ class StreamingClassifier:
             drift=drift,
         )
 
+    def replay(
+        self, samples: np.ndarray, *, calibrate: bool = True
+    ) -> list[StreamEvent]:
+        """Replay a recording at the LIVE cadence: hop-sized pushes, one
+        dispatch per hop, so ``latency_stats()`` afterwards is the
+        per-hop serving floor (a single whole-recording ``push`` batches
+        into one dispatch and measures replay throughput instead — that
+        path is ``classify_session``).  With ``calibrate``, runs the
+        batch-1 ``device_latency_ms`` measurement afterwards (skipped
+        silently for models without a jitted predict) so the stats also
+        separate device compute from host/transfer/tunnel overhead.
+        Events are identical to any other chunking of the same samples.
+        """
+        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        events: list[StreamEvent] = []
+        for start in range(0, len(samples), self.hop):
+            events.extend(self.push(samples[start : start + self.hop]))
+        if calibrate:
+            try:
+                self.device_latency_ms(batch=1)
+            except ValueError:
+                pass
+        return events
+
     # ---------------------------------------------------------- reporting
 
+    def device_latency_ms(self, batch: int = 1, iters: int = 16) -> dict:
+        """Measure DEVICE execution time for the compiled predict.
+
+        Runs the inner jitted apply on a device-resident ``(batch,
+        window, channels)`` input with ``block_until_ready`` — no host
+        numpy staging, no scaler, no result fetch — so the number is
+        dispatch + device compute only.  The gap between this and the
+        e2e ``latency_stats()`` percentiles is host/transfer/tunnel
+        overhead, which dominates through a remote-tunnel device (e2e
+        ~250 ms/hop vs sub-ms device compute in BENCH_r04's serving
+        lane) and is what a co-located deployment would shed.
+
+        The result is cached per batch size and folded into
+        ``latency_stats()`` as ``device_p50_ms`` / ``host_overhead_p50_ms``.
+        Raises ValueError for models without a jitted predict (trees,
+        MLlib replicas) — their transform has no single device program
+        to time.
+        """
+        # unwrap to the jitted NeuralModel through any wrapper chain:
+        # NeuralClassifierModel's ``.inner``, TemperatureScaledModel's
+        # ``.model`` — the device program is the same base forward either
+        # way (temperature/scaler are host-side)
+        inner = self.model
+        for _ in range(4):
+            if hasattr(inner, "_predict") and hasattr(inner, "params"):
+                break
+            nxt = getattr(inner, "inner", None)
+            if nxt is None:
+                nxt = getattr(inner, "model", None)
+            if nxt is None:
+                break
+            inner = nxt
+        if not (hasattr(inner, "_predict") and hasattr(inner, "params")):
+            raise ValueError(
+                "device timing needs a NeuralModel-backed classifier "
+                f"(got {type(self.model).__name__}); e2e latency_stats() "
+                "is still available"
+            )
+        import jax.numpy as jnp
+
+        x = jnp.zeros((batch, self.window, self.channels), jnp.float32)
+        inner._predict(inner.params, x).block_until_ready()  # warm
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            inner._predict(inner.params, x).block_until_ready()
+            times.append((time.perf_counter() - t0) * 1e3)
+        result = {
+            "batch": batch,
+            "iters": iters,
+            "p50_ms": round(_percentile(times, 50), 3),
+            "min_ms": round(min(times), 3),
+        }
+        self._device_ms[batch] = result
+        return result
+
     def latency_stats(self) -> dict:
-        """Per-PREDICT wall-clock distribution (ms) since reset().
+        """Per-PREDICT end-to-end wall-clock distribution (ms) since
+        reset().
 
         One sample per dispatched batch: a live hop-by-hop stream gets
         one sample per hop, while a burst/replay push contributes one
         sample per batched predict (events carry the amortized
-        per-window share in ``latency_ms``)."""
+        per-window share in ``latency_ms``).
+
+        Contract: ``steady_p50_ms`` is ``None`` when there is no
+        post-compilation evidence (a cold session that dispatched only
+        once) — consumers must treat it as optional, never as 0.  All
+        ``*_ms`` keys are e2e (host staging + transfer + device +
+        fetch); after a ``device_latency_ms()`` calibration the dict
+        also carries ``device_p50_ms`` (device dispatch+compute only)
+        and ``host_overhead_p50_ms`` (steady e2e minus device — the
+        transfer/tunnel share a co-located deployment would shed).
+        """
         if not self._latencies:
             return {"count": 0}
         lat = self._latencies
@@ -338,7 +433,7 @@ class StreamingClassifier:
         # first session pays it, and with a single (cold) sample there is
         # no steady evidence at all — report None, not the compile time
         steady = lat[1:] if self._session_starts_cold else lat
-        return {
+        stats = {
             "count": len(lat),
             "p50_ms": round(_percentile(lat, 50), 3),
             "p95_ms": round(_percentile(lat, 95), 3),
@@ -347,6 +442,22 @@ class StreamingClassifier:
                 round(_percentile(steady, 50), 3) if steady else None
             ),
         }
+        dev = self._device_ms.get(1) or next(
+            iter(self._device_ms.values()), None
+        )
+        if dev is not None:
+            stats["device_p50_ms"] = dev["p50_ms"]
+            stats["device_batch"] = dev["batch"]
+            e2e_ref = stats["steady_p50_ms"]
+            # the overhead subtraction is only meaningful against a
+            # batch-1 calibration (hops dispatch single windows) — a
+            # batch-k device time against per-hop e2e would understate
+            # or zero-clamp the published overhead
+            if e2e_ref is not None and dev["batch"] == 1:
+                stats["host_overhead_p50_ms"] = round(
+                    max(0.0, e2e_ref - dev["p50_ms"]), 3
+                )
+        return stats
 
     @property
     def drift_report(self):

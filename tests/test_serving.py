@@ -315,7 +315,13 @@ def test_real_model_end_to_end():
         smoothing="none",
         class_names=raw.class_names,
     )
-    events = sc.push(rec)
+    # hop-sized pushes: one dispatch per window, the live-stream cadence
+    # (a single whole-recording push would batch into one dispatch and
+    # leave no steady-state latency evidence — pinned separately in
+    # test_single_cold_sample_has_no_steady_latency)
+    events = []
+    for start in range(0, len(rec), 200):
+        events.extend(sc.push(rec[start : start + 200]))
     assert len(events) == 9
     # interior windows (not straddling an activity change) must classify
     # to their segment's class
@@ -324,7 +330,100 @@ def test_real_model_end_to_end():
     assert labels[3] == 1 and labels[4] == 1
     assert labels[7] == 0 and labels[8] == 0
     assert sc.label_name(events[0].label) == raw.class_names[0]
-    # the compiled predict is reused: steady latency well under the
-    # first (compiling) call
+    # the compiled predict is reused: 9 hop dispatches, and the steady
+    # (post-compile) median bounded by the worst (compiling) call
     stats = sc.latency_stats()
+    assert stats["count"] == 9
+    assert stats["steady_p50_ms"] is not None
     assert stats["steady_p50_ms"] <= stats["max_ms"]
+    # device-only calibration separates compute from transfer/tunnel:
+    # device execution can never exceed the steady e2e hop time
+    dev = sc.device_latency_ms(batch=1)
+    stats = sc.latency_stats()
+    assert stats["device_p50_ms"] == dev["p50_ms"]
+    # (loose margin: both medians are sub-ms on CPU, so allow noise)
+    assert stats["device_p50_ms"] <= stats["steady_p50_ms"] * 1.5 + 0.5
+    assert (
+        stats["host_overhead_p50_ms"]
+        == round(max(0.0, stats["steady_p50_ms"] - dev["p50_ms"]), 3)
+    )
+
+
+def test_replay_helper_matches_chunked_pushes():
+    """StreamingClassifier.replay = hop-sized pushes + batch-1 device
+    calibration: events identical to manual chunking, stats carry the
+    batch-1 decomposition keys (host_overhead only for batch-1 — a
+    batch-k calibration must not be subtracted from per-hop e2e)."""
+    model = _StubModel()
+    a = StreamingClassifier(model, window=100, hop=50, smoothing="none")
+    b = StreamingClassifier(model, window=100, hop=50, smoothing="none")
+    rec = np.random.default_rng(0).normal(size=(400, 3)).astype(np.float32)
+
+    ev_a = a.replay(rec, calibrate=False)  # _StubModel has no jit path
+    ev_b = []
+    for i in range(0, len(rec), 50):
+        ev_b.extend(b.push(rec[i : i + 50]))
+    assert [e.t_index for e in ev_a] == [e.t_index for e in ev_b]
+    assert [e.label for e in ev_a] == [e.label for e in ev_b]
+    assert a.latency_stats()["count"] == len(ev_a)
+
+    # non-NeuralModel: calibrate=True silently skips (no device program)
+    a.replay(rec, calibrate=True)
+    assert "device_p50_ms" not in a.latency_stats()
+
+
+def test_batch_mismatched_calibration_not_subtracted():
+    """A batch!=1 device calibration reports device_p50_ms + its batch
+    but never host_overhead_p50_ms (apples-to-oranges vs per-hop e2e)."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, learning_rate=1e-3,
+                             seed=0),
+        model_kwargs={"channels": (8,)},
+    ).fit(FeatureSet(features=raw.windows,
+                     label=raw.labels.astype(np.int32)))
+    sc = StreamingClassifier(model, window=200, hop=200, smoothing="none")
+    sc.replay(raw.windows[:4].reshape(-1, 3), calibrate=False)
+    sc.device_latency_ms(batch=4)
+    stats = sc.latency_stats()
+    assert stats["device_batch"] == 4
+    assert "device_p50_ms" in stats
+    assert "host_overhead_p50_ms" not in stats
+    # a batch-1 calibration restores the decomposition
+    sc.device_latency_ms(batch=1)
+    stats = sc.latency_stats()
+    assert stats["device_batch"] == 1
+    assert "host_overhead_p50_ms" in stats
+
+
+def test_device_timing_unwraps_calibrated_wrapper():
+    """A TemperatureScaledModel-wrapped neural model still yields the
+    device/host-overhead split (unwrap follows .model/.inner chains)."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.ops.calibration import TemperatureScaledModel
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    base = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, learning_rate=1e-3,
+                             seed=0),
+        model_kwargs={"channels": (8,)},
+    ).fit(FeatureSet(features=raw.windows,
+                     label=raw.labels.astype(np.int32)))
+    sc = StreamingClassifier(
+        TemperatureScaledModel(model=base, temperature=1.7),
+        window=200, hop=200, smoothing="none",
+    )
+    sc.replay(raw.windows[:4].reshape(-1, 3))
+    stats = sc.latency_stats()
+    assert stats["device_batch"] == 1
+    assert "host_overhead_p50_ms" in stats
