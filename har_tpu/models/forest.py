@@ -160,7 +160,9 @@ class RandomForestClassifier:
             min_instances=self.min_instances_per_node,
             features_per_split=self._features_per_split(x.shape[1]),
             num_trees=self.num_trees,
-            use_pallas_hist=auto_pallas_hist(self.use_pallas_hist),
+            use_pallas_hist=auto_pallas_hist(
+                self.use_pallas_hist, self.max_bins
+            ),
         )
         return RandomForestModel(
             feature=np.asarray(feature),

@@ -49,20 +49,28 @@ def _hist_bench_prefers_pallas() -> bool | None:
     return policy.startswith("pallas") if policy else None
 
 
-def auto_pallas_hist(flag: bool | None) -> bool:
+def auto_pallas_hist(flag: bool | None, max_bins: int = 32) -> bool:
     """Resolve a use_pallas_hist tri-state to a concrete choice.
 
-    Explicit True/False wins.  Auto (None) consults the measured
-    comparison in artifacts/hist_bench.json (scripts/hist_bench.py,
-    VERDICT r3 #6b: "a kernel nobody measures is a liability"); off-TPU
-    the kernel would run in interpret mode, so auto is always False
-    there.  No evidence → matmul: the committed measurement has the
-    kernel losing 0.96-0.98x and failing to compile on one workload, so
-    the safe default and the measured default coincide.
+    Explicit True/False wins (an explicit True outside the kernel's
+    validated envelope then fails loudly in hist_matmul).  Auto (None)
+    consults the measured comparison in artifacts/hist_bench.json
+    (scripts/hist_bench.py, VERDICT r3 #6b: "a kernel nobody measures is
+    a liability") — and never selects the kernel beyond its validated
+    ``MAX_BINS_SUPPORTED`` envelope, where larger bin counts exceed the
+    per-tile VMEM budget (the bins=128 workload crashed the TPU
+    compiler; see pallas_hist.py).  Off-TPU the kernel would run in
+    interpret mode, so auto is always False there.  No evidence →
+    matmul: the committed measurement has the kernel losing 0.96-0.98x,
+    so the safe default and the measured default coincide.
     """
     if flag is not None:
         return flag
     if jax.default_backend() != "tpu":
+        return False
+    from har_tpu.ops.pallas_hist import MAX_BINS_SUPPORTED
+
+    if max_bins > MAX_BINS_SUPPORTED:
         return False
     return _hist_bench_prefers_pallas() is True
 
@@ -430,7 +438,9 @@ class DecisionTreeClassifier:
             max_depth=self.max_depth,
             max_bins=self.max_bins,
             min_instances=self.min_instances_per_node,
-            use_pallas_hist=auto_pallas_hist(self.use_pallas_hist),
+            use_pallas_hist=auto_pallas_hist(
+                self.use_pallas_hist, self.max_bins
+            ),
         )
         return DecisionTreeModel(
             tree=TreeArrays(

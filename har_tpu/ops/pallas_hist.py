@@ -38,6 +38,16 @@ from jax.experimental.pallas import tpu as pltpu
 _DT = 128
 _NT = 256
 
+# Validated max_bins envelope.  The kernel's per-grid-step VMEM
+# footprint scales with _NT·_DT·max_bins·4 B (the expanded indicator and
+# its compare operands): ~4 MB per temporary at B=32 — measured working
+# — but ~17 MB at B=128, past a TPU core's ~16 MB VMEM, where the
+# Mosaic compile faults the toolchain (artifacts/hist_bench.json,
+# workload dt_numeric13_depth6_bins128: "tpu_compile_helper subprocess
+# exit code 1").  Shapes beyond the measured-good envelope are rejected
+# host-side with a clean error instead of a compiler crash.
+MAX_BINS_SUPPORTED = 32
+
 
 def _hist_kernel(bins_ref, m_ref, out_ref, *, max_bins: int):
     i = pl.program_id(1)  # row-tile index (accumulation axis)
@@ -106,7 +116,22 @@ def hist_matmul(bins: jax.Array, m: jax.Array, max_bins: int) -> jax.Array:
     bins: (n, d) int32 bin ids in [0, max_bins); m: (n, WC) f32 row
     statistics.  Returns (WC, d·max_bins) f32 — identical (up to f32
     summation order) to the XLA one-hot matmul in tree.py.
+
+    Raises ValueError for max_bins > MAX_BINS_SUPPORTED (uniformly, on
+    every backend — CPU interpret mode would "work", but a shape that
+    crash-compiles on the target hardware must not pass tests
+    elsewhere).
     """
+    if max_bins > MAX_BINS_SUPPORTED:
+        raise ValueError(
+            f"pallas hist kernel supports max_bins <= "
+            f"{MAX_BINS_SUPPORTED} (got {max_bins}): larger bin counts "
+            "exceed the kernel's per-tile VMEM budget and fault the TPU "
+            "compiler (measured: artifacts/hist_bench.json, "
+            "dt_numeric13_depth6_bins128).  Use the XLA one-hot matmul "
+            "path (use_pallas_hist=False, the default auto policy) for "
+            "this shape."
+        )
     n, d = bins.shape
     d_pad = -(-d // _DT) * _DT
     n_pad = -(-n // _NT) * _NT

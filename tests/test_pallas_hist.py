@@ -70,3 +70,55 @@ def test_tree_pallas_hist_matches_xla_path():
     np.testing.assert_allclose(
         m_xla.tree.leaf_probs, m_pal.tree.leaf_probs, rtol=1e-5, atol=1e-7
     )
+
+
+def test_oversized_bins_fenced_host_side():
+    """The measured-failing envelope (artifacts/hist_bench.json:
+    dt_numeric13_depth6_bins128 crashed the TPU compiler) must be a
+    clean host-side ValueError, never a toolchain fault — on every
+    backend, so CPU tests catch it too."""
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.tree import DecisionTreeClassifier
+    from har_tpu.ops.pallas_hist import MAX_BINS_SUPPORTED
+
+    bins = np.zeros((64, 13), np.int32)
+    m = np.ones((64, 4), np.float32)
+    with pytest.raises(ValueError, match="hist_bench"):
+        hist_matmul(jnp.asarray(bins), jnp.asarray(m), 128)
+
+    # the boundary itself still works
+    out = hist_matmul(
+        jnp.asarray(bins), jnp.asarray(m), MAX_BINS_SUPPORTED
+    )
+    assert out.shape == (4, 13 * MAX_BINS_SUPPORTED)
+
+    # and the estimator surface reproducing the crashed workload
+    # (numeric features, bins=128, depth 6) errors cleanly at fit()
+    rng = np.random.default_rng(0)
+    data = FeatureSet(
+        features=rng.normal(size=(128, 13)).astype(np.float32),
+        label=(rng.random(128) > 0.5).astype(np.int32),
+    )
+    est = DecisionTreeClassifier(
+        max_depth=6, max_bins=128, use_pallas_hist=True
+    )
+    with pytest.raises(ValueError, match="max_bins"):
+        est.fit(data)
+
+
+def test_auto_policy_respects_bins_envelope(monkeypatch):
+    """Auto mode must fall back to the matmul path (not raise) for bin
+    counts beyond the kernel's validated envelope, even on a TPU whose
+    hist_bench verdict prefers pallas."""
+    import har_tpu.models.tree as tree_mod
+
+    monkeypatch.setattr(tree_mod.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        tree_mod, "_hist_bench_prefers_pallas", lambda: True
+    )
+    assert tree_mod.auto_pallas_hist(None, 32) is True
+    assert tree_mod.auto_pallas_hist(None, 64) is False
+    assert tree_mod.auto_pallas_hist(None, 128) is False
+    # explicit choice still wins (and fails loudly later in hist_matmul)
+    assert tree_mod.auto_pallas_hist(True, 128) is True
+    assert tree_mod.auto_pallas_hist(False, 32) is False
