@@ -21,10 +21,15 @@ schedule) is elementwise, so slicing the flattened vector computes the
 IDENTICAL result to the replicated trainer — pinned by test against
 ``Trainer`` on the same schedule.
 
-Scope: the scanned core fit (whole run = one compiled program, like
-``trainer.make_scan_fit``).  Augmentation/class-weights/early-stop live
-in the full ``Trainer``; ZeRO-1 is about where optimizer state LIVES,
-and composes with those features in the same way when needed.
+ZeRO-1 is about where optimizer state LIVES, not a separate trainer:
+``train.Trainer(..., zero1=True)`` swaps its scanned fit for this one
+and every other Trainer feature (augmentation, class weights, early
+stopping, periodic checkpointing + resume) composes unchanged — the
+step here mirrors ``make_scan_fit``'s per-step semantics (rng folds,
+augment key, weighted loss) exactly, so the fitted params match the
+replicated trainer to float tolerance feature-for-feature.
+``Zero1Trainer`` remains as the thin historical surface and now
+delegates to ``Trainer``.
 """
 
 from __future__ import annotations
@@ -47,7 +52,14 @@ from har_tpu.parallel.mesh import (
 )
 
 
-def make_zero1_fit(apply_fn, optimizer, mesh: Mesh, params_template):
+def make_zero1_fit(
+    apply_fn,
+    optimizer,
+    mesh: Mesh,
+    params_template,
+    augment=None,
+    class_weights=None,  # (C,) per-class loss weights
+):
     """(fit, init_opt_state) for a ZeRO-1 scanned training run.
 
     ``fit(params, opt_state, rng, x, y, batch_idx, step0)`` mirrors
@@ -57,6 +69,11 @@ def make_zero1_fit(apply_fn, optimizer, mesh: Mesh, params_template):
     comes from ``init_opt_state()``: optimizer state over the padded
     flattened parameter vector, leading axis sharded over the mesh's
     data axes.
+
+    ``augment``/``class_weights`` follow make_scan_fit exactly — same
+    per-step rng folds (augment key one fold past dropout's), same
+    weighted loss — so a zero1 fit is math-identical to the replicated
+    one feature-for-feature.
     """
     flat0, unravel = ravel_pytree(params_template)
     d = int(flat0.size)
@@ -95,6 +112,15 @@ def make_zero1_fit(apply_fn, optimizer, mesh: Mesh, params_template):
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(rng, step_i), shard
             )
+            if augment is not None:
+                # same decorrelation convention as make_scan_fit: the
+                # augmentation key is one fold past the dropout key
+                xb = augment(jax.random.fold_in(step_rng, 1), xb)
+
+            if class_weights is not None:
+                wb = class_weights[yb]
+            else:
+                wb = jnp.ones((yb.shape[0],), jnp.float32)
 
             def local_sum(p):
                 logits = apply_fn(
@@ -104,9 +130,7 @@ def make_zero1_fit(apply_fn, optimizer, mesh: Mesh, params_template):
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, yb
                 )
-                return jnp.sum(ce), jnp.asarray(
-                    yb.shape[0], jnp.float32
-                )
+                return jnp.sum(ce * wb), jnp.sum(wb)
 
             (loss_sum, count), grads = jax.value_and_grad(
                 local_sum, has_aux=True
@@ -160,12 +184,12 @@ def make_zero1_fit(apply_fn, optimizer, mesh: Mesh, params_template):
 
 @dataclasses.dataclass
 class Zero1Trainer:
-    """Drop-in scanned trainer with ZeRO-1 optimizer-state sharding.
+    """Scanned trainer with ZeRO-1 optimizer-state sharding.
 
-    Same core contract as ``train.Trainer`` with ``scan=True`` (whole
-    run compiled as one program, identical batch schedule and optimizer,
-    so the fitted params match the replicated trainer to float
-    tolerance) — but the Adam state lives 1/N per data shard.
+    Thin historical surface over ``train.Trainer(..., zero1=True)`` —
+    the composed path, where augmentation, class weights, early stopping
+    and checkpoint/resume all work with the sharded optimizer state.
+    Prefer constructing ``Trainer`` directly.
     """
 
     module: Any
@@ -173,89 +197,17 @@ class Zero1Trainer:
     mesh: Mesh | None = None
 
     def fit(self, x, y, num_classes: int | None = None):
-        from har_tpu.train.trainer import (
-            NeuralModel,
-            TrainerConfig,
-            batch_iterator,
-            make_optimizer,
+        from har_tpu.train.trainer import Trainer
+
+        trainer = Trainer(
+            self.module,
+            self.config,
+            mesh=self.mesh or create_mesh(dp=-1),
+            scan=True,
+            zero1=True,
         )
-
-        cfg = self.config or TrainerConfig()
-        # fail loud on Trainer features this scanned core does not run —
-        # silently dropping fault-tolerance or early stopping would be a
-        # behavior divergence the caller cannot detect
-        unsupported = {
-            "checkpoint_dir": cfg.checkpoint_dir,
-            "save_every_epochs": cfg.save_every_epochs,
-            "early_stop_patience": cfg.early_stop_patience,
-            "class_weight": cfg.class_weight,
-            "log_every": cfg.log_every,
-            "compute_flops": cfg.compute_flops,
-        }
-        set_fields = [k for k, v in unsupported.items() if v]
-        if set_fields:
-            raise ValueError(
-                f"Zero1Trainer does not implement {set_fields}; use "
-                "train.Trainer for those features (ZeRO-1 covers the "
-                "scanned core fit)"
-            )
-        mesh = self.mesh or create_mesh(dp=-1)
-        x = np.asarray(x, np.float32)
-        y = np.asarray(y, np.int32)
-        n = len(x)
-        num_classes = num_classes or int(y.max()) + 1
-        dp = data_shard_count(mesh)
-        if cfg.batch_size % dp:
-            raise ValueError(
-                f"batch_size {cfg.batch_size} must be divisible by the "
-                f"data-parallel shard count ({dp})"
-            )
-        steps_per_epoch = max(1, -(-n // cfg.batch_size))
-        optimizer = make_optimizer(cfg, steps_per_epoch * cfg.epochs)
-
-        root = jax.random.PRNGKey(cfg.seed)
-        init_rng, step_rng = jax.random.split(root)
-        params = self.module.init(
-            init_rng, jnp.asarray(x[: min(2, n)]), train=False
-        )["params"]
-
-        fit, init_opt_state = make_zero1_fit(
-            self.module.apply, optimizer, mesh, params
-        )
-        host_rng = np.random.default_rng(cfg.seed)
-        batch_idx = np.stack(
-            [
-                idx
-                for _ in range(cfg.epochs)
-                for idx in batch_iterator(n, cfg.batch_size, host_rng)
-            ]
-        ).astype(np.int32)
-        import time
-
-        t0 = time.perf_counter()
-        params, opt_state, losses = fit(
-            params,
-            init_opt_state(),
-            step_rng,
-            jnp.asarray(x),
-            jnp.asarray(y),
-            jnp.asarray(batch_idx),
-            jnp.asarray(0, jnp.int32),
-        )
-        losses = np.asarray(losses)
-        train_time = time.perf_counter() - t0
-        history = {
-            # Trainer's convention: last step of each epoch
-            "loss": list(losses.reshape(-1, steps_per_epoch)[:, -1]),
-            "train_time_s": train_time,
-            "windows_per_sec": (
-                batch_idx.size / train_time if train_time > 0 else 0.0
-            ),
-            "zero1_shards": dp,
-        }
-        return NeuralModel(
-            module=self.module,
-            params=params,
+        return trainer.fit(
+            np.asarray(x, np.float32),
+            np.asarray(y, np.int32),
             num_classes=num_classes,
-            history=history,
         )

@@ -427,6 +427,7 @@ class Trainer:
         scan: bool = True,
         augment: Callable | None = None,
         optimizer_factory: Callable | None = None,
+        zero1: bool = False,
     ):
         self.module = module
         self.config = config or TrainerConfig()
@@ -442,6 +443,18 @@ class Trainer:
         # (e.g. transfer.fine_tune masks frozen subtrees) while keeping
         # the schedule derived from the actual step count.
         self.optimizer_factory = optimizer_factory
+        # zero1=True shards the optimizer state 1/N over the data axes
+        # (parallel.zero1) while keeping every other feature —
+        # augmentation, class weights, early stopping, checkpoint/resume
+        # — on the same code path; the fitted params equal the
+        # replicated run's to float tolerance (test-pinned)
+        self.zero1 = zero1
+        if zero1 and not scan:
+            raise ValueError(
+                "zero1=True requires scan=True: the sharded-optimizer "
+                "fit is a scanned program (the streaming path's "
+                "per-step host dispatch would dwarf the memory saving)"
+            )
 
     def _open_checkpointer(self, cfg, x, y, params):
         """One slot-derivation for every checkpointing path (chunked and
@@ -541,7 +554,16 @@ class Trainer:
                     self.optimizer_factory, "__qualname__", "custom"
                 ),
             )
-        opt_state = optimizer.init(params)
+        if self.zero1:
+            # zero1 snapshots carry a flattened sharded optimizer state —
+            # a different schema than the replicated tree, so the run
+            # must key its own checkpoint slot (set before
+            # _open_checkpointer derives the fingerprint)
+            self._optimizer_tag = f"zero1:{self._optimizer_tag or ''}"
+        # zero1's optimizer state is created by its fit factory (padded
+        # flattened vector, sharded over the data axes) in the scan
+        # branch below, not here
+        opt_state = None if self.zero1 else optimizer.init(params)
 
         host_rng = np.random.default_rng(cfg.seed)
         history: dict[str, Any] = {"loss": []}
@@ -576,6 +598,12 @@ class Trainer:
                 ]
             ).astype(np.int32)
             if tp > 1:
+                if self.zero1:
+                    raise ValueError(
+                        "zero1=True composes with data parallelism only "
+                        "— a tp>1 mesh already shards params (and GSPMD "
+                        "places the optimizer state with them)"
+                    )
                 # tensor parallelism: params sharded over tp, XLA inserts
                 # the collectives (GSPMD) — see har_tpu.parallel.tensor_parallel
                 from har_tpu.parallel.tensor_parallel import (
@@ -594,6 +622,20 @@ class Trainer:
                     augment=self.augment,
                     class_weights=class_weights,
                 )
+            elif self.zero1:
+                # same scanned contract, optimizer state sharded 1/N over
+                # the data axes; the step mirrors make_scan_fit's rng/
+                # augment/weighting exactly, so everything downstream
+                # (chunked checkpointing, early stop, flops) is unchanged
+                from har_tpu.parallel.zero1 import make_zero1_fit
+
+                fit, init_opt_state = make_zero1_fit(
+                    self.module.apply, optimizer, mesh, params,
+                    augment=self.augment,
+                    class_weights=class_weights,
+                )
+                opt_state = init_opt_state()
+                history["zero1_shards"] = dp
             else:
                 fit = make_scan_fit(
                     self.module.apply, optimizer, mesh,
