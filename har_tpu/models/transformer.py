@@ -144,12 +144,37 @@ class Transformer1D(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sp_axis: str | None = None
     use_flash: bool | None = None
+    # patch_size > 1 embeds non-overlapping patches with a strided conv
+    # (ViT-style) instead of the per-sample Dense: T drops by the patch
+    # factor BEFORE attention, cutting the (B, H, T, T) score traffic —
+    # the short-T lane's roofline limiter (docs/roofline.md: at T=200
+    # attention HBM traffic holds the encoder to ~21% steady MFU) — by
+    # patch².  kernel == stride, so a sequence-sharded input needs no
+    # halo exchange and the sp ring path works unchanged on patched
+    # sequences.
+    patch_size: int = 1
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         x = x.astype(self.dtype)
         b, t, _ = x.shape
-        x = nn.Dense(self.embed_dim, dtype=self.dtype, name="embed")(x)
+        if self.patch_size > 1:
+            if t % self.patch_size:
+                raise ValueError(
+                    f"sequence length {t} must be divisible by "
+                    f"patch_size {self.patch_size}"
+                )
+            x = nn.Conv(
+                self.embed_dim,
+                kernel_size=(self.patch_size,),
+                strides=(self.patch_size,),
+                padding="VALID",
+                dtype=self.dtype,
+                name="patch_embed",
+            )(x)
+            t = t // self.patch_size
+        else:
+            x = nn.Dense(self.embed_dim, dtype=self.dtype, name="embed")(x)
         if self.sp_axis is None:
             offset = 0.0
         else:  # global position = shard index × local block length

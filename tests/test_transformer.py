@@ -75,3 +75,76 @@ def test_registry_builds_transformer():
 
     m = build_model("transformer", num_classes=6, embed_dim=16, num_heads=2)
     assert isinstance(m, Transformer1D)
+
+
+def test_patch_embedding_shapes_and_guard():
+    """patch_size>1: strided-conv patch embed shrinks T before attention
+    (the short-T lane's roofline limiter, docs/roofline.md); indivisible
+    lengths error cleanly."""
+    model = Transformer1D(
+        num_classes=6, embed_dim=32, num_heads=4, num_layers=1,
+        dtype=jnp.float32, patch_size=4,
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(3, 64, 3)), jnp.float32
+    )
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    assert model.apply({"params": params}, x).shape == (3, 6)
+    # the conv kernel is (patch, C_in, E): per-patch linear, not Dense
+    assert params["patch_embed"]["kernel"].shape == (4, 3, 32)
+    with pytest.raises(ValueError, match="divisible"):
+        model.init(jax.random.PRNGKey(0), x[:, :62])
+
+
+def test_patch_embedding_sequence_parallel_matches():
+    """kernel == stride means no halo: a patched model runs unchanged on
+    the sequence-sharded ring and matches single-device output."""
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 64, 3)), jnp.float32
+    )
+    single = Transformer1D(
+        num_classes=6, embed_dim=32, num_heads=4, num_layers=1,
+        dtype=jnp.float32, patch_size=4,
+    )
+    params = single.init(jax.random.PRNGKey(0), x)["params"]
+    ref = single.apply({"params": params}, x)
+
+    mesh = create_mesh(dp=1, tp=8)
+    sp = Transformer1D(
+        num_classes=6, embed_dim=32, num_heads=4, num_layers=1,
+        dtype=jnp.float32, patch_size=4, sp_axis="tp",
+    )
+
+    def fwd(params, x):
+        return sp.apply({"params": params}, x)
+
+    f = jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P(None, "tp")), out_specs=P(),
+        check_vma=False,
+    )
+    out = jax.jit(f)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_patched_transformer_trains():
+    """The patched encoder still learns the synthetic activity classes."""
+    raw = synthetic_raw_stream(n_windows=512, seed=0)
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+
+    model = NeuralClassifier(
+        "transformer",
+        config=TrainerConfig(batch_size=128, epochs=8,
+                             learning_rate=2e-3, seed=0),
+        model_kwargs={
+            "embed_dim": 32, "num_heads": 4, "num_layers": 1,
+            "patch_size": 4,
+        },
+    ).fit(FeatureSet(features=raw.windows,
+                     label=raw.labels.astype(np.int32)))
+    preds = model.transform(raw.windows)
+    acc = (np.asarray(preds.prediction) == raw.labels).mean()
+    assert acc > 0.8
