@@ -202,3 +202,126 @@ def ucihar_parity_lane(root: str | None = None) -> dict:
         ),
         "reference_train_time_s": 271.196,  # paper Table 2, 70-30 LR+CV
     }
+
+
+def resolve_wisdm_raw() -> str | None:
+    """Locate a real ``WISDM_ar_v1.1_raw.txt``, or None.
+
+    Probes $HAR_TPU_WISDM_RAW (a file path) first, then conventional
+    data dirs.  The raw-accuracy lane (wisdm_raw_lane) keys off this:
+    the reference repo ships only the 46-feature summary table — the raw
+    20 Hz stream its transform consumed (/root/reference/Main/
+    main.py:22-26 drops the raw-derived bins) is NOT present and the
+    offline environment cannot fetch it, so the ≥97% claim stays
+    falsifiable-on-demand rather than runnable here.
+    """
+    env = os.environ.get("HAR_TPU_WISDM_RAW")
+    candidates = [
+        env,
+        "./WISDM_ar_v1.1_raw.txt",
+        "./data/WISDM_ar_v1.1_raw.txt",
+        os.path.expanduser("~/data/WISDM_ar_v1.1_raw.txt"),
+    ]
+    for cand in candidates:
+        if cand and os.path.isfile(cand):
+            return cand
+    return None
+
+
+def wisdm_raw_lane(
+    path: str | None = None,
+    *,
+    epochs: int = 40,
+    seed: int = 7,
+    batch_size: int = 1024,
+    channels: tuple = (128, 128, 128),
+    max_windows: int | None = None,
+) -> dict:
+    """The ≥97% north star, falsifiable on real raw data (VERDICT r4 #3).
+
+    The repo's accuracy story is: summary features cap at ~0.90 (GBDT;
+    artifacts/accuracy_ceiling_sweep.json) and ≥0.97 needs the raw 20 Hz
+    windows the reference dropped — measured so far only on the
+    statistics-calibrated synthetic stream (bench
+    ``raw_synthetic_accuracy`` = 0.979).  The moment a real
+    ``WISDM_ar_v1.1_raw.txt`` appears, this lane windows it with the
+    paper's protocol (200 samples @ 20 Hz per window, segmented
+    per-(user, activity) bout so no window straddles a change), trains
+    the bench CNN, and reports held-out accuracy against the 0.97
+    target; with no file it returns a skipped marker instead of a
+    vacuous synthetic number.
+    """
+    from har_tpu.data.raw_loader import load_raw_stream, stream_windows
+    from har_tpu.data.split import split_indices
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    target = 0.97
+    path = path if path is not None else resolve_wisdm_raw()
+    if path is None:
+        return {
+            "skipped": (
+                "no WISDM_ar_v1.1_raw.txt found — set "
+                "HAR_TPU_WISDM_RAW (or drop the file in ./data) to "
+                "measure the >=0.97 raw-window claim on real data"
+            ),
+            "target_accuracy": target,
+        }
+    stream = load_raw_stream(path)
+    data = stream_windows(stream, window=200)
+    if len(data.labels) < 100:
+        return {
+            "path": path,
+            "skipped": (
+                f"only {len(data.labels)} complete 200-sample windows — "
+                "too few to train/evaluate the claim"
+            ),
+            "target_accuracy": target,
+        }
+    n_total = int(len(data.labels))
+    if max_windows is not None and n_total > max_windows:
+        # deterministic subsample to bound training cost (the bench
+        # calls with a cap so a large real file cannot blow its budget;
+        # a standalone run measures the full set)
+        pick = np.random.default_rng(seed).choice(
+            n_total, size=max_windows, replace=False
+        )
+        data = dataclasses.replace(
+            data, windows=data.windows[pick], labels=data.labels[pick]
+        )
+    tr, te = split_indices(len(data.labels), [0.85, 0.15], seed=seed)
+    est = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(
+            batch_size=batch_size, epochs=epochs, learning_rate=2e-3,
+            seed=0,
+        ),
+        model_kwargs={"channels": tuple(channels)},
+    )
+    t0 = time.perf_counter()
+    model = est.fit(
+        FeatureSet(
+            features=data.windows[tr],
+            label=data.labels[tr].astype(np.int32),
+        )
+    )
+    train_time = time.perf_counter() - t0
+    m = evaluate(
+        data.labels[te].astype(np.int32),
+        model.transform(data.windows[te]).raw,
+        len(data.class_names),
+    )
+    acc = float(m["accuracy"])
+    return {
+        "path": path,
+        "n_windows": n_total,
+        "n_used": int(len(data.labels)),
+        "n_train": int(len(tr)),
+        "n_test": int(len(te)),
+        "accuracy": round(acc, 4),
+        "weighted_f1": round(float(m["f1"]), 4),
+        "train_time_s": round(train_time, 3),
+        "target_accuracy": target,
+        "target_met": bool(acc >= target),
+    }

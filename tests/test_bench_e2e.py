@@ -54,6 +54,13 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     assert "dt_parity_test_accuracy" in extra
     assert "serving_latency_ms" in extra
     assert "north_star" in extra
+    # r5 additions: the dual headline and the real-raw-WISDM lane marker
+    assert result["headline_tpu"]["metric"] == "raw_cnn_train_throughput"
+    assert result["headline_tpu"]["target_windows_per_sec"] > 0
+    assert (
+        "skipped" in extra["wisdm_raw_parity"]
+        or "accuracy" in extra["wisdm_raw_parity"]
+    )
     # smoke draws are throwaway: they must not touch (or carry) the
     # healthy-state cross-reference machinery
     assert "healthy_state_reference" not in extra

@@ -1,0 +1,71 @@
+"""wisdm_raw_lane (har_tpu.parity): the ≥0.97 raw-window claim is
+falsifiable the moment real raw data appears (VERDICT r4 missing #3).
+
+No real WISDM_ar_v1.1_raw.txt exists in this environment, so the lane's
+skip path and its end-to-end mechanics are proven on a fixture written
+in the exact raw format (`user,activity,timestamp,x,y,z;`) from the
+calibrated synthetic generator.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.parity import resolve_wisdm_raw, wisdm_raw_lane
+
+
+def test_lane_skips_without_file(monkeypatch, tmp_path):
+    monkeypatch.delenv("HAR_TPU_WISDM_RAW", raising=False)
+    monkeypatch.chdir(tmp_path)  # no ./data candidates either
+    assert resolve_wisdm_raw() is None
+    out = wisdm_raw_lane()
+    assert "skipped" in out and "HAR_TPU_WISDM_RAW" in out["skipped"]
+    assert out["target_accuracy"] == 0.97
+
+
+def _write_raw_fixture(path, n_windows=120, seed=0):
+    """Serialize calibrated synthetic windows in the WISDM raw format."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+
+    raw = synthetic_raw_stream(n_windows=n_windows, seed=seed)
+    lines = []
+    t = 0
+    for w, label in zip(raw.windows, raw.labels):
+        name = raw.class_names[label]
+        for x, y, z in w:
+            t += 50_000_000  # 20 Hz in nanoseconds
+            lines.append(f"1,{name},{t},{x:.6f},{y:.6f},{z:.6f};")
+    path.write_text("\n".join(lines) + "\n")
+    return raw
+
+
+def test_lane_end_to_end_on_fixture(monkeypatch, tmp_path):
+    """The detect → window → train → score chain runs and reports the
+    target verdict on a file in the real format."""
+    fixture = tmp_path / "WISDM_ar_v1.1_raw.txt"
+    raw = _write_raw_fixture(fixture)
+
+    # resolution honors the env var
+    monkeypatch.setenv("HAR_TPU_WISDM_RAW", str(fixture))
+    assert resolve_wisdm_raw() == str(fixture)
+
+    # small trainer shape: this test pins the lane's MECHANICS (detect →
+    # window → train → score → verdict); the bench-CNN default shape is
+    # the measuring configuration and would compile for minutes on CPU
+    out = wisdm_raw_lane(epochs=40, batch_size=64, channels=(32, 32))
+    assert "skipped" not in out and "error" not in out
+    assert out["n_windows"] == len(raw.labels)
+    assert out["n_train"] + out["n_test"] == out["n_windows"]
+    assert 0.0 <= out["accuracy"] <= 1.0
+    assert out["target_accuracy"] == 0.97
+    assert out["target_met"] == (out["accuracy"] >= 0.97)
+    # the calibrated classes are separable: the lane must actually learn
+    # (chance for the generator's class family is ~1/6; this light shape
+    # measured 0.93 held-out)
+    assert out["accuracy"] > 0.7
+
+
+def test_lane_refuses_too_few_windows(tmp_path):
+    fixture = tmp_path / "WISDM_ar_v1.1_raw.txt"
+    _write_raw_fixture(fixture, n_windows=10)
+    out = wisdm_raw_lane(str(fixture))
+    assert "skipped" in out and "too few" in out["skipped"]
