@@ -1,0 +1,55 @@
+"""The release gate (scripts/release_gate.py) keeps published test
+counts generated, not typed — VERDICT r4 weak #6 (stale counts) and
+weak #1 (a red tree shipped with a "green" claim).
+
+Smoke tier pins the cheap invariant: README's count lines equal the
+gate's run log.  The slow tier re-collects from scratch via
+``--check`` so real drift (tests added without rerunning the gate) is
+caught by the full suite.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _readme_counts():
+    text = (REPO / "README.md").read_text()
+    smoke = re.search(r"# smoke tier: (\d+) tests", text)
+    full = re.search(r"# full suite: (\d+) tests", text)
+    assert smoke and full, (
+        "README.md lost the generated count anchor lines "
+        '("# smoke tier: N tests" / "# full suite: N tests"); '
+        "run scripts/release_gate.py --counts-only"
+    )
+    return int(smoke.group(1)), int(full.group(1))
+
+
+def test_readme_counts_match_gate_log():
+    log_path = REPO / "artifacts" / "test_gate.json"
+    assert log_path.exists(), (
+        "artifacts/test_gate.json missing — run scripts/release_gate.py "
+        "(the README test counts must trace to a gate run log)"
+    )
+    log = json.loads(log_path.read_text())
+    assert _readme_counts() == (log["smoke_count"], log["total_count"])
+
+
+@pytest.mark.slow
+def test_gate_check_agrees_with_fresh_collection():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "release_gate.py"),
+         "--check"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "release_gate --check failed — README counts drifted from a "
+        f"fresh collection:\n{proc.stdout}\n{proc.stderr}"
+    )
