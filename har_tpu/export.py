@@ -218,6 +218,17 @@ class ExportedPredictor:
     meta: dict
     weights: list | None = None  # weight-input artifacts (int8 export)
 
+    def device_call(self, x):
+        """The bare exported program on a device array: returns device
+        logits, no numpy staging or shape checks.  One source of truth
+        for the weights-input dispatch — serving's device-latency timing
+        (StreamingClassifier.device_latency_ms) calls this so a change
+        to the artifact's call contract cannot silently diverge from
+        ``predict``."""
+        if self.weights is not None:
+            return self.exported.call(self.weights, x)[0]
+        return self.exported.call(x)[0]
+
     def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(logits, probability) for a (n, *example_shape) batch."""
         x = np.asarray(x, np.float32)

@@ -370,13 +370,19 @@ class StreamingClassifier:
         MLlib replicas) — their transform has no single device program
         to time.
         """
-        # unwrap to the jitted NeuralModel through any wrapper chain:
+        # unwrap to the compiled predict through any wrapper chain:
         # NeuralClassifierModel's ``.inner``, TemperatureScaledModel's
         # ``.model`` — the device program is the same base forward either
-        # way (temperature/scaler are host-side)
+        # way (temperature/scaler are host-side).  An ExportedPredictor
+        # (StableHLO artifact) is timed via its exported call.
         inner = self.model
+        fn = None
         for _ in range(4):
             if hasattr(inner, "_predict") and hasattr(inner, "params"):
+                fn = lambda x: inner._predict(inner.params, x)  # noqa: E731
+                break
+            if hasattr(inner, "device_call"):
+                fn = inner.device_call  # ExportedPredictor
                 break
             nxt = getattr(inner, "inner", None)
             if nxt is None:
@@ -384,20 +390,20 @@ class StreamingClassifier:
             if nxt is None:
                 break
             inner = nxt
-        if not (hasattr(inner, "_predict") and hasattr(inner, "params")):
+        if fn is None:
             raise ValueError(
-                "device timing needs a NeuralModel-backed classifier "
-                f"(got {type(self.model).__name__}); e2e latency_stats() "
-                "is still available"
+                "device timing needs a NeuralModel-backed or exported-"
+                f"artifact classifier (got {type(self.model).__name__}); "
+                "e2e latency_stats() is still available"
             )
         import jax.numpy as jnp
 
         x = jnp.zeros((batch, self.window, self.channels), jnp.float32)
-        inner._predict(inner.params, x).block_until_ready()  # warm
+        fn(x).block_until_ready()  # warm
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
-            inner._predict(inner.params, x).block_until_ready()
+            fn(x).block_until_ready()
             times.append((time.perf_counter() - t0) * 1e3)
         result = {
             "batch": batch,

@@ -427,3 +427,32 @@ def test_device_timing_unwraps_calibrated_wrapper():
     stats = sc.latency_stats()
     assert stats["device_batch"] == 1
     assert "host_overhead_p50_ms" in stats
+
+
+def test_device_timing_on_exported_artifact(tmp_path):
+    """The StableHLO deployment path (load_exported → StreamingClassifier)
+    gets the same device/host-overhead split as a live model."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.export import export_model, load_exported
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, learning_rate=1e-3,
+                             seed=0),
+        model_kwargs={"channels": (8,)},
+    ).fit(FeatureSet(features=raw.windows,
+                     label=raw.labels.astype(np.int32)))
+    art = str(tmp_path / "art")
+    export_model(model, art)
+    sc = StreamingClassifier(
+        load_exported(art), window=200, hop=200, smoothing="none"
+    )
+    events = sc.replay(raw.windows[:4].reshape(-1, 3))
+    assert len(events) == 4
+    stats = sc.latency_stats()
+    assert stats["device_batch"] == 1
+    assert "host_overhead_p50_ms" in stats

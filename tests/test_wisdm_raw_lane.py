@@ -69,3 +69,23 @@ def test_lane_refuses_too_few_windows(tmp_path):
     _write_raw_fixture(fixture, n_windows=10)
     out = wisdm_raw_lane(str(fixture))
     assert "skipped" in out and "too few" in out["skipped"]
+
+
+def test_cli_parity_raw(monkeypatch, tmp_path, capsys):
+    """`har parity --raw`: skip marker without data, full verdict with a
+    --data-path fixture."""
+    import json
+
+    from har_tpu.cli import main
+
+    monkeypatch.delenv("HAR_TPU_WISDM_RAW", raising=False)
+    monkeypatch.chdir(tmp_path)
+    assert main(["parity", "--raw"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "skipped" in out
+
+    fixture = tmp_path / "WISDM_ar_v1.1_raw.txt"
+    _write_raw_fixture(fixture, n_windows=10)  # too-few path is cheap
+    assert main(["parity", "--raw", "--data-path", str(fixture)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "too few" in out["skipped"]
