@@ -456,3 +456,19 @@ def test_device_timing_on_exported_artifact(tmp_path):
     stats = sc.latency_stats()
     assert stats["device_batch"] == 1
     assert "host_overhead_p50_ms" in stats
+
+
+def test_latency_window_bounded():
+    """A long-lived session's latency memory is constant: stats cover a
+    trailing window (deque maxlen), count included."""
+    sc = StreamingClassifier(
+        _StubModel(), window=10, hop=10, smoothing="none"
+    )
+    cap = sc._latencies.maxlen
+    assert cap is not None and cap >= 1024
+    rec = _recording(10)
+    for _ in range(cap + 50):
+        sc.push(rec)
+    stats = sc.latency_stats()
+    assert stats["count"] == cap
+    assert len(sc._latencies) == cap
