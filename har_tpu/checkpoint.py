@@ -266,6 +266,8 @@ def save_classical_model(
     drop_binned: bool | None = None,
     split_method: str | None = None,
     pipeline=None,
+    split_seed: int | None = None,
+    train_fraction: float | None = None,
 ) -> str:
     """Persist a classical model (and optionally its feature pipeline).
 
@@ -295,6 +297,13 @@ def save_classical_model(
         meta["drop_binned"] = drop_binned
     if split_method is not None:
         meta["split_method"] = split_method
+    if split_seed is not None:
+        # same provenance contract as save_model: scoring backends
+        # default to the RECORDED split, so a non-default training seed
+        # never leaks training rows into the "held-out" score
+        meta["split_seed"] = int(split_seed)
+    if train_fraction is not None:
+        meta["train_fraction"] = float(train_fraction)
     with open(os.path.join(path, _META), "w") as f:
         json.dump(meta, f)
     pipe_path = os.path.join(path, _PIPELINE)
@@ -452,35 +461,37 @@ class TrainCheckpointer:
         self._mgr.close()
 
 
-def _load_checkpoint_for_scoring(
-    path: str,
-    data_path: str | None,
-    dataset: str | None,
-    train_fraction: float,
-    seed: int,
-    synthetic_rows: int | None,
+def scoring_config_from_meta(
+    meta: dict,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float | None = None,
+    seed: int | None = None,
+    synthetic_rows: int | None = None,
+    what: str = "checkpoint",
 ):
-    """Load a checkpoint (either format) + the data it should be scored on.
+    """Saved provenance → the RunConfig that re-derives the held-out
+    partition.  The ONE derivation for every scoring backend — evaluate/
+    predict on checkpoints AND evaluate on exported artifacts — so the
+    split semantics cannot drift between them.
 
-    Returns (model, test FeatureSet).  Shared by the evaluate and predict
-    backends so both load identically and derive the identical test
-    partition — through the checkpoint's bundled pipeline vocabularies
-    when present, through runner.featurize otherwise.
+    ``None`` for dataset/train_fraction/seed/synthetic_rows means "use
+    the recorded value" (falling back to wisdm / 0.7 / 2018 for
+    pre-provenance saves); an explicit value that CONTRADICTS a
+    recording is refused where it would silently change the feature
+    view or regenerate different data.  seed/train_fraction overrides
+    are accepted (scoring against a different draw is a legitimate ask)
+    but default to the recorded split so a non-default training seed
+    never leaks training rows into the "held-out" score.
     """
     from har_tpu.config import DataConfig, ModelConfig, RunConfig
-    from har_tpu.runner import featurize, load_dataset
 
-    with open(os.path.join(_abspath(path), _META)) as f:
-        meta = json.load(f)
-    is_classical = meta.get("format") == "classical"
-    model = load_classical_model(path) if is_classical else load_model(path)
-    model_name = meta["model_name"]
     saved_dataset = meta.get("dataset")
     if dataset is None:
         dataset = saved_dataset or "wisdm"
     elif saved_dataset is not None and dataset != saved_dataset:
         raise ValueError(
-            f"checkpoint was trained on dataset {saved_dataset!r}; "
+            f"{what} was trained on dataset {saved_dataset!r}; "
             f"evaluating against {dataset!r} would derive a different "
             "feature view than the saved parameters expect"
         )
@@ -489,11 +500,15 @@ def _load_checkpoint_for_scoring(
         synthetic_rows = saved_rows
     elif saved_rows is not None and synthetic_rows != saved_rows:
         raise ValueError(
-            f"checkpoint was trained with synthetic_rows={saved_rows}; "
+            f"{what} was trained with synthetic_rows={saved_rows}; "
             f"evaluating against synthetic_rows={synthetic_rows} would "
             "regenerate different data than the saved parameters saw"
         )
-    config = RunConfig(
+    if seed is None:
+        seed = meta.get("split_seed", 2018)
+    if train_fraction is None:
+        train_fraction = meta.get("train_fraction", 0.7)
+    return RunConfig(
         data=DataConfig(
             dataset=dataset,
             path=data_path,
@@ -505,7 +520,33 @@ def _load_checkpoint_for_scoring(
             # under the bernoulli draw; honor their provenance
             split_method=meta.get("split_method", "bernoulli"),
         ),
-        model=ModelConfig(name=model_name),
+        model=ModelConfig(name=meta.get("model_name", "cnn1d")),
+    )
+
+
+def _load_checkpoint_for_scoring(
+    path: str,
+    data_path: str | None,
+    dataset: str | None,
+    train_fraction: float | None,
+    seed: int | None,
+    synthetic_rows: int | None,
+):
+    """Load a checkpoint (either format) + the data it should be scored on.
+
+    Returns (model, test FeatureSet).  Shared by the evaluate and predict
+    backends so both load identically and derive the identical test
+    partition — through the checkpoint's bundled pipeline vocabularies
+    when present, through runner.featurize otherwise.
+    """
+    from har_tpu.runner import featurize, load_dataset
+
+    with open(os.path.join(_abspath(path), _META)) as f:
+        meta = json.load(f)
+    is_classical = meta.get("format") == "classical"
+    model = load_classical_model(path) if is_classical else load_model(path)
+    config = scoring_config_from_meta(
+        meta, data_path, dataset, train_fraction, seed, synthetic_rows
     )
     table = load_dataset(config)
     pipe_path = os.path.join(_abspath(path), _PIPELINE)
@@ -529,8 +570,8 @@ def predict_checkpoint(
     output_csv: str,
     data_path: str | None = None,
     dataset: str | None = None,
-    train_fraction: float = 0.7,
-    seed: int = 2018,
+    train_fraction: float | None = None,
+    seed: int | None = None,
     synthetic_rows: int | None = None,
 ) -> dict:
     """CLI `predict` backend: batch inference from a saved checkpoint.
@@ -571,15 +612,16 @@ def evaluate_checkpoint(
     path: str,
     data_path: str | None = None,
     dataset: str | None = None,
-    train_fraction: float = 0.7,
-    seed: int = 2018,
+    train_fraction: float | None = None,
+    seed: int | None = None,
     synthetic_rows: int | None = None,
 ) -> dict:
     """CLI `evaluate` backend: load a checkpoint, score it on held-out data.
 
-    ``train_fraction``/``seed`` must match the values the checkpoint was
-    trained with — the test partition is re-derived from them, so a
-    mismatch would leak training rows into the score.  The feature view
+    ``train_fraction``/``seed`` default to the values recorded in the
+    checkpoint metadata (falling back to 0.7/2018 for pre-provenance
+    saves) — the test partition is re-derived from them, so an explicit
+    mismatched value would leak training rows into the score.  The feature view
     is re-derived from the checkpoint's saved model name + dataset
     through the same runner logic that trained it; ``dataset=None``
     uses the recorded one, and an explicit value that contradicts the

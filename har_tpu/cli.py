@@ -101,17 +101,28 @@ def _parser() -> argparse.ArgumentParser:
                         "shardings over hidden dims)")
     t.add_argument("--output-dir", default="main_result")
 
-    e = sub.add_parser("evaluate", help="evaluate a saved checkpoint")
-    e.add_argument("--checkpoint", required=True)
+    e = sub.add_parser(
+        "evaluate",
+        help="evaluate a saved checkpoint (or an exported artifact)",
+    )
+    e_src = e.add_mutually_exclusive_group(required=True)
+    e_src.add_argument("--checkpoint")
+    e_src.add_argument(
+        "--artifact",
+        help="score an exported StableHLO artifact directory (har "
+             "export output) instead of a checkpoint — the deployed "
+             "program itself, no model classes in the loop",
+    )
     e.add_argument("--dataset", default=None,
                    choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"],
                    help="defaults to the dataset recorded in the "
                         "checkpoint metadata")
     e.add_argument("--data-path", default=None)
-    e.add_argument("--train-fraction", type=float, default=0.7,
-                   help="must match the training run (test split re-derived)")
-    e.add_argument("--seed", type=int, default=2018,
-                   help="must match the training run")
+    e.add_argument("--train-fraction", type=float, default=None,
+                   help="defaults to the training run's recorded value "
+                        "(test split re-derived from it)")
+    e.add_argument("--seed", type=int, default=None,
+                   help="defaults to the training run's recorded value")
 
     pr = sub.add_parser(
         "predict",
@@ -122,8 +133,10 @@ def _parser() -> argparse.ArgumentParser:
     pr.add_argument("--dataset", default=None,
                     choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
     pr.add_argument("--data-path", default=None)
-    pr.add_argument("--train-fraction", type=float, default=0.7)
-    pr.add_argument("--seed", type=int, default=2018)
+    pr.add_argument("--train-fraction", type=float, default=None,
+                    help="defaults to the training run's recorded value")
+    pr.add_argument("--seed", type=int, default=None,
+                    help="defaults to the training run's recorded value")
 
     s = sub.add_parser(
         "sweep",
@@ -344,29 +357,19 @@ def main(argv=None) -> int:
                 "finetune covers the neural families; classical models "
                 "retrain in seconds — use `har train`"
             )
-        dataset = args.dataset or meta.get("dataset") or "wisdm"
-        seed = (
-            args.seed
-            if args.seed is not None
-            else meta.get("split_seed", 2018)
+        # the ONE meta→RunConfig derivation (checkpoint.
+        # scoring_config_from_meta): same recorded-split defaults and
+        # contradiction guards as evaluate/predict, so a --dataset that
+        # conflicts with the checkpoint is refused here too
+        from har_tpu.checkpoint import scoring_config_from_meta
+
+        config = scoring_config_from_meta(
+            meta, args.data_path, args.dataset, args.train_fraction,
+            args.seed,
         )
-        train_fraction = (
-            args.train_fraction
-            if args.train_fraction is not None
-            else meta.get("train_fraction", 0.7)
-        )
-        config = RunConfig(
-            data=DataConfig(
-                dataset=dataset,
-                path=args.data_path,
-                train_fraction=train_fraction,
-                seed=seed,
-                synthetic_rows=meta.get("synthetic_rows"),
-                drop_binned=meta.get("drop_binned", True),
-                split_method=meta.get("split_method", "bernoulli"),
-            ),
-            model=ModelConfig(name=meta["model_name"]),
-        )
+        dataset = config.data.dataset
+        seed = config.data.seed
+        train_fraction = config.data.train_fraction
         table = load_dataset(config)
         train, test, _ = featurize(config, table)
         model = load_model(args.checkpoint)
@@ -542,19 +545,27 @@ def main(argv=None) -> int:
         return 0
 
     if args.command == "evaluate":
-        from har_tpu.checkpoint import evaluate_checkpoint
+        if args.artifact is not None:
+            from har_tpu.export import evaluate_artifact
 
-        print(
-            json.dumps(
-                evaluate_checkpoint(
-                    args.checkpoint,
-                    args.data_path,
-                    dataset=args.dataset,
-                    train_fraction=args.train_fraction,
-                    seed=args.seed,
-                )
+            out = evaluate_artifact(
+                args.artifact,
+                args.data_path,
+                dataset=args.dataset,
+                train_fraction=args.train_fraction,
+                seed=args.seed,
             )
-        )
+        else:
+            from har_tpu.checkpoint import evaluate_checkpoint
+
+            out = evaluate_checkpoint(
+                args.checkpoint,
+                args.data_path,
+                dataset=args.dataset,
+                train_fraction=args.train_fraction,
+                seed=args.seed,
+            )
+        print(json.dumps(out))
         return 0
 
     # train

@@ -176,9 +176,17 @@ def export_checkpoint(
             "via save_classical_model"
         )
     model = load_model(checkpoint_path)
+    # split provenance (split_method/seed family) rides along so
+    # evaluate_artifact re-derives the checkpoint's own held-out
+    # partition — without it an artifact evaluation could leak
+    # training rows through a different split draw
     carry = {
         k: meta[k]
-        for k in ("model_name", "model_kwargs", "dataset", "input_shape")
+        for k in (
+            "model_name", "model_kwargs", "dataset", "input_shape",
+            "split_method", "split_seed", "train_fraction",
+            "drop_binned", "synthetic_rows",
+        )
         if k in meta
     }
     if quantize == "int8":
@@ -276,3 +284,49 @@ def load_exported(path: str) -> ExportedPredictor:
         meta=meta,
         weights=weights,
     )
+
+
+def evaluate_artifact(
+    path: str,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float | None = None,
+    seed: int | None = None,
+    synthetic_rows: int | None = None,
+) -> dict:
+    """CLI ``evaluate --artifact`` backend: score an exported StableHLO
+    artifact on the held-out partition — no checkpoint, no flax, no
+    model classes; the deployment artifact itself is what gets scored.
+
+    The test partition is re-derived from the artifact's recorded
+    provenance (dataset, split method/seed/fraction; carried over from
+    the checkpoint by ``export_checkpoint``) through the SAME helper as
+    ``evaluate_checkpoint`` (checkpoint.scoring_config_from_meta), so
+    the two backends cannot drift: contradictions in dataset/
+    synthetic_rows are refused, and seed/train_fraction default to the
+    recorded split.
+    """
+    from har_tpu.checkpoint import scoring_config_from_meta
+    from har_tpu.ops.metrics import evaluate
+    from har_tpu.runner import featurize, load_dataset
+
+    art = load_exported(path)
+    config = scoring_config_from_meta(
+        art.meta, data_path, dataset, train_fraction, seed,
+        synthetic_rows, what="artifact",
+    )
+    table = load_dataset(config)
+    _, test, _ = featurize(config, table)
+    preds = art.transform(test)
+    rep = evaluate(test.label, preds.raw, art.num_classes)
+    return {
+        "accuracy": rep["accuracy"],
+        "f1": rep["f1"],
+        "weightedPrecision": rep["weightedPrecision"],
+        "weightedRecall": rep["weightedRecall"],
+        "count_correct": int(rep["count_correct"]),
+        "count_wrong": int(rep["count_wrong"]),
+        "n_test": int(len(test)),
+        "artifact": path,
+        "quantized": (art.meta.get("quantization") or {}).get("scheme"),
+    }

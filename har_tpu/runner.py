@@ -673,6 +673,8 @@ def _save_fitted(
         drop_binned=config.data.drop_binned,
         split_method=split_method,
         pipeline=pipe_model,
+        split_seed=config.data.seed,
+        train_fraction=config.data.train_fraction,
     )
 
 

@@ -168,3 +168,44 @@ def test_export_without_scaler_needs_shape(raw_model, tmp_path):
         np.zeros((2, 200, 3), np.float32)
     )
     assert logits.shape == (2, model.num_classes)
+
+
+def test_evaluate_artifact_matches_checkpoint(raw_model, tmp_path, capsys):
+    """`har evaluate --artifact`: the deployed StableHLO program scores
+    the SAME held-out partition to the SAME accuracy as evaluating its
+    source checkpoint — split provenance rides in the artifact meta."""
+    import json
+
+    from har_tpu.checkpoint import evaluate_checkpoint, save_model
+    from har_tpu.cli import main
+    from har_tpu.export import evaluate_artifact
+
+    model, raw = raw_model
+    ckpt = str(tmp_path / "ckpt")
+    # NON-default split provenance: both backends must default to the
+    # RECORDED seed/fraction (a 2018/0.7 fallback here would leak
+    # training rows into the "held-out" score)
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16, 16)},
+               dataset="wisdm_raw", input_shape=(200, 3),
+               split_seed=7, train_fraction=0.8)
+    art = export_checkpoint(ckpt, str(tmp_path / "art"))
+    assert json.load(open(f"{art}/export_meta.json"))["split_seed"] == 7
+
+    from_ckpt = evaluate_checkpoint(ckpt)
+    from_art = evaluate_artifact(art)
+    assert from_art["accuracy"] == from_ckpt["accuracy"]
+    assert from_art["n_test"] == from_ckpt["n_test"]
+    assert from_art["count_correct"] == from_ckpt["count_correct"]
+    assert from_art["quantized"] is None
+
+    # CLI surface
+    rc = main(["evaluate", "--artifact", art])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["accuracy"] == from_ckpt["accuracy"]
+
+    # contradicting the recorded dataset is refused
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="feature view"):
+        evaluate_artifact(art, dataset="wisdm")

@@ -188,3 +188,29 @@ def test_run_save_models_dir(tmp_path):
         rep = evaluate_checkpoint(os.path.join(models_dir, job), seed=SEED)
         assert 0.0 <= rep["accuracy"] <= 1.0
         assert rep["n_test"] > 0
+
+
+def test_classical_split_provenance_recorded(tmp_path):
+    """Classical checkpoints record split_seed/train_fraction like the
+    neural path, and evaluate defaults to the RECORDED split — a
+    non-default training seed must never leak training rows into the
+    'held-out' score (r5 contract, checkpoint.scoring_config_from_meta)."""
+    from har_tpu.ops.metrics import evaluate
+
+    cfg = RunConfig(
+        data=DataConfig(dataset="synthetic", synthetic_rows=N_ROWS, seed=7),
+        model=ModelConfig(name="logistic_regression"),
+    )
+    train, test, pipe = featurize(cfg, load_dataset(cfg))
+    model = build_estimator("logistic_regression", {"max_iter": 5}).fit(train)
+    path = save_classical_model(
+        str(tmp_path / "lr7"), model,
+        dataset="synthetic", synthetic_rows=N_ROWS, pipeline=pipe,
+        split_seed=7, train_fraction=0.7,
+    )
+    # NO seed argument: the recorded seed-7 partition must be re-derived
+    rep = evaluate_checkpoint(path)
+    direct = evaluate(test.label, model.transform(test).raw,
+                      model.num_classes)
+    assert rep["accuracy"] == pytest.approx(float(direct["accuracy"]))
+    assert rep["n_test"] == len(test)
