@@ -160,9 +160,21 @@ def update_healthy_reference(result: dict, path: pathlib.Path) -> None:
         stored = json.loads(path.read_text())
     except (OSError, ValueError):
         stored = None
-    result.setdefault("extra", {})["healthy_state_reference"] = (
+    extra = result.setdefault("extra", {})
+    extra["healthy_state_reference"] = (
         healthy_summary(stored) if stored is not None else None
     )
+    if result.get("degraded_chip_state"):
+        # the auditable record of the states observed while waiting for
+        # a >=HEALTHY_CHIP_PCT draw (scripts/chip_probe.py --log);
+        # attached only when it actually exists — a dangling pointer
+        # would undermine its whole purpose
+        log_path = path.parent / "chip_state_log.json"
+        extra["chip_state_log"] = (
+            str(log_path.relative_to(path.parent.parent))
+            if log_path.exists()
+            else None
+        )
 
 
 def load_table():
