@@ -702,19 +702,24 @@ def main() -> None:
     )
     tfm_wps = tfm_stats.get("windows_per_sec_best")
     # The 50k windows/s north star stays on the lane but the miss is
-    # self-documenting (VERDICT r4 item 8): even patched, the encoder's
-    # per-window FLOPs (~12x the CNN's) put 50k at ~2.8x the healthy
-    # measured rate — the gap is model cost, not an unfed chip; see
-    # docs/roofline.md "Transformer" for the traffic accounting.  Only a
-    # lane that RAN carries the measurement prose (a deadline-skipped
-    # lane keeps its bare skip marker).
+    # self-documenting (VERDICT r4 item 8).  Measured program FLOPs put
+    # the patched encoder at 244 vs the CNN's 149 MFLOP/window (1.64x),
+    # while the same-draw throughput gap to the CNN lane is 12.7x
+    # (bench_latest 2026-07-31, 4.1% state: 214,340 vs 16,833 w/s) — so
+    # ~8x of the gap is EFFICIENCY, not model size: at T=25 the
+    # per-step attention/LayerNorm passes are bandwidth-bound and the
+    # tiny matmul shapes underfill the MXU — see docs/roofline.md
+    # "Transformer".  Only a lane that RAN carries the measurement
+    # prose (a deadline-skipped lane keeps its skip marker).
     if tfm_wps is not None:
         tfm_stats["note"] = (
             "patch-8 ViT-style embedding (r5): T 200->25 before "
             "attention; 2.1x the r4 unpatched rate same-session. 50k "
-            "w/s remains out of reach for this family at HAR sizes — "
-            "the per-window FLOP cost, not chip starvation, is the "
-            "limiter (docs/roofline.md)"
+            "w/s remains out of reach for this family at HAR sizes: "
+            "measured 244 vs 149 MFLOP/window vs the CNN (1.64x), "
+            "same-draw throughput gap 12.7x — the difference is "
+            "bandwidth-bound attention/norm passes and MXU-"
+            "underfilling shapes at T=25 (docs/roofline.md)"
         )
 
     # Raw-window accuracy lane (VERDICT r3 #4): synthesize windows whose
