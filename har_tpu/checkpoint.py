@@ -565,26 +565,12 @@ def _load_checkpoint_for_scoring(
     return model, test
 
 
-def predict_checkpoint(
-    path: str,
-    output_csv: str,
-    data_path: str | None = None,
-    dataset: str | None = None,
-    train_fraction: float | None = None,
-    seed: int | None = None,
-    synthetic_rows: int | None = None,
-) -> dict:
-    """CLI `predict` backend: batch inference from a saved checkpoint.
-
-    Scores the held-out partition (same derivation as `evaluate`) and
-    writes one CSV row per window: UID (when the view carries one), the
-    true label, the predicted class, and per-class probabilities.
-    """
+def write_predictions_csv(model, test, output_csv: str) -> dict:
+    """One CSV row per window: UID (when the view carries one), the true
+    label, the predicted class, per-class probabilities.  The ONE writer
+    for every predict backend (checkpoint and exported-artifact)."""
     import csv
 
-    model, test = _load_checkpoint_for_scoring(
-        path, data_path, dataset, train_fraction, seed, synthetic_rows
-    )
     preds = model.transform(test)
     probs = np.asarray(preds.probability)
     output_csv = _abspath(output_csv)
@@ -606,6 +592,25 @@ def predict_checkpoint(
         "n_rows": int(len(preds)),
         "num_classes": int(probs.shape[1]),
     }
+
+
+def predict_checkpoint(
+    path: str,
+    output_csv: str,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float | None = None,
+    seed: int | None = None,
+    synthetic_rows: int | None = None,
+) -> dict:
+    """CLI `predict` backend: batch inference from a saved checkpoint.
+
+    Scores the held-out partition (same derivation as `evaluate`) and
+    writes the predictions CSV (write_predictions_csv)."""
+    model, test = _load_checkpoint_for_scoring(
+        path, data_path, dataset, train_fraction, seed, synthetic_rows
+    )
+    return write_predictions_csv(model, test, output_csv)
 
 
 def evaluate_checkpoint(

@@ -126,9 +126,16 @@ def _parser() -> argparse.ArgumentParser:
 
     pr = sub.add_parser(
         "predict",
-        help="batch inference from a saved checkpoint → predictions CSV",
+        help="batch inference from a saved checkpoint (or exported "
+             "artifact) → predictions CSV",
     )
-    pr.add_argument("--checkpoint", required=True)
+    pr_src = pr.add_mutually_exclusive_group(required=True)
+    pr_src.add_argument("--checkpoint")
+    pr_src.add_argument(
+        "--artifact",
+        help="predict with an exported StableHLO artifact directory "
+             "(har export output) instead of a checkpoint",
+    )
     pr.add_argument("--output", default="predictions.csv")
     pr.add_argument("--dataset", default=None,
                     choices=["wisdm", "wisdm_raw", "ucihar", "synthetic"])
@@ -324,12 +331,18 @@ def main(argv=None) -> int:
         return 0
 
     if args.command == "predict":
-        from har_tpu.checkpoint import predict_checkpoint
+        if args.artifact is not None:
+            from har_tpu.export import predict_artifact as _predict
 
+            src = args.artifact
+        else:
+            from har_tpu.checkpoint import predict_checkpoint as _predict
+
+            src = args.checkpoint
         print(
             json.dumps(
-                predict_checkpoint(
-                    args.checkpoint,
+                _predict(
+                    src,
                     args.output,
                     args.data_path,
                     dataset=args.dataset,

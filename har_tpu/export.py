@@ -286,6 +286,31 @@ def load_exported(path: str) -> ExportedPredictor:
     )
 
 
+def _load_artifact_for_scoring(
+    path: str,
+    data_path: str | None,
+    dataset: str | None,
+    train_fraction: float | None,
+    seed: int | None,
+    synthetic_rows: int | None,
+):
+    """Load an artifact + the held-out data it should be scored on —
+    the artifact-side mirror of checkpoint._load_checkpoint_for_scoring,
+    shared by the evaluate and predict backends so both derive the
+    identical test partition."""
+    from har_tpu.checkpoint import scoring_config_from_meta
+    from har_tpu.runner import featurize, load_dataset
+
+    art = load_exported(path)
+    config = scoring_config_from_meta(
+        art.meta, data_path, dataset, train_fraction, seed,
+        synthetic_rows, what="artifact",
+    )
+    table = load_dataset(config)
+    _, test, _ = featurize(config, table)
+    return art, test
+
+
 def evaluate_artifact(
     path: str,
     data_path: str | None = None,
@@ -306,17 +331,11 @@ def evaluate_artifact(
     synthetic_rows are refused, and seed/train_fraction default to the
     recorded split.
     """
-    from har_tpu.checkpoint import scoring_config_from_meta
     from har_tpu.ops.metrics import evaluate
-    from har_tpu.runner import featurize, load_dataset
 
-    art = load_exported(path)
-    config = scoring_config_from_meta(
-        art.meta, data_path, dataset, train_fraction, seed,
-        synthetic_rows, what="artifact",
+    art, test = _load_artifact_for_scoring(
+        path, data_path, dataset, train_fraction, seed, synthetic_rows
     )
-    table = load_dataset(config)
-    _, test, _ = featurize(config, table)
     preds = art.transform(test)
     rep = evaluate(test.label, preds.raw, art.num_classes)
     return {
@@ -330,3 +349,25 @@ def evaluate_artifact(
         "artifact": path,
         "quantized": (art.meta.get("quantization") or {}).get("scheme"),
     }
+
+
+def predict_artifact(
+    path: str,
+    output_csv: str,
+    data_path: str | None = None,
+    dataset: str | None = None,
+    train_fraction: float | None = None,
+    seed: int | None = None,
+    synthetic_rows: int | None = None,
+) -> dict:
+    """CLI ``predict --artifact`` backend: batch inference CSV straight
+    from the deployed StableHLO program — same held-out derivation
+    (_load_artifact_for_scoring) and the same writer as the checkpoint
+    path (checkpoint.write_predictions_csv), no model classes in the
+    loop."""
+    from har_tpu.checkpoint import write_predictions_csv
+
+    art, test = _load_artifact_for_scoring(
+        path, data_path, dataset, train_fraction, seed, synthetic_rows
+    )
+    return write_predictions_csv(art, test, output_csv)
