@@ -238,3 +238,32 @@ def test_predict_artifact_matches_checkpoint(raw_model, tmp_path, capsys):
     # predictions column (probabilities agree to the printed precision)
     get_pred = lambda lines: [ln.split(",")[2] for ln in lines[1:]]
     assert get_pred(a) == get_pred(b)
+
+
+def test_evaluate_int8_artifact(raw_model, tmp_path):
+    """An int8 artifact evaluates end-to-end and reports its scheme;
+    accuracy equals the quantized live model's on the same partition."""
+    from har_tpu.checkpoint import save_model
+    from har_tpu.export import evaluate_artifact
+    from har_tpu.ops.metrics import evaluate as _eval
+    from har_tpu.quantize import quantize_model
+
+    model, raw = raw_model
+    ckpt = str(tmp_path / "ckpt")
+    save_model(ckpt, model, "cnn1d", model_kwargs={"channels": (16, 16)},
+               dataset="wisdm_raw", input_shape=(200, 3))
+    art = export_checkpoint(ckpt, str(tmp_path / "art"), quantize="int8")
+    rep = evaluate_artifact(art)
+    assert rep["quantized"] == "int8_weight_only"
+    assert 0.0 <= rep["accuracy"] <= 1.0
+
+    # same partition, quantized live model: accuracies agree
+    from har_tpu.export import _load_artifact_for_scoring
+
+    _, test = _load_artifact_for_scoring(art, None, None, None, None, None)
+    qlive = _eval(
+        test.label, quantize_model(model).transform(test).raw,
+        model.num_classes,
+    )
+    assert rep["accuracy"] == pytest.approx(float(qlive["accuracy"]),
+                                            abs=1e-9)
