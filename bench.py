@@ -110,7 +110,8 @@ def healthy_summary(result: dict) -> dict:
         }
     note = (
         "most recent full bench draw taken at a healthy chip state "
-        f"(pure-matmul probe >= {HEALTHY_CHIP_PCT}% of peak); compare "
+        f"(compute-only pure-matmul probe >= {HEALTHY_CHIP_PCT}% of "
+        "peak, device-timed — no tunnel fetch in the interval); compare "
         "a state-limited draw's lanes against these numbers"
     )
     if result.get("provenance"):
@@ -224,8 +225,8 @@ def load_features(table, tr, te, asm=None):
     return train, test
 
 
-def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
-                peak=None, steady_ok=True):
+def neural_lane(name, train_set, config, model_kwargs=None, runs=3,
+                peak=None):
     """(model, stats) — stats carries the lane's full config and run
     variance so consecutive bench runs are comparable lane-for-lane
     (VERDICT r2 weak #4: a bench that can't distinguish a regression
@@ -245,7 +246,21 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     the scanned body once (per-step), so the short program reports the
     same per-step count as the full one.  The first full fit is a
     compile/warmup run and is not timed; the headline rate is the best
-    of `runs` timed executions, with median/std alongside.
+    of `runs` (>= 3 since r6 — VERDICT r5 item 3: the committed artifact
+    must carry a median and a non-zero std, so draw-to-draw swings are
+    quantified in the artifact itself) timed executions, with median/std
+    alongside.  Repeat fits reuse the estimator's warm-refit cache
+    (NeuralClassifier._fit_cache → Trainer._scan_cache), so a timed run
+    is init + one dispatch of the already-traced program on the already-
+    device-resident data — re-trace and tunnel re-upload are warmup
+    costs, not measured throughput.
+
+    The steady slope is computed on EVERY draw since r6 (VERDICT r5
+    item 2): degraded chip states are exactly when the in-program number
+    is needed, because the end-to-end one is tunnel-laden.  The warm
+    cache is what makes its anchoring affordable there — the second
+    clean short fit reuses the traced program, so the pre-r6 "skip the
+    slope when degraded" economy no longer buys anything.
     """
     from har_tpu.models.neural_classifier import NeuralClassifier
 
@@ -261,26 +276,24 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     # t_short anchors the steady-state slope, and an inflated value
     # biases steady_mfu_pct HIGH — so it takes the min over the warmup
     # (compile-inflated: trainer's t0 starts before tracing, so this
-    # sample is usually discarded) and TWO clean post-compile fits;
-    # one clean sample alone can catch the tunnel's 2-13 s overhead
-    # swing and silently flatter the metric.  In degraded-chip mode
-    # (steady_ok=False) the slope is discarded anyway, so skip the two
-    # clean fits — on the worst states they'd nearly double lane cost
-    # for a number that is never reported.
+    # sample is usually discarded) and one or two clean post-compile
+    # fits; one clean sample alone can catch the tunnel's 2-13 s
+    # overhead swing and silently flatter the metric.  The second clean
+    # fit is a warm-refit cache hit (execution-only), so it is cheap on
+    # exactly the draws where it matters most.
     t_short = float(warm_short.history["train_time_s"])
-    if steady_ok:
-        short_est = NeuralClassifier(
-            name,
-            config=dataclasses.replace(config, epochs=epochs_short),
-            model_kwargs=kwargs,
-        )
-        t_short = min(
-            t_short,
-            *(
-                float(short_est.fit(train_set).history["train_time_s"])
-                for _ in range(2)
-            ),
-        )
+    short_est = NeuralClassifier(
+        name,
+        config=dataclasses.replace(config, epochs=epochs_short),
+        model_kwargs=kwargs,
+    )
+    t_short = min(
+        t_short,
+        *(
+            float(short_est.fit(train_set).history["train_time_s"])
+            for _ in range(2)
+        ),
+    )
 
     est = NeuralClassifier(name, config=config, model_kwargs=kwargs)
     est.fit(train_set)  # warmup: compile the full program
@@ -301,13 +314,10 @@ def neural_lane(name, train_set, config, model_kwargs=None, runs=2,
     # rises measurably between the fits; for sub-second models the
     # difference drowns in the tunnel's overhead jitter and a clamped
     # near-zero slope would report absurd steady MFU — omit instead.
-    # steady_ok=False (degraded-chip mode) suppresses the fields
-    # entirely: reduced epochs + single runs make the slope noise-
-    # dominated exactly when chip jitter is worst, and equal short/full
-    # step counts (epochs reduced to 1) would "fit" pure jitter.
+    # (The caller keeps degraded-draw epochs >= a floor so the slope
+    # has steps to rise over — see lane_epochs.)
     steady_valid = (
-        steady_ok
-        and steps_full > steps_short
+        steps_full > steps_short
         and (t_full - t_short) > max(0.25, 0.05 * t_full)
     )
     program_flops = per_step_flops * steps_full
@@ -378,7 +388,11 @@ def main() -> None:
     from har_tpu.models.logistic_regression import LogisticRegression
     from har_tpu.ops.metrics import evaluate
     from har_tpu.train.trainer import TrainerConfig
-    from har_tpu.utils.mfu import chip_peak_flops, chip_state_probe
+    from har_tpu.utils.mfu import (
+        chip_peak_flops,
+        chip_state_probe,
+        degraded_resource,
+    )
 
     peak = chip_peak_flops()
 
@@ -393,6 +407,11 @@ def main() -> None:
     # Chip-state probe (har_tpu.utils.mfu.chip_state_probe): lets a
     # reader of one bench draw tell a state-limited run from a code
     # regression — the remote chip/tunnel has session-scale states.
+    # Since r6 the probe decomposes into compute_pct / tunnel_mb_s /
+    # dispatch_rtt_ms (VERDICT r5 items 1/6): the compute interval is
+    # device-timed (block_until_ready, no host fetch), so a degraded
+    # TUNNEL can no longer masquerade as a degraded CHIP and starve the
+    # >= HEALTHY_CHIP_PCT gate by construction.
     # Short settings: in a badly degraded state the probe itself gets
     # slow, and the budgeted bench must not spend 30s diagnosing it.
     chip_probe = (
@@ -419,17 +438,39 @@ def main() -> None:
     # run-count/steady-slope decisions
     degraded = reduction > 1 and not smoke
     reduced = degraded or smoke
+    # which resource the decomposed probe shows degraded (chip compute
+    # vs device→host tunnel vs dispatch RTT) — the draw's label must
+    # name it, not blame "the chip" for a slow fetch (VERDICT r5 item 6)
+    degraded_note = degraded_resource(
+        chip_probe, healthy_compute_pct=HEALTHY_CHIP_PCT
+    )
     if degraded:
         print(
-            f"warning: degraded chip state ({probe_pct}% of peak) — "
+            f"warning: degraded chip state ({probe_pct}% of bf16 peak, "
+            f"compute-only probe; decomposition: {degraded_note}) — "
             f"running lanes at epochs/{reduction}",
             file=sys.stderr,
         )
 
     def lane_epochs(e: int) -> int:
-        return max(1, e // reduction)
+        # floor 3 on real draws: the steady-state slope needs the full
+        # fit to run measurably more in-program steps than the
+        # epochs//5 short fit — a 1-epoch degraded lane has no slope to
+        # fit, and the degraded draw is exactly where steady_mfu_pct is
+        # the only trustworthy number (VERDICT r5 item 2).
+        # smoke caps at 1: its numbers are meaningless by design (the
+        # lane exists to exercise result assembly), and n_runs=3 × the
+        # 4+runs fits per lane otherwise overruns a slow CPU host's
+        # bench budget (neural_lane's slope fit self-disables at equal
+        # short/full step counts — steady_valid)
+        return 1 if smoke else max(3, e // reduction)
 
-    lane_runs = 1 if reduced else 2
+    # n_runs >= 3 on every draw (VERDICT r5 item 3): the committed
+    # artifact carries median + non-zero std, so two draws' headline
+    # numbers can be compared against in-artifact variance instead of
+    # against a better same-day draw someone remembers.  Affordable even
+    # degraded: repeat fits hit the warm-refit cache (execution-only).
+    lane_runs = 3
 
     table, is_real_data = load_table()
     # the reference's exact 3,793/1,625 rows — one membership, every view
@@ -475,7 +516,6 @@ def main() -> None:
         ),
         runs=lane_runs,
         peak=peak,
-        steady_ok=not reduced,
     )
     windows_per_sec = mlp_stats["windows_per_sec_best"]
     train_time = mlp_stats["train_time_s_best"]
@@ -639,7 +679,6 @@ def main() -> None:
             },
             runs=lane_runs,
             peak=peak,
-            steady_ok=not reduced,
         ),
     )
     cnn_wps = cnn_stats.get("windows_per_sec_best")
@@ -665,19 +704,22 @@ def main() -> None:
             model_kwargs={"bf16_stream": True, "remat": True},
             runs=lane_runs,
             peak=peak,
-            steady_ok=not reduced,
         ),
     )
     bilstm_wps = bilstm_stats.get("windows_per_sec_best")
 
     # Transformer encoder on the same raw windows (4th neural family,
-    # VERDICT r1 weak #3), XLA-fused attention (the measured winner at
-    # T=200 — artifacts/mfu_tune.json use_flash variants).  r5 shape:
-    # embed 256 x 8 heads over PATCH-8 embeddings (ViT-style strided
-    # conv, T 200→25) at batch 4096 — the roofline said short-T
-    # attention score traffic was the limiter (docs/roofline.md), and
-    # cutting T 8x measured 2.1x windows/s over the r4 unpatched config
-    # in the same session (10.7k → 22.7k at a 14.5%-state chip).
+    # VERDICT r1 weak #3).  r6 shape (the raw-lane overhaul —
+    # docs/roofline.md "Transformer"): embed 256 x 8 heads over PATCH-8
+    # embeddings (ViT-style strided conv, T 200→25) at batch 4096, with
+    # window_pack=8 gluing 8 post-patch windows into one 200-token
+    # block-diagonal sequence (the attention score matmuls tile the MXU
+    # at 200 rows instead of 25-row crumbs; packed-vs-unpacked logits
+    # are test-pinned equal) and scan_layers=True compiling the encoder
+    # stack as ONE scanned block body (faster compile, reused activation
+    # buffers).  Attention route is the auto policy: one masked GEMM at
+    # this packed length, the fused Pallas kernel past _FLASH_AUTO_T
+    # (measured loser at short packed lengths — mfu_tune packed rows).
     _, tfm_stats = deadline_lane(
         "transformer", 70,
         lambda: neural_lane(
@@ -694,32 +736,34 @@ def main() -> None:
             ),
             model_kwargs={
                 "embed_dim": 256, "num_heads": 8, "patch_size": 8,
+                "window_pack": 8, "scan_layers": True,
             },
             runs=lane_runs,
             peak=peak,
-            steady_ok=not reduced,
         ),
     )
     tfm_wps = tfm_stats.get("windows_per_sec_best")
-    # The 50k windows/s north star stays on the lane but the miss is
-    # self-documenting (VERDICT r4 item 8).  Measured program FLOPs put
-    # the patched encoder at 244 vs the CNN's 149 MFLOP/window (1.64x),
-    # while the same-draw throughput gap to the CNN lane is 12.7x
-    # (bench_latest 2026-07-31, 4.1% state: 214,340 vs 16,833 w/s) — so
-    # ~8x of the gap is EFFICIENCY, not model size: at T=25 the
-    # per-step attention/LayerNorm passes are bandwidth-bound and the
-    # tiny matmul shapes underfill the MXU — see docs/roofline.md
-    # "Transformer".  Only a lane that RAN carries the measurement
-    # prose (a deadline-skipped lane keeps its skip marker).
+    # The 50k windows/s north star stays on the lane but the gap is
+    # self-documenting (VERDICT r4 item 8).  r6 acceptance anchor: the
+    # committed r5 artifact measured 10,200.8 w/s (n_runs=1, 3.9%-state
+    # draw) — this lane's median must credit the packed/fused overhaul
+    # at >= 2x that at a comparable chip state, with the remaining
+    # distance to 50k accounted in docs/roofline.md "Transformer".
+    # Only a lane that RAN carries the measurement prose (a
+    # deadline-skipped lane keeps its skip marker).
     if tfm_wps is not None:
+        tfm_stats["r5_committed_windows_per_sec"] = 10200.8
         tfm_stats["note"] = (
-            "patch-8 ViT-style embedding (r5): T 200->25 before "
-            "attention; 2.1x the r4 unpatched rate same-session. 50k "
-            "w/s remains out of reach for this family at HAR sizes: "
-            "measured 244 vs 149 MFLOP/window vs the CNN (1.64x), "
-            "same-draw throughput gap 12.7x — the difference is "
-            "bandwidth-bound attention/norm passes and MXU-"
-            "underfilling shapes at T=25 (docs/roofline.md)"
+            "r6 packed/fused raw lane: fused QKV projection + "
+            "window_pack=8 block-diagonal attention (8 post-patch "
+            "windows -> one 200-token sequence; MXU-sized score tiles) "
+            "+ scanned encoder stack + bf16 streams with f32 "
+            "accumulation; warm-refit timing excludes re-trace/"
+            "re-upload from the timed region. Compare "
+            "windows_per_sec_median against r5_committed_windows_per_"
+            "sec (10.2k at a 3.9%-state draw) at a comparable chip "
+            "state; the remaining gap to the 50k target is accounted "
+            "in docs/roofline.md 'Transformer'"
         )
 
     # Raw-window accuracy lane (VERDICT r3 #4): synthesize windows whose
@@ -871,7 +915,6 @@ def main() -> None:
             model_kwargs=sat_kwargs,
             runs=lane_runs,
             peak=peak,
-            steady_ok=not reduced,
         )
 
     # last in line on purpose: at a degraded state its MFU number is
@@ -1086,8 +1129,15 @@ def main() -> None:
             ),
         },
         # adjacent to the numbers it qualifies: a degraded-chip draw's
-        # headline must carry its own label, not bury it in extra
+        # headline must carry its own label, not bury it in extra.
+        # degraded_note names WHICH resource the decomposed probe shows
+        # degraded (chip compute vs device→host tunnel vs dispatch RTT);
+        # it is recorded whenever ANY resource crosses its threshold —
+        # a compute-healthy draw through a slow tunnel still carries the
+        # tunnel's name, it just doesn't trigger lane reduction or lose
+        # the healthy-reference gate (per-spec compute-only)
         "degraded_chip_state": degraded,
+        "degraded_note": degraded_note,
         "chip_pct_of_peak": probe_pct,
         "captured_at": int(time.time()),
         "extra": extra,

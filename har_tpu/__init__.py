@@ -18,6 +18,26 @@ computations sharded over the device mesh.
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    # Older jax (< 0.5): shard_map lives in jax.experimental and the
+    # replication-check kwarg is named check_rep, not check_vma.  The
+    # codebase targets the new spelling; shim the old runtime up to it
+    # so one tree runs on both sides of the rename.
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def _shard_map_compat(
+        f, *, mesh, in_specs, out_specs, check_vma=None, **kw
+    ):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map_old(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    _jax.shard_map = _shard_map_compat
+
 from har_tpu.config import DataConfig, ModelConfig, TrainConfig, MeshConfig
 
 __all__ = [

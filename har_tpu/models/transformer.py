@@ -10,6 +10,28 @@ over the ring — bit-for-bit the same function, sequence-parallel.
 
 Both constructions share one parameter pytree, so a model trained
 single-device serves sequence-parallel and vice versa (tested).
+
+Throughput design (r6, the raw-lane overhaul — docs/roofline.md
+"Transformer"):
+  - Q/K/V are one fused (E, 3E) projection and the output projection is
+    a single Dense — four per-head matmuls never exist separately.
+  - ``window_pack=p`` packs p short windows into one attention sequence
+    under a block-diagonal mask (ops.flash_attention.segment_*): each
+    window still attends only itself (packed-vs-unpacked logits are
+    test-pinned equal), but every dense/norm pass sees one long
+    (B/p, p·T, E) activation stream and the attention runs either as
+    the fused Pallas kernel over the diagonal (scores never leave VMEM)
+    or as one large masked GEMM — MXU tiles instead of per-window
+    crumbs.
+  - Activations stream in bf16 with f32 accumulation everywhere a
+    reduction lives (attention scores/softmax, LayerNorm statistics) —
+    the same stream-narrow/accumulate-wide pattern as
+    FusedBiLSTMLayer's bf16_stream (docs/bilstm_profile.md).
+  - ``scan_layers=True`` runs the encoder stack as one ``nn.scan`` over
+    stacked per-layer parameters: XLA compiles ONE block body instead
+    of unrolling L copies (smaller program, faster compile) and reuses
+    the same activation buffers layer to layer instead of materializing
+    L distinct intermediates.
 """
 
 from __future__ import annotations
@@ -22,6 +44,8 @@ from har_tpu.ops.flash_attention import (
     MIN_HEAD_DIM,
     flash_attention,
     pick_block,
+    segment_attention,
+    segment_flash_attention,
 )
 from har_tpu.parallel.ring_attention import (
     full_attention,
@@ -38,16 +62,16 @@ from har_tpu.parallel.ring_attention import (
 # runs to T=65536).
 _FLASH_AUTO_T = 8192
 
+# minimum per-window token count for the packed-lane Pallas route: the
+# kernel's segment-folded blocks need >= 8 rows AND 8-row (sublane)
+# alignment — below/unaligned, the masked-GEMM path runs
+_MIN_SEG = 8
 
-def sinusoidal_positions(t: int, dim: int, offset) -> jax.Array:
-    """Standard sin/cos positional encoding, positions offset (traced ok)."""
-    pos = jnp.arange(t, dtype=jnp.float32) + offset
-    half = dim // 2
-    freqs = jnp.exp(
-        -jnp.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
-    )
-    angles = pos[:, None] * freqs[None, :]
-    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+def _seg_flash_legal(seg: int, head_dim: int) -> bool:
+    """Shapes the segment-folded Pallas route accepts (one kernel block
+    per window: >= 8 rows, sublane-aligned, supported head dim)."""
+    return head_dim >= MIN_HEAD_DIM and seg >= _MIN_SEG and seg % 8 == 0
 
 
 class EncoderBlock(nn.Module):
@@ -56,8 +80,14 @@ class EncoderBlock(nn.Module):
     sp_axis: str | None
     # None = auto: Pallas flash attention for T >= _FLASH_AUTO_T (the
     # measured crossover — see _FLASH_AUTO_T's comment); plain XLA below
-    # it (faster at short T, same numerics family)
+    # it (faster at short T, same numerics family).  In packed mode
+    # (seg is not None) auto routes the diagonal through the kernel on
+    # TPU whenever the shape is legal, the masked GEMM otherwise.
     use_flash: bool | None = None
+    # block-diagonal attention segment length (window packing): tokens
+    # [i*seg, (i+1)*seg) attend only within their own segment.  None =
+    # ordinary full attention over the sequence.
+    seg: int | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -71,7 +101,36 @@ class EncoderBlock(nn.Module):
         q = q.reshape(b, t, h, head_dim)
         k = k.reshape(b, t, h, head_dim)
         v = v.reshape(b, t, h, head_dim)
-        if self.sp_axis is not None:
+        if self.seg is not None:
+            # packed windows: block-diagonal attention, two exact routes
+            # (fused per-window kernel vs one big masked GEMM)
+            flash_ok = _seg_flash_legal(self.seg, head_dim)
+            if self.use_flash and not flash_ok:
+                # same contract as the other paths: an explicit flash
+                # request the kernel refuses must fail loudly
+                raise ValueError(
+                    "use_flash=True with window packing requires "
+                    f"head_dim >= {MIN_HEAD_DIM} and per-window tokens "
+                    f">= {_MIN_SEG} in multiples of 8; got "
+                    f"head_dim={head_dim}, seg={self.seg}"
+                )
+            # auto: the masked GEMM materializes (T, T) scores for the
+            # whole PACKED length, so its cost crosses the kernel's at
+            # the same packed-sequence length as unpacked full attention
+            # — reuse _FLASH_AUTO_T on t (the packed length), gated on
+            # kernel legality for the per-window block
+            seg_flash = (
+                jax.default_backend() == "tpu"
+                and flash_ok
+                and t >= _FLASH_AUTO_T
+                if self.use_flash is None
+                else self.use_flash
+            )
+            if seg_flash:
+                attn = segment_flash_attention(q, k, v, self.seg)
+            else:
+                attn = segment_attention(q, k, v, self.seg)
+        elif self.sp_axis is not None:
             # per-hop local attention: the einsum ring materializes a
             # (B, H, T_local, T_local) score tile per hop; once the
             # local block crosses the same threshold as the single-chip
@@ -133,6 +192,24 @@ class EncoderBlock(nn.Module):
         return x + y
 
 
+class _ScanEncoderBlock(nn.Module):
+    """Carry adapter: EncoderBlock under ``nn.scan`` (x is the carry)."""
+
+    num_heads: int
+    dtype: jnp.dtype
+    sp_axis: str | None
+    use_flash: bool | None
+    seg: int | None
+
+    @nn.compact
+    def __call__(self, x, _):
+        x = EncoderBlock(
+            self.num_heads, self.dtype, self.sp_axis, self.use_flash,
+            seg=self.seg,
+        )(x)
+        return x, None
+
+
 class Transformer1D(nn.Module):
     """Encoder classifier: (B, T, C) raw windows → (B, num_classes)."""
 
@@ -153,9 +230,29 @@ class Transformer1D(nn.Module):
     # halo exchange and the sp ring path works unchanged on patched
     # sequences.
     patch_size: int = 1
+    # window_pack > 1 packs that many windows into one block-diagonal
+    # attention sequence AFTER patch embedding (see the module
+    # docstring).  Batches not divisible by the pack are zero-padded and
+    # the padding windows sliced back off — block-diagonality means
+    # padding can never leak into real windows.  Mutually exclusive
+    # with sp_axis (the ring shards one long sequence; packing glues
+    # many short ones).
+    window_pack: int = 1
+    # scan_layers=True compiles the encoder stack as one nn.scan over
+    # stacked per-layer params (one block body, reused buffers) instead
+    # of num_layers unrolled copies.  Parameter layout differs (leaves
+    # gain a leading layer axis under "blocks"), so it is opt-in; the
+    # bench lane uses it, parity-era checkpoints predate it.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
+        if self.window_pack > 1 and self.sp_axis is not None:
+            raise ValueError(
+                "window_pack and sp_axis are mutually exclusive: the "
+                "ring sequence-shards one long window, packing glues "
+                "many short ones"
+            )
         x = x.astype(self.dtype)
         b, t, _ = x.shape
         if self.patch_size > 1:
@@ -181,18 +278,52 @@ class Transformer1D(nn.Module):
             offset = (jax.lax.axis_index(self.sp_axis) * t).astype(
                 jnp.float32
             )
+        # positions are per-window and applied BEFORE packing, so every
+        # packed window carries the identical encoding it would alone
         x = x + sinusoidal_positions(t, self.embed_dim, offset).astype(
             self.dtype
         )
-        for _ in range(self.num_layers):
-            x = EncoderBlock(
-                self.num_heads, self.dtype, self.sp_axis, self.use_flash
-            )(x, train=train)
+        seg = None
+        pack_pad = 0
+        if self.window_pack > 1:
+            pack_pad = (-b) % self.window_pack
+            if pack_pad:
+                x = jnp.concatenate(
+                    [x, jnp.zeros((pack_pad, t, self.embed_dim), x.dtype)],
+                    axis=0,
+                )
+            x = x.reshape(
+                (b + pack_pad) // self.window_pack,
+                self.window_pack * t,
+                self.embed_dim,
+            )
+            seg = t
+        if self.scan_layers:
+            x, _ = nn.scan(
+                _ScanEncoderBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.num_layers,
+            )(
+                self.num_heads, self.dtype, self.sp_axis, self.use_flash,
+                seg, name="blocks",
+            )(x, None)
+        else:
+            for _ in range(self.num_layers):
+                x = EncoderBlock(
+                    self.num_heads, self.dtype, self.sp_axis,
+                    self.use_flash, seg=seg,
+                )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        pooled = x.mean(axis=1)
-        if self.sp_axis is not None:
-            # local mean → global mean (equal-size shards around the ring)
-            pooled = jax.lax.pmean(pooled, self.sp_axis)
+        if self.window_pack > 1:
+            # per-window mean-pool, then drop the padding windows
+            x = x.reshape(-1, self.window_pack, t, self.embed_dim)
+            pooled = x.mean(axis=2).reshape(-1, self.embed_dim)[:b]
+        else:
+            pooled = x.mean(axis=1)
+            if self.sp_axis is not None:
+                # local mean → global mean (equal-size shards on the ring)
+                pooled = jax.lax.pmean(pooled, self.sp_axis)
         pooled = nn.Dropout(self.dropout_rate, deterministic=not train)(
             pooled
         )
@@ -200,3 +331,14 @@ class Transformer1D(nn.Module):
             pooled
         )
         return logits.astype(jnp.float32)
+
+
+def sinusoidal_positions(t: int, dim: int, offset) -> jax.Array:
+    """Standard sin/cos positional encoding, positions offset (traced ok)."""
+    pos = jnp.arange(t, dtype=jnp.float32) + offset
+    half = dim // 2
+    freqs = jnp.exp(
+        -jnp.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    angles = pos[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)

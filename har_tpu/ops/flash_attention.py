@@ -36,6 +36,12 @@ from jax.experimental.pallas import tpu as pltpu
 # imports this so the gate and the guard cannot drift apart.
 MIN_HEAD_DIM = 32
 
+# jax renamed pltpu.TPUCompilerParams → CompilerParams; support both so
+# the kernel runs on either side of the rename
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 
 def pick_block(t: int, max_block: int = 512) -> int:
     """Largest divisor of ``t`` that is ≤ max_block (kernel needs uniform
@@ -156,7 +162,7 @@ def _flash_bht(q, k, v, block_q: int, block_k: int, with_lse: bool = False):
         ],
         # (bh, q-block) steps own disjoint outputs; the k dimension
         # carries the softmax state through scratch, so it is sequential
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=jax.default_backend() != "tpu",
@@ -376,3 +382,77 @@ def _flash_bwd(block_q, block_k, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Block-diagonal (packed-window) attention.
+#
+# The raw-HAR transformer attends over short windows (T≈200 samples → 25
+# post-patch tokens).  Packing ``p`` windows into one sequence of length
+# p·seg under a block-diagonal mask is mathematically per-window
+# attention — each window sees only itself — but it changes what the MXU
+# sees: one (B/p, p·seg, E) activation stream for every dense/norm pass,
+# and an attention whose score tiles can either stay per-window
+# (the fused kernel below — zero off-diagonal work, scores never leave
+# VMEM) or fill large masked tiles (the XLA path — fewer, bigger GEMMs).
+# Both are exact; which is faster is measured per-shape (the packed rows
+# of ``scripts/mfu_tune.py transformer`` write the numbers that pick the
+# bench lane's route).
+# ---------------------------------------------------------------------------
+
+
+def _fold_segments(x, seg: int):
+    """(B, T, H, D) → (B·T/seg, seg, H, D): contiguity-preserving."""
+    b, t, h, d = x.shape
+    return x.reshape(b * (t // seg), seg, h, d)
+
+
+def segment_flash_attention(q, k, v, seg: int):
+    """Block-diagonal attention via the Pallas kernel, (B, T, H, D).
+
+    Segments of length ``seg`` (T % seg == 0) attend only within
+    themselves.  Folding segments into the batch dimension makes each
+    segment exactly one kernel block — grid (B·n_seg·H, 1, 1) — so the
+    diagonal is computed with no off-diagonal score work, the softmax
+    state never leaves VMEM, and the existing custom_vjp backward
+    applies per segment unchanged.
+    """
+    b, t, h, d = q.shape
+    if t % seg:
+        raise ValueError(f"segment length {seg} must divide T={t}")
+    if seg < 8 or seg % 8:
+        raise ValueError(
+            f"segment length {seg} must be a multiple of 8 (the kernel's "
+            "sublane block granularity); use segment_attention"
+        )
+    out = flash_attention(
+        _fold_segments(q, seg), _fold_segments(k, seg),
+        _fold_segments(v, seg), block_q=seg, block_k=seg,
+    )
+    return out.reshape(b, t, h, d)
+
+
+def segment_attention(q, k, v, seg: int):
+    """Block-diagonal attention via one masked XLA einsum, (B, T, H, D).
+
+    The big-tile route: scores for the whole packed sequence are one
+    (B, H, T, T) f32 GEMM with an additive block-diagonal mask — p× the
+    diagonal's FLOPs, but large MXU tiles instead of per-window crumbs,
+    and XLA fuses mask+softmax into the score pass.  Exact (identical
+    softmax over each window's finite row support).
+    """
+    b, t, h, d = q.shape
+    if t % seg:
+        raise ValueError(f"segment length {seg} must divide T={t}")
+    scale = d**-0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    seg_id = jnp.arange(t, dtype=jnp.int32) // seg
+    mask = seg_id[:, None] == seg_id[None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)

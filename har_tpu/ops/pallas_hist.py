@@ -33,6 +33,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# one shim for the pltpu.TPUCompilerParams → CompilerParams rename,
+# shared with the flash kernel so the two can't drift
+from har_tpu.ops.flash_attention import _CompilerParams
+
 # feature tile must keep the bins block's lane dim at 128; the row tile is
 # sized so the two (NT, DT·B) f32 VMEM temporaries fit comfortably
 _DT = 128
@@ -103,7 +107,7 @@ def _hist_padded(bins, m, max_bins: int):
         ),
         # feature tiles are independent; row tiles accumulate into the
         # same output block and must stay sequential
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=jax.default_backend() != "tpu",

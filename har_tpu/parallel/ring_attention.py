@@ -24,6 +24,13 @@ import jax
 import jax.numpy as jnp
 
 
+def _axis_size(axis_name: str) -> int:
+    """Mesh-axis size; jax.lax.axis_size only exists on newer jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Reference O(T²) attention, (B, T, H, D) layout, no masking."""
     scale = q.shape[-1] ** -0.5
@@ -44,7 +51,7 @@ def ring_attention(
     *local* sequence block, shape (B, T_local, H, D).  Returns the local
     block of the attention output, same shape.
     """
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     scale = q.shape[-1] ** -0.5
     b, t_q, h, d = q.shape
 
@@ -115,7 +122,7 @@ def ring_flash_attention(
         pick_block,
     )
 
-    axis_size = jax.lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     b, t_q, h, d = q.shape
     blk = block or pick_block(k.shape[1])
     if not blk:

@@ -63,21 +63,27 @@ def main() -> None:
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
 
-    from har_tpu.utils.mfu import chip_state_probe
+    from har_tpu.utils.mfu import chip_state_probe, degraded_resource
 
     probe = chip_state_probe()
     if probe is None:
         print(json.dumps({"error": "probe failed to run"}))
         return
     pct = probe.get("pct_of_peak")
+    # r6: the probe decomposes chip compute / tunnel bandwidth / dispatch
+    # RTT (VERDICT r5 item 1) — the verdict names WHICH resource is
+    # degraded instead of blaming "the chip" for a slow fetch
+    slow = degraded_resource(probe)
     out = {
         **probe,
         "backend": jax.default_backend(),
         "verdict": (
             "unknown chip peak — cannot judge" if pct is None
-            else "healthy" if pct > 70.0
-            else "DEGRADED chip/tunnel state — treat this session's "
-                 "bench draws as state-limited"
+            else "healthy" if pct > 70.0 and slow is None
+            else f"DEGRADED: {slow} — treat this session's bench draws "
+                 "as state-limited" if slow is not None
+            else "chip compute below healthy band — bench draws are "
+                 "state-limited"
         ),
     }
     print(json.dumps(out))  # the one-shot output, before any logging
@@ -89,6 +95,8 @@ def main() -> None:
                 ),
                 "pct_of_peak": pct,
                 "matmul_tflops": probe.get("matmul_tflops"),
+                "tunnel_mb_s": probe.get("tunnel_mb_s"),
+                "dispatch_rtt_ms": probe.get("dispatch_rtt_ms"),
             }
         )
 

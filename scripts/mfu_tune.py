@@ -176,6 +176,35 @@ def main(argv):
                  model_kwargs={"embed_dim": 256, "num_heads": 4}),
             dict(batch=1024, epochs_short=10, epochs_full=60,
                  model_kwargs={"embed_dim": 512, "num_heads": 8}),
+            # r6 packed/fused raw lane (docs/roofline.md "Transformer"):
+            # patch-8 embedding + window_pack gluing p post-patch
+            # windows into one block-diagonal sequence — the attention
+            # score matmuls tile the MXU at p*25 rows instead of 25-row
+            # crumbs — with the encoder stack compiled as one scanned
+            # block.  The pack sweep prices the masked GEMM's p× score
+            # FLOPs against its tiling win; the use_flash row measures
+            # the segment-folded Pallas kernel ON the training path
+            # (seg=25 is sublane-misaligned, so the kernel row uses
+            # patch 5 → seg 40, the aligned neighbor shape).
+            dict(batch=4096, epochs_short=5, epochs_full=25,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "patch_size": 8, "scan_layers": True}),
+            dict(batch=4096, epochs_short=5, epochs_full=25,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "patch_size": 8, "window_pack": 4,
+                               "scan_layers": True}),
+            dict(batch=4096, epochs_short=5, epochs_full=25,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "patch_size": 8, "window_pack": 8,
+                               "scan_layers": True}),
+            dict(batch=4096, epochs_short=5, epochs_full=25,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "patch_size": 8, "window_pack": 16,
+                               "scan_layers": True}),
+            dict(batch=4096, epochs_short=5, epochs_full=25,
+                 model_kwargs={"embed_dim": 256, "num_heads": 8,
+                               "patch_size": 5, "window_pack": 8,
+                               "use_flash": True, "scan_layers": True}),
         ],
         "bilstm": [
             dict(batch=2048, epochs_short=10, epochs_full=60,

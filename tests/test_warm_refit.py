@@ -1,0 +1,95 @@
+"""Warm-refit cache: repeat bench fits time the compiled program, not
+the harness (r6 measurement layer).
+
+A bench lane times several fits of the SAME (estimator, data) workload;
+pre-r6 each timed fit re-traced the scanned program and re-uploaded the
+dataset through the (possibly degraded) device tunnel inside the timed
+region.  The cache (NeuralClassifier._fit_cache → Trainer._scan_cache)
+must make repeats execution-only — and must be numerically invisible.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from har_tpu.features.wisdm_pipeline import FeatureSet
+from har_tpu.models.neural_classifier import NeuralClassifier
+from har_tpu.train.trainer import TrainerConfig
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return FeatureSet(
+        features=rng.normal(size=(n, 13)).astype(np.float32),
+        label=rng.integers(0, 6, n).astype(np.int32),
+    )
+
+
+def _flat(model):
+    return np.asarray(
+        jax.flatten_util.ravel_pytree(model.inner.params)[0]
+    )
+
+
+def test_warm_refit_hits_and_is_bit_identical():
+    data = _data()
+    est = NeuralClassifier(
+        "mlp", config=TrainerConfig(batch_size=16, epochs=3),
+        model_kwargs={"hidden": (8,)},
+    )
+    m1, m2 = est.fit(data), est.fit(data)
+    assert m1.history["warm_refit"] is False
+    assert m2.history["warm_refit"] is True
+    # the cache reuses program + device data, never training state:
+    # same seed => the refit must be BIT-identical, not just close
+    assert (_flat(m1) == _flat(m2)).all()
+
+
+def test_different_data_object_misses_but_agrees():
+    data = _data()
+    clone = FeatureSet(
+        features=data.features.copy(), label=data.label.copy()
+    )
+    est = NeuralClassifier(
+        "mlp", config=TrainerConfig(batch_size=16, epochs=3),
+        model_kwargs={"hidden": (8,)},
+    )
+    m1 = est.fit(data)
+    m3 = est.fit(clone)
+    assert m3.history["warm_refit"] is False
+    np.testing.assert_allclose(_flat(m1), _flat(m3), rtol=1e-6, atol=1e-7)
+
+
+def test_copy_with_does_not_share_cache():
+    """A config-changed copy must not hit the original's cache (it would
+    run the wrong program)."""
+    data = _data()
+    est = NeuralClassifier(
+        "mlp", config=TrainerConfig(batch_size=16, epochs=3),
+        model_kwargs={"hidden": (8,)},
+    )
+    est.fit(data)
+    longer = est.copy_with(
+        config=dataclasses.replace(est.config, epochs=5)
+    )
+    m = longer.fit(data)
+    assert m.history["warm_refit"] is False
+    assert len(m.history["loss"]) == 5  # per-epoch losses: 5 epochs ran
+
+
+def test_streaming_path_untouched():
+    """The cache is scan-path only; the streaming trainer keeps its
+    per-batch dispatch semantics."""
+    from har_tpu.models.neural import build_model
+    from har_tpu.train.trainer import Trainer
+
+    data = _data()
+    tr = Trainer(
+        build_model("mlp", num_classes=6, hidden=(8,)),
+        TrainerConfig(batch_size=16, epochs=2),
+        scan=False,
+    )
+    m = tr.fit(data.features, data.label, num_classes=6)
+    assert "warm_refit" not in m.history
+    assert np.isfinite(m.history["loss"][-1])
