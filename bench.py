@@ -377,7 +377,12 @@ def main() -> None:
     )
 
     # persistent compilation cache: repeat bench runs (and the driver's
-    # round-end run) skip recompiling unchanged programs
+    # round-end run) skip recompiling unchanged programs.  Known caveat
+    # (tests/conftest.py r7 note): a DESERIALIZED executable is not
+    # bit-identical to a fresh compile on this jaxlib — fine here
+    # (throughput lanes measure time; the bit-exact parity lanes run on
+    # host/native float64 math, not cached XLA executables), but the
+    # test suite runs cache-OFF for exactly that reason.
     jax.config.update("jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
@@ -887,6 +892,91 @@ def main() -> None:
                 file=sys.stderr,
             )
 
+    # Fleet-serving lane (r7 tentpole): continuous batching of N
+    # concurrent synthetic 20 Hz sessions through har_tpu.serve's
+    # micro-batcher — the population-scale counterpart of the per-hop
+    # serving lane above.  Reports per-EVENT latency (enqueue→dispatch,
+    # the fleet SLO number) and aggregate scored windows/s at n_runs>=3
+    # with median+std, model = the calibrated raw-window CNN when the
+    # raw lane ran (falls back to the training-free analytic demo model
+    # — then the number isolates scheduler overhead and says so).  The
+    # chip-state probe fields are stamped INTO the lane so a degraded
+    # draw's fleet numbers carry their own state label.
+    def _fleet_lane():
+        from har_tpu.serve import (
+            AnalyticDemoModel,
+            FleetConfig,
+            FleetServer,
+            drive_fleet,
+            synthetic_sessions,
+        )
+
+        fleet_model = cal_model
+        model_name = "cnn1d_calibrated"
+        if fleet_model is None:
+            fleet_model = AnalyticDemoModel()
+            model_name = "analytic_demo"
+        n_sessions = 32 if smoke else 512
+        recordings, _ = synthetic_sessions(
+            n_sessions, windows_per_session=2, seed=3
+        )
+
+        def one_run():
+            server = FleetServer(
+                fleet_model,
+                window=200,
+                hop=200,
+                smoothing="ema",
+                config=FleetConfig(max_sessions=n_sessions),
+            )
+            for i in range(n_sessions):
+                server.add_session(i)
+            _, report = drive_fleet(server, recordings, seed=3)
+            snap = server.stats_snapshot()
+            return server, report, snap
+
+        one_run()  # warmup: compile the padded batch programs
+        wps, p50s, p99s, dropped, dispatches = [], [], [], 0, []
+        server = None
+        for _ in range(lane_runs):
+            server, report, snap = one_run()
+            acct = snap["accounting"]
+            wps.append(
+                acct["scored"] / report.duration_s
+                if report.duration_s
+                else 0.0
+            )
+            ev = snap["stages"]["event_ms"]
+            p50s.append(ev.get("p50_ms") or 0.0)
+            p99s.append(ev.get("p99_ms") or 0.0)
+            dropped += acct["dropped"]
+            dispatches.append(snap["dispatches"])
+        try:
+            server.calibrate_device()  # cnn only; ValueError for stubs
+        except ValueError:
+            pass
+        snap = server.stats_snapshot()
+        stats = {
+            "model": model_name,
+            "n_sessions": n_sessions,
+            "windows_per_session": 2,
+            "n_runs": lane_runs,
+            "windows_per_sec_best": round(max(wps), 1),
+            "windows_per_sec_median": round(float(np.median(wps)), 1),
+            "windows_per_sec_std": round(float(np.std(wps)), 1),
+            "event_p50_ms_median": round(float(np.median(p50s)), 3),
+            "event_p99_ms_median": round(float(np.median(p99s)), 3),
+            "event_p99_ms_std": round(float(np.std(p99s)), 3),
+            "dropped_windows": dropped,
+            "dispatches_per_run": dispatches,
+            "fleet_stats": snap,
+            # the r6 decomposed probe fields, stamped per-lane
+            "chip_state_probe": chip_probe,
+        }
+        return None, stats
+
+    _, fleet_stats = deadline_lane("fleet_serving", 40, _fleet_lane)
+
     # Chip-saturation lane (VERDICT r2 weak #1/item 3): a transformer
     # sized for the MXU — embed 768 (12 heads x 64), 4 layers, bf16
     # params/activations, batch 1024 over a larger synthetic stream —
@@ -932,6 +1022,14 @@ def main() -> None:
         ucihar = ucihar_parity_lane()
     except Exception as exc:
         ucihar = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+    if ucihar.get("skipped"):
+        # loud on stderr, not just buried in the JSON extra: the lane
+        # must stay armed — the moment a real dataset tree appears the
+        # 91.9% claim becomes a measurement (VERDICT r5 item 7)
+        print(
+            f"note: ucihar_parity lane skipped — {ucihar['skipped']}",
+            file=sys.stderr,
+        )
 
     # Real-raw-WISDM accuracy lane (VERDICT r4 #3): the ≥0.97 raw-window
     # claim becomes a measurement the moment WISDM_ar_v1.1_raw.txt is
@@ -963,6 +1061,13 @@ def main() -> None:
             )
     except Exception as exc:
         wisdm_raw = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+    if wisdm_raw.get("skipped"):
+        # same loudness contract as the ucihar lane above
+        print(
+            f"note: wisdm_raw_parity lane skipped — "
+            f"{wisdm_raw['skipped']}",
+            file=sys.stderr,
+        )
 
     # Device-parallel CV sweep scaling (VERDICT r3 #7): measured by
     # scripts/cv_scaling.py on an 8-device virtual CPU mesh (virtual
@@ -1042,6 +1147,15 @@ def main() -> None:
         # per-hop wall latency of the streaming serving path (carries a
         # "skipped"/"error" marker instead of stats when it didn't run)
         "serving_latency_ms": serving_latency,
+        # fleet serving (har_tpu.serve): population-scale continuous
+        # batching — flat headline keys here, full stats in lanes
+        "fleet_sessions": fleet_stats.get("n_sessions"),
+        "fleet_windows_per_sec_median": fleet_stats.get(
+            "windows_per_sec_median"
+        ),
+        "fleet_event_p50_ms": fleet_stats.get("event_p50_ms_median"),
+        "fleet_event_p99_ms": fleet_stats.get("event_p99_ms_median"),
+        "fleet_dropped_windows": fleet_stats.get("dropped_windows"),
         "ucihar_parity": ucihar,
         "wisdm_raw_parity": wisdm_raw,
         "cv_sweep_scaling": cv_scaling,
@@ -1105,6 +1219,7 @@ def main() -> None:
         "bilstm": bilstm_stats,
         "transformer": tfm_stats,
         "saturation_transformer": sat_stats,
+        "fleet_serving": fleet_stats,
     }
     result = {
         "metric": "wisdm_mlp_train_throughput",

@@ -40,6 +40,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <nav><a href="index.html">overview</a> ·
 <a href="architecture.html">architecture</a> ·
 <a href="parallelism.html">parallelism</a> ·
+<a href="serving.html">serving</a> ·
 <a href="api.html">api</a></nav>
 {body}
 </body>

@@ -9,12 +9,14 @@ Usage:
   python -m har_tpu.cli train    --models mlp --epochs 150
   python -m har_tpu.cli evaluate --checkpoint models/lr
   python -m har_tpu.cli predict  --checkpoint models/lr --output preds.csv
+  python -m har_tpu.cli serve    --sessions 1000
   python -m har_tpu.cli bench
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 
@@ -190,6 +192,54 @@ def _parser() -> argparse.ArgumentParser:
                     help="input-drift detection against the checkpoint's "
                          "training statistics; events are stamped and the "
                          "summary carries the final drift report")
+
+    sv = sub.add_parser(
+        "serve",
+        help="fleet serving smoke: multiplex N concurrent synthetic "
+             "20 Hz sessions through the continuous-batching engine "
+             "(har_tpu.serve) and report FleetStats + p50/p99 event "
+             "latency",
+    )
+    sv.add_argument("--sessions", type=int, default=1000,
+                    help="concurrent sessions to admit and drive")
+    sv.add_argument("--windows-per-session", type=int, default=2,
+                    help="10 s windows each session streams")
+    sv.add_argument("--checkpoint", default=None,
+                    help="serve a saved neural checkpoint; default is "
+                         "the training-free analytic demo model "
+                         "(scheduler-overhead baseline)")
+    sv.add_argument("--hop", type=int, default=200,
+                    help="emission stride in samples (200 = one "
+                         "decision per 10 s window)")
+    sv.add_argument("--smoothing", default="ema",
+                    choices=["ema", "vote", "none"])
+    sv.add_argument("--target-batch", type=int, default=256,
+                    help="micro-batcher dispatch size (power-of-two "
+                         "padded; at most log2+1 programs compile)")
+    sv.add_argument("--max-delay-ms", type=float, default=50.0,
+                    help="deadline: max time a due window waits for "
+                         "batch coalescing")
+    sv.add_argument("--monitor", action="store_true",
+                    help="attach a per-session DriftMonitor (synthetic "
+                         "training stats); drift verdicts flow into "
+                         "the multiplexed event stream")
+    sv.add_argument("--calibrate-device", action="store_true",
+                    help="measure device p50 per dispatched batch "
+                         "shape (checkpoint models only) so the stats "
+                         "attribute p99 spikes to tunnel vs chip")
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--inject-drop", type=float, default=0.0,
+                    help="probability a delivery chunk is lost")
+    sv.add_argument("--inject-delay", type=float, default=0.0,
+                    help="probability a chunk is held one round "
+                         "(catch-up burst)")
+    sv.add_argument("--inject-stall-ms", type=float, default=0.0,
+                    help="with --inject-stall-every: dispatch stall "
+                         "length (exercises the SLO/degradation "
+                         "ladder)")
+    sv.add_argument("--inject-stall-every", type=int, default=0,
+                    help="stall every Nth dispatch by "
+                         "--inject-stall-ms")
 
     ft = sub.add_parser(
         "finetune",
@@ -463,6 +513,135 @@ def main(argv=None) -> int:
                     ),
                     "platforms": args.platforms,
                     "quantized": art_meta.get("quantization"),
+                }
+            )
+        )
+        return 0
+
+    if args.command == "serve":
+        import numpy as np
+
+        from har_tpu.serve import (
+            AnalyticDemoModel,
+            DeliveryFaults,
+            DispatchFaults,
+            FleetConfig,
+            FleetServer,
+            drive_fleet,
+            synthetic_sessions,
+        )
+
+        window, channels = 200, 3
+        if args.checkpoint is not None:
+            from har_tpu.checkpoint import load_model, load_model_meta
+
+            model = load_model(args.checkpoint)
+            # honor the checkpoint's recorded geometry (the same guard
+            # StreamingClassifier.from_checkpoint enforces): a pooled
+            # CNN would silently score 200-sample windows it was never
+            # trained on — serve at the trained shape instead
+            try:
+                shape = load_model_meta(args.checkpoint).get("input_shape")
+            except OSError:
+                shape = None
+            if shape and len(shape) == 2:
+                window, channels = int(shape[0]), int(shape[1])
+            if channels != 3:
+                raise SystemExit(
+                    f"checkpoint records input_shape={shape}; the "
+                    "synthetic fleet load generator emits tri-axial "
+                    "(n, 3) streams — serve this checkpoint behind a "
+                    "matching transport instead"
+                )
+        else:
+            # training-free analytic model: the scheduler-overhead
+            # baseline (a checkpoint adds device dispatch on top)
+            model = AnalyticDemoModel()
+        recordings, class_names = synthetic_sessions(
+            args.sessions,
+            windows_per_session=args.windows_per_session,
+            window=window,
+            seed=args.seed,
+        )
+        fault_hook = None
+        if args.inject_stall_every:
+            fault_hook = DispatchFaults(
+                stall_every=args.inject_stall_every,
+                stall_ms=args.inject_stall_ms,
+            )
+        server = FleetServer(
+            model,
+            window=window,
+            channels=channels,
+            hop=args.hop,
+            smoothing=args.smoothing,
+            class_names=class_names,
+            config=FleetConfig(
+                max_sessions=args.sessions,
+                target_batch=args.target_batch,
+                max_delay_ms=args.max_delay_ms,
+            ),
+            fault_hook=fault_hook,
+        )
+        monitor_ref = None
+        if args.monitor:
+            # population statistics of the generated fleet as the
+            # training reference; one independent DriftMonitor per
+            # session (per-session EWMA state)
+            pool = np.concatenate(recordings)
+            monitor_ref = (pool.mean(axis=0), pool.std(axis=0))
+        from har_tpu.monitoring import DriftMonitor
+
+        for i in range(args.sessions):
+            server.add_session(
+                i,
+                monitor=(
+                    DriftMonitor(*monitor_ref)
+                    if monitor_ref is not None
+                    else None
+                ),
+            )
+        events, report = drive_fleet(
+            server,
+            recordings,
+            seed=args.seed,
+            faults=DeliveryFaults(
+                drop_prob=args.inject_drop, delay_prob=args.inject_delay
+            ),
+        )
+        if args.calibrate_device:
+            try:
+                server.calibrate_device()
+            except ValueError as e:
+                print(f"warning: device calibration skipped: {e}",
+                      file=sys.stderr)
+        snap = server.stats_snapshot()
+        acct = snap["accounting"]
+        print(
+            json.dumps(
+                {
+                    "sessions": args.sessions,
+                    "n_events": len(events),
+                    "enqueued": acct["enqueued"],
+                    "scored": acct["scored"],
+                    "dropped": acct["dropped"],
+                    "windows_per_sec": (
+                        round(acct["scored"] / report.duration_s, 1)
+                        if report.duration_s
+                        else None
+                    ),
+                    "event_p50_ms": snap["stages"]["event_ms"].get(
+                        "p50_ms"
+                    ),
+                    "event_p99_ms": snap["stages"]["event_ms"].get(
+                        "p99_ms"
+                    ),
+                    "degraded_events": snap["degraded_events"],
+                    "drift_events": sum(
+                        1 for ev in events if ev.event.drift
+                    ),
+                    "load": dataclasses.asdict(report),
+                    "stats": snap,
                 }
             )
         )

@@ -1,0 +1,54 @@
+"""Fleet serving: continuous batching for thousands of concurrent
+20 Hz accelerometer streams over one compiled predict path.
+
+Public surface:
+  FleetServer / FleetConfig / FleetEvent  — the engine (engine.py)
+  FleetStats                              — observability (stats.py)
+  DispatchFaults / DeliveryFaults / FakeClock — fault injection
+  AnalyticDemoModel / synthetic_sessions / drive_fleet — load generation
+  fleet_slo_smoke                         — the release gate's check
+
+See docs/serving.md for the architecture and the equivalence contract.
+"""
+
+from har_tpu.serve.engine import (
+    AdmissionError,
+    DispatchError,
+    FleetConfig,
+    FleetEvent,
+    FleetServer,
+)
+from har_tpu.serve.faults import (
+    DeliveryFaults,
+    DispatchFaults,
+    FakeClock,
+    InjectedDispatchFailure,
+)
+from har_tpu.serve.loadgen import (
+    AnalyticDemoModel,
+    LoadReport,
+    drive_fleet,
+    synthetic_sessions,
+)
+from har_tpu.serve.slo import events_equal, fleet_slo_smoke
+from har_tpu.serve.stats import FleetStats, StageHistogram
+
+__all__ = [
+    "AdmissionError",
+    "AnalyticDemoModel",
+    "DeliveryFaults",
+    "DispatchError",
+    "DispatchFaults",
+    "FakeClock",
+    "FleetConfig",
+    "FleetEvent",
+    "FleetServer",
+    "FleetStats",
+    "InjectedDispatchFailure",
+    "LoadReport",
+    "StageHistogram",
+    "drive_fleet",
+    "events_equal",
+    "fleet_slo_smoke",
+    "synthetic_sessions",
+]

@@ -1,0 +1,594 @@
+"""Fleet serving engine: continuous batching for N concurrent streams.
+
+The single-stream ``StreamingClassifier`` (har_tpu.serving) tops out at
+one session per process: every hop pays its own dispatch round-trip, so
+a thousand 20 Hz users would mean a thousand tunnel RTTs per second.
+The paper's whole point is *continuous monitoring* at population scale
+(ROADMAP north star: "serve heavy traffic from millions of users"), so
+this module multiplexes N sessions onto the ONE fixed-shape compiled
+predict path:
+
+  - per-session ring buffers (the shared ``_WindowAssembler``) turn each
+    session's sample deliveries into due windows;
+  - a deadline-aware micro-batcher coalesces due windows across sessions
+    into power-of-two padded batches — ``StreamingClassifier``'s
+    catch-up-burst batching generalized across users, so at most
+    log2(target_batch)+1 programs ever compile;
+  - admission control (bounded sessions), bounded per-session and global
+    queues with backpressure (shed-oldest, never block the producer);
+  - per-dispatch retry + SLO tracking with graceful degradation, in
+    strict order: shed smoothing first (host-side work, events keep
+    flowing with raw labels), then shed scoring by dropping the STALEST
+    queued windows — the batch never blocks on one slow stream;
+  - a fault-injection hook on the dispatch path (see
+    ``har_tpu.serve.faults``) so every one of those paths is provable
+    under test, not hoped at.
+
+Correctness is pinned, not hoped: with the same delivery chunks, a
+fleet-multiplexed session emits bit-identical ``StreamEvent``s to a
+standalone ``StreamingClassifier`` (tests/test_fleet_serving.py) —
+guaranteed by construction, because window assembly, smoothing and
+drift monitoring are the same shared objects, and scoring is row-
+independent under any batch composition.
+
+Single-threaded by design: at 20 Hz × thousands of sessions the host
+work (ring rolls + EWMAs) is microseconds per delivery; the scarce
+resource is dispatches, which is exactly what the micro-batcher
+amortizes.  ``push`` ingests, ``poll`` dispatches what is due,
+``flush`` drains — the caller owns the loop (CLI, bench lane, or an
+async transport shim).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Hashable, Sequence
+
+import numpy as np
+
+from har_tpu.serve.stats import FleetStats
+from har_tpu.serving import (
+    StreamEvent,
+    _Smoother,
+    _WindowAssembler,
+    measure_device_latency,
+)
+
+
+class AdmissionError(RuntimeError):
+    """Session refused: fleet at max_sessions, or duplicate/unknown id."""
+
+
+class DispatchError(RuntimeError):
+    """A batched predict failed after all retries; its windows were
+    dropped (reason ``dispatch_failed``) and the engine kept serving."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Scheduling, bounding and degradation knobs for a FleetServer."""
+
+    # admission control: sessions beyond this are refused, not queued —
+    # a fleet that silently over-admits degrades everyone
+    max_sessions: int = 4096
+    # bounded per-session queue: a session whose consumer stalls sheds
+    # its own oldest windows instead of growing without bound
+    max_pending_per_session: int = 64
+    # global bound: total live queued windows before backpressure sheds
+    # the stalest queued windows fleet-wide
+    max_queue_windows: int = 65536
+    # micro-batcher: dispatch when this many windows are due ...
+    target_batch: int = 256
+    # ... or when the oldest queued window has waited this long — the
+    # deadline that bounds event latency at light load (a lone session
+    # must not wait forever for 255 peers)
+    max_delay_ms: float = 50.0
+    # SLO for one batched dispatch (e2e, through the tunnel); breaches
+    # drive the degradation ladder
+    dispatch_timeout_ms: float = 1000.0
+    # transparent re-dispatches of a FAILED (raised) transform before
+    # the batch's windows are dropped
+    retries: int = 1
+    # consecutive SLO breaches before degrading, and consecutive
+    # within-SLO dispatches before stepping back up
+    degrade_after_breaches: int = 2
+    recover_after_ok: int = 2
+    # fraction of the live queue shed (stalest first) at degradation
+    # level 2 — scoring shed, the last resort before unbounded latency
+    shed_fraction: float = 0.5
+
+    def __post_init__(self):
+        if self.max_sessions <= 0 or self.target_batch <= 0:
+            raise ValueError("max_sessions and target_batch must be positive")
+        if not (0.0 < self.shed_fraction <= 1.0):
+            raise ValueError("shed_fraction must be in (0, 1]")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetEvent:
+    """One session's StreamEvent as emitted by the fleet.
+
+    ``event`` is bit-identical to what a standalone StreamingClassifier
+    would emit for the same delivery chunks (latency fields excepted —
+    they measure this engine's dispatches).  ``degraded=True`` marks an
+    event emitted while smoothing was shed: label == raw_label and the
+    session's smoothing state was left frozen.
+    """
+
+    session_id: Hashable
+    event: StreamEvent
+    degraded: bool = False
+
+
+class _Pending:
+    """One completed, not-yet-scored window in the queues."""
+
+    __slots__ = ("session", "t_index", "window", "drift", "t_enqueue",
+                 "dropped")
+
+    def __init__(self, session, t_index, window, drift, t_enqueue):
+        self.session = session
+        self.t_index = t_index
+        self.window = window
+        self.drift = drift
+        self.t_enqueue = t_enqueue
+        self.dropped = False
+
+
+class _FleetSession:
+    """Per-session state: ring buffer + smoother + bounded queue."""
+
+    __slots__ = ("sid", "asm", "smoother", "pending", "n_live",
+                 "n_enqueued", "n_scored", "n_dropped")
+
+    def __init__(self, sid, asm, smoother):
+        self.sid = sid
+        self.asm = asm
+        self.smoother = smoother
+        # shares _Pending objects with the server's global FIFO; drops
+        # flag in place, scoring pops from the left
+        self.pending: deque[_Pending] = deque()
+        self.n_live = 0
+        self.n_enqueued = 0
+        self.n_scored = 0
+        self.n_dropped = 0
+
+
+class FleetServer:
+    """Session-multiplexing scheduler over one compiled predict path.
+
+    Parameters mirror ``StreamingClassifier`` (window geometry,
+    smoothing) plus a ``FleetConfig`` for scheduling/bounding knobs.
+
+    ``fault_hook(windows)`` — called before every dispatch attempt with
+    the padded batch; may raise (simulated dispatch failure → retry
+    path) or stall (simulated slow tunnel → SLO/degradation path).
+
+    ``clock`` — injectable monotonic-seconds source; every deadline,
+    SLO and histogram measurement reads it, so tests drive the
+    scheduler deterministically with a fake clock.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        window: int = 200,
+        hop: int = 20,
+        channels: int = 3,
+        smoothing: str = "ema",
+        ema_alpha: float = 0.4,
+        vote_depth: int = 5,
+        class_names: Sequence[str] | None = None,
+        config: FleetConfig | None = None,
+        fault_hook: Callable[[np.ndarray], None] | None = None,
+        clock: Callable[[], float] | None = None,
+    ):
+        if window <= 0 or hop <= 0:
+            raise ValueError("window and hop must be positive")
+        if smoothing not in ("ema", "vote", "none"):
+            raise ValueError(f"unknown smoothing {smoothing!r}")
+        # same construction-time guards as StreamingClassifier: a bad
+        # smoothing knob must fail HERE, not crash inside poll() after
+        # windows are already queued
+        if smoothing == "ema" and not (0.0 < ema_alpha <= 1.0):
+            raise ValueError("ema_alpha must be in (0, 1]")
+        if smoothing == "vote" and vote_depth < 1:
+            raise ValueError("vote_depth must be >= 1")
+        self.model = model
+        self.window = int(window)
+        self.hop = int(hop)
+        self.channels = int(channels)
+        self.smoothing = smoothing
+        self.ema_alpha = float(ema_alpha)
+        self.vote_depth = int(vote_depth)
+        self.class_names = list(class_names) if class_names else None
+        self.config = config or FleetConfig()
+        self.stats = FleetStats()
+        self._fault_hook = fault_hook
+        self._clock = clock or time.monotonic
+        self._sessions: dict[Hashable, _FleetSession] = {}
+        self._queue: deque[_Pending] = deque()  # global FIFO
+        self._n_live = 0
+        # degradation ladder state
+        self._smoothing_shed = False
+        self._breaches = 0
+        self._ok_streak = 0
+        # device calibration results keyed by padded batch size
+        self._device_ms: dict[int, dict] = {}
+
+    # ------------------------------------------------------- sessions
+
+    def add_session(self, session_id: Hashable, *, monitor=None) -> None:
+        """Admit a session (optionally with its own DriftMonitor, whose
+        verdicts then flow into the multiplexed event stream).  Raises
+        AdmissionError at max_sessions — bounded by construction."""
+        if session_id in self._sessions:
+            raise AdmissionError(f"session {session_id!r} already admitted")
+        if len(self._sessions) >= self.config.max_sessions:
+            self.stats.admission_rejections += 1
+            raise AdmissionError(
+                f"fleet full ({self.config.max_sessions} sessions); "
+                "remove a session or raise FleetConfig.max_sessions"
+            )
+        self._sessions[session_id] = _FleetSession(
+            session_id,
+            _WindowAssembler(
+                self.window, self.hop, self.channels, monitor=monitor
+            ),
+            _Smoother(self.smoothing, self.ema_alpha, self.vote_depth),
+        )
+        self.stats.sessions = len(self._sessions)
+
+    def remove_session(self, session_id: Hashable) -> None:
+        """Evict a session; its queued windows are dropped (reason
+        ``session_removed``)."""
+        sess = self._sessions.pop(session_id, None)
+        if sess is None:
+            raise AdmissionError(f"unknown session {session_id!r}")
+        n = 0
+        for p in sess.pending:
+            if not p.dropped:
+                p.dropped = True
+                p.window = None
+                n += 1
+        sess.pending.clear()
+        sess.n_dropped += n
+        self._n_live -= n
+        if n:
+            self.stats.drop(n, "session_removed")
+        self.stats.sessions = len(self._sessions)
+        self.stats.note_queue_depth(self._n_live)
+
+    @property
+    def sessions(self) -> tuple:
+        return tuple(self._sessions)
+
+    def drift_report(self, session_id: Hashable):
+        """The session's latest DriftReport (None without a monitor)."""
+        return self._sessions[session_id].asm.drift_report
+
+    # ------------------------------------------------------- ingestion
+
+    def push(self, session_id: Hashable, samples: np.ndarray) -> int:
+        """Feed ``(n, channels)`` samples for one session; windows they
+        complete are QUEUED (not scored — that's ``poll``).  Returns the
+        number of windows enqueued.  Never blocks: queue overflow sheds
+        the stalest windows instead (counted in stats.dropped)."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise AdmissionError(
+                f"unknown session {session_id!r}; add_session first"
+            )
+        now = self._clock()
+        completed = sess.asm.consume(samples)
+        for t_index, win, drift in completed:
+            p = _Pending(sess, t_index, win, drift, now)
+            sess.pending.append(p)
+            self._queue.append(p)
+            sess.n_live += 1
+            sess.n_enqueued += 1
+            self._n_live += 1
+            self.stats.enqueued += 1
+        # bounded per-session queue: this session sheds ITS OWN oldest
+        # windows — one stalled consumer must not push the fleet around
+        while sess.n_live > self.config.max_pending_per_session:
+            self._drop_oldest_of(sess, "session_queue")
+        # global backpressure: shed the stalest queued windows fleet-
+        # wide (FIFO head = oldest enqueue = stalest session data)
+        overflow = self._n_live - self.config.max_queue_windows
+        if overflow > 0:
+            self._shed_stalest(overflow, "backpressure")
+        self.stats.note_queue_depth(self._n_live)
+        return len(completed)
+
+    def _drop_oldest_of(self, sess: _FleetSession, reason: str) -> None:
+        while sess.pending:
+            p = sess.pending.popleft()
+            if not p.dropped:
+                p.dropped = True
+                p.window = None
+                sess.n_live -= 1
+                sess.n_dropped += 1
+                self._n_live -= 1
+                self.stats.drop(1, reason)
+                return
+
+    def _shed_stalest(self, n: int, reason: str) -> int:
+        """Drop up to n live windows from the global FIFO head (the
+        stalest enqueued data).  The queue entry is left in place with
+        its flag set; scoring and session queues skip flagged entries."""
+        shed = 0
+        for p in self._queue:
+            if shed >= n:
+                break
+            if not p.dropped:
+                p.dropped = True
+                p.window = None
+                p.session.n_live -= 1
+                p.session.n_dropped += 1
+                self._n_live -= 1
+                shed += 1
+        if shed:
+            self.stats.drop(shed, reason)
+        return shed
+
+    # ------------------------------------------------------ scheduling
+
+    def due(self, now: float | None = None) -> bool:
+        """Would poll() dispatch right now?  True when a full batch is
+        queued or the oldest queued window has passed its deadline."""
+        if self._n_live >= self.config.target_batch:
+            return True
+        oldest = self._oldest_live()
+        if oldest is None:
+            return False
+        now = self._clock() if now is None else now
+        return (now - oldest.t_enqueue) * 1e3 >= self.config.max_delay_ms
+
+    def _oldest_live(self) -> _Pending | None:
+        while self._queue and self._queue[0].dropped:
+            self._queue.popleft()
+        return self._queue[0] if self._queue else None
+
+    def poll(self, *, force: bool = False) -> list[FleetEvent]:
+        """Dispatch every due batch; return the events they produced.
+
+        ``force=True`` dispatches regardless of deadlines (drain).  A
+        dispatch that fails after retries drops its own windows and
+        keeps the engine serving — the error is counted, not raised.
+        """
+        events: list[FleetEvent] = []
+        while self._n_live and (force or self.due()):
+            events.extend(self._dispatch_batch())
+        self.stats.note_queue_depth(self._n_live)
+        return events
+
+    def flush(self) -> list[FleetEvent]:
+        """Drain the queue completely (end of stream / shutdown)."""
+        return self.poll(force=True)
+
+    # ------------------------------------------------------ dispatch
+
+    def _dispatch_batch(self) -> list[FleetEvent]:
+        cfg = self.config
+        batch: list[_Pending] = []
+        while self._queue and len(batch) < cfg.target_batch:
+            p = self._queue.popleft()
+            if not p.dropped:
+                batch.append(p)
+        if not batch:
+            return []
+        t_assembled = self._clock()
+        for p in batch:
+            self.stats.queue_wait.record(
+                (t_assembled - p.t_enqueue) * 1e3
+            )
+        k = len(batch)
+        pad_k = 1 << (k - 1).bit_length()
+        windows = np.stack([p.window for p in batch])
+        if pad_k != k:
+            # power-of-two padding, same policy as StreamingClassifier:
+            # at most log2(target_batch)+1 programs ever compile
+            windows = np.concatenate(
+                [windows, np.repeat(windows[-1:], pad_k - k, axis=0)]
+            )
+        try:
+            probs, dispatch_ms = self._score(windows, k)
+        except DispatchError:
+            # graceful degradation: this batch's windows are shed, the
+            # engine keeps serving every other stream
+            for p in batch:
+                p.dropped = True
+                p.window = None
+                p.session.n_live -= 1
+                p.session.n_dropped += 1
+                self._n_live -= 1
+                self._unlink_scored(p)
+            self.stats.drop(k, "dispatch_failed")
+            self.stats.dispatch_failures += 1
+            self._note_slo(breached=True)
+            return []
+        self.stats.dispatches += 1
+        self.stats.note_batch(pad_k)
+        self.stats.dispatch.record(dispatch_ms)
+        # the ladder is driven by PRIOR evidence: the batch that records
+        # a breach is still emitted at the pre-breach degradation level
+        # (its windows were scored under the old regime), the next one
+        # reflects the step
+        shed = self._smoothing_shed
+        self._note_slo(breached=dispatch_ms > cfg.dispatch_timeout_ms)
+
+        # calibrated device share for this padded program, amortized
+        # per window — the per-event tunnel-vs-chip attribution
+        dev = self._device_ms.get(pad_k)
+        dev_share = None if dev is None else round(dev["p50_ms"] / k, 4)
+        lat_share = dispatch_ms / k
+
+        t_smooth0 = self._clock()
+        events: list[FleetEvent] = []
+        for p, pr in zip(batch, probs):
+            sess = p.session
+            if shed:
+                # degradation level 1: smoothing shed — raw labels out,
+                # smoothing state left FROZEN (recovery resumes from it)
+                raw_label = int(pr.argmax())
+                ev = StreamEvent(
+                    t_index=p.t_index,
+                    label=raw_label,
+                    raw_label=raw_label,
+                    probability=pr.copy(),
+                    latency_ms=lat_share,
+                    drift=p.drift,
+                    device_ms=dev_share,
+                )
+                self.stats.degraded_events += 1
+            else:
+                label, raw_label, decision = sess.smoother.step(pr)
+                ev = StreamEvent(
+                    t_index=p.t_index,
+                    label=label,
+                    raw_label=raw_label,
+                    probability=decision.copy(),
+                    latency_ms=lat_share,
+                    drift=p.drift,
+                    device_ms=dev_share,
+                )
+            sess.n_live -= 1
+            sess.n_scored += 1
+            self._n_live -= 1
+            self.stats.scored += 1
+            self._unlink_scored(p)
+            self.stats.event.record((t_smooth0 - p.t_enqueue) * 1e3)
+            events.append(FleetEvent(sess.sid, ev, degraded=shed))
+        self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
+        return events
+
+    @staticmethod
+    def _unlink_scored(p: _Pending) -> None:
+        """Remove p from its session queue.  The global FIFO preserves
+        per-session order, so p is that session's leftmost entry (maybe
+        behind already-processed flagged ones)."""
+        pending = p.session.pending
+        while pending:
+            q = pending.popleft()
+            if q is p:
+                return
+            if not q.dropped:  # pragma: no cover - FIFO order invariant
+                pending.appendleft(q)
+                raise AssertionError("fleet queue order violated")
+
+    def _score(self, windows: np.ndarray, k: int):
+        """One timed model.transform with fault hook + retry.  Both the
+        hook and the transform are inside the timed/retried region —
+        injected stalls and failures exercise the same accounting real
+        ones would.  The clock starts ONCE, before the first attempt:
+        dispatch_ms is what the batch actually waited, failed attempts
+        included — a stall-then-fail absorbed by the retry path must
+        still read as an SLO breach, not as the fast retry's time."""
+        last_err: Exception | None = None
+        t0 = self._clock()
+        for attempt in range(self.config.retries + 1):
+            try:
+                if self._fault_hook is not None:
+                    self._fault_hook(windows)
+                preds = self.model.transform(windows)
+                probs = np.asarray(preds.probability[:k], np.float64)
+            except Exception as exc:
+                last_err = exc
+                if attempt < self.config.retries:
+                    self.stats.dispatch_retries += 1
+                continue
+            return probs, (self._clock() - t0) * 1e3
+        raise DispatchError(
+            f"dispatch failed after {self.config.retries + 1} attempts: "
+            f"{type(last_err).__name__}: {last_err}"
+        ) from last_err
+
+    def _note_slo(self, *, breached: bool) -> None:
+        """The degradation ladder, in the order the docstring promises:
+        smoothing shed first (events keep flowing), then scoring shed
+        (stalest windows dropped) — and recovery in reverse."""
+        cfg = self.config
+        if breached:
+            self.stats.slo_breaches += 1
+            self._breaches += 1
+            self._ok_streak = 0
+            if self._breaches >= cfg.degrade_after_breaches:
+                if not self._smoothing_shed:
+                    self._smoothing_shed = True
+                    self.stats.smoothing_shed_transitions += 1
+                else:
+                    self._shed_stalest(
+                        max(1, int(self._n_live * cfg.shed_fraction)),
+                        "slo_shed",
+                    )
+                self._breaches = 0  # each ladder step needs fresh evidence
+        else:
+            self._breaches = 0
+            self._ok_streak += 1
+            if (
+                self._smoothing_shed
+                and self._ok_streak >= cfg.recover_after_ok
+            ):
+                self._smoothing_shed = False
+                self._ok_streak = 0
+
+    @property
+    def smoothing_shed(self) -> bool:
+        """True while the engine is in degradation level >= 1."""
+        return self._smoothing_shed
+
+    # ---------------------------------------------------- calibration
+
+    def calibrate_device(
+        self, batch_sizes: Sequence[int] | None = None, iters: int = 16
+    ) -> dict[int, dict]:
+        """Measure DEVICE execution p50 for the padded batch programs
+        (shared measure_device_latency: device-resident input,
+        block_until_ready, no fetch).  Defaults to the padded sizes this
+        engine has actually dispatched (plus 1).  After calibration,
+        events carry ``device_ms`` and ``stats_snapshot`` attributes
+        dispatch p99 to tunnel/host vs device.  ValueError for models
+        without a jitted predict propagates — callers that serve host
+        stubs skip calibration."""
+        if batch_sizes is None:
+            batch_sizes = sorted({1, *self.stats.batch_sizes})
+        for b in batch_sizes:
+            self._device_ms[int(b)] = measure_device_latency(
+                self.model,
+                window=self.window,
+                channels=self.channels,
+                batch=int(b),
+                iters=iters,
+            )
+        return dict(self._device_ms)
+
+    # ------------------------------------------------------ reporting
+
+    def stats_snapshot(self) -> dict:
+        """FleetStats snapshot + device calibration + p99 attribution."""
+        snap = self.stats.snapshot()
+        snap["smoothing_shed"] = self._smoothing_shed
+        if self._device_ms:
+            snap["device_ms"] = {
+                str(b): d["p50_ms"]
+                for b, d in sorted(self._device_ms.items())
+            }
+            # attribute the dispatch p99 spike: if the worst calibrated
+            # device time can't explain it, the spike is host/transfer/
+            # tunnel — the share a co-located deployment would shed
+            p99 = self.stats.dispatch.percentile(99)
+            worst_dev = max(d["p50_ms"] for d in self._device_ms.values())
+            if p99 is not None:
+                snap["dispatch_p99_attribution"] = {
+                    "p99_ms": round(p99, 3),
+                    "device_p50_ms": worst_dev,
+                    "host_overhead_ms": round(max(0.0, p99 - worst_dev), 3),
+                    "dominated_by": (
+                        "host_tunnel" if p99 > 2.0 * worst_dev else "device"
+                    ),
+                }
+        return snap

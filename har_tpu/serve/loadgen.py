@@ -1,0 +1,225 @@
+"""Seeded synthetic fleet load: thousands of phase-offset 20 Hz sessions.
+
+Builds per-session recordings from the calibrated synthetic generator
+family (``data/raw_windows.synthetic_raw_stream``) and drives a
+``FleetServer`` with a deterministic round-robin delivery schedule:
+each session delivers hop-sized chunks, phase-offset so hop boundaries
+stagger across the fleet instead of all landing in the same
+micro-batch slot (the realistic arrival pattern — users don't
+synchronize their sensors).  Transport faults (drop / delay / burst,
+``har_tpu.serve.faults.DeliveryFaults``) are applied per chunk from the
+same seed.
+
+Also home of ``AnalyticDemoModel`` — a deterministic, training-free
+classifier over the synthetic stream's own class dynamics.  It is
+row-independent numpy end-to-end, so its per-window outputs are
+bit-identical under ANY batch composition: the property the
+fleet-vs-independent equivalence test (and the release gate's SLO
+smoke) pins without spending a model fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from har_tpu.data.raw_windows import synthetic_raw_stream
+from har_tpu.serve.engine import FleetServer
+from har_tpu.serve.faults import DeliveryFaults
+
+
+class AnalyticDemoModel:
+    """Nearest-centroid activity classifier on (per-axis mean, std).
+
+    Centroids are computed once from a fixed-seed draw of the synthetic
+    generator itself — self-calibrating to the exact class dynamics the
+    load generator emits, no training step.  transform() is plain
+    per-row numpy: deterministic, batch-composition-independent, and
+    fast enough to score a thousand sessions' windows in microseconds —
+    the engine-overhead measurement baseline (a real model adds device
+    dispatch on top; this model isolates the scheduler's own cost).
+    """
+
+    def __init__(self, tau: float = 2.0):
+        cal = synthetic_raw_stream(n_windows=240, seed=1729)
+        feats = self._features(cal.windows)
+        self.num_classes = len(cal.class_names)
+        self.class_names = cal.class_names
+        self._centroids = np.stack(
+            [
+                feats[cal.labels == c].mean(axis=0)
+                for c in range(self.num_classes)
+            ]
+        )
+        self._tau = float(tau)
+
+    @staticmethod
+    def _features(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        return np.concatenate(
+            [x.mean(axis=1), x.std(axis=1)], axis=-1
+        )  # (n, 6)
+
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
+
+        f = self._features(np.asarray(x))
+        d2 = ((f[:, None, :] - self._centroids[None]) ** 2).sum(-1)
+        raw = -d2 / self._tau
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return Predictions.from_raw(
+            raw, e / e.sum(axis=-1, keepdims=True)
+        )
+
+
+def synthetic_sessions(
+    n_sessions: int,
+    *,
+    windows_per_session: int = 2,
+    window: int = 200,
+    seed: int = 0,
+) -> tuple[list[np.ndarray], tuple[str, ...]]:
+    """Per-session ``(n_samples, 3)`` recordings cut from one seeded
+    synthetic stream draw (each session = windows_per_session
+    contiguous windows of one draw; sessions differ in content and in
+    activity mix).  Returns (recordings, class_names)."""
+    pool = synthetic_raw_stream(
+        n_windows=n_sessions * windows_per_session, seed=seed,
+        window=window,
+    )
+    recordings = [
+        pool.windows[
+            i * windows_per_session : (i + 1) * windows_per_session
+        ].reshape(-1, 3)
+        for i in range(n_sessions)
+    ]
+    return recordings, pool.class_names
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadReport:
+    """What the drive actually delivered (faults included)."""
+
+    sessions: int
+    samples_delivered: int
+    deliveries: int
+    dropped_deliveries: int
+    delayed_deliveries: int
+    burst_deliveries: int
+    windows_enqueued: int
+    duration_s: float
+
+
+def drive_fleet(
+    server: FleetServer,
+    recordings: list[np.ndarray],
+    *,
+    chunk: int | None = None,
+    seed: int = 0,
+    faults: DeliveryFaults | None = None,
+    poll_every: int = 1,
+    session_ids: list | None = None,
+    delivery_log: list | None = None,
+) -> tuple[list, LoadReport]:
+    """Deliver every recording through the fleet engine; return
+    (events, LoadReport).
+
+    Delivery is round-robin over sessions in hop-sized chunks (override
+    with ``chunk``), with a seeded per-session phase offset on the
+    first chunk so hop boundaries stagger across the fleet.  Sessions
+    must already be admitted (ids default to range(len(recordings))).
+    ``poll_every`` controls how many delivery rounds pass between
+    scheduler polls; the queue is flushed at the end, so at nominal
+    load nothing is left pending.
+
+    ``delivery_log`` (a list, appended with ``(session_index, payload)``
+    in delivery order) records the exact post-fault chunk sequence —
+    what an equivalence check replays through independent
+    StreamingClassifiers, since drift EWMAs are chunk-cadence-dependent.
+    """
+    n = len(recordings)
+    ids = list(range(n)) if session_ids is None else list(session_ids)
+    if len(ids) != n:
+        raise ValueError("session_ids length must match recordings")
+    chunk = server.hop if chunk is None else int(chunk)
+    faults = faults or DeliveryFaults()
+    rng = np.random.default_rng((seed, 31337))
+    # phase offsets: session i's first chunk is shorter by a seeded,
+    # deterministic amount, so window completions stagger across rounds
+    offsets = rng.integers(0, chunk, size=n)
+    cursors = [0] * n
+    held: list[list[np.ndarray]] = [[] for _ in range(n)]
+    events: list = []
+    delivered = deliveries = dropped_d = delayed_d = burst_d = 0
+    enqueued = 0
+    t0 = time.perf_counter()
+    rounds = 0
+    while True:
+        active = False
+        for i in range(n):
+            rec = recordings[i]
+            if cursors[i] >= len(rec) and not held[i]:
+                continue
+            active = True
+            take = chunk if cursors[i] else max(1, chunk - int(offsets[i]))
+            n_chunks = 1
+            if faults.burst_prob and rng.random() < faults.burst_prob:
+                n_chunks = faults.burst_rounds
+                burst_d += 1
+            parts = list(held[i])
+            held[i] = []
+            for _ in range(n_chunks):
+                part = rec[cursors[i] : cursors[i] + take]
+                cursors[i] += take
+                take = chunk  # only the first chunk carries the offset
+                if not len(part):
+                    break
+                if faults.drop_prob and rng.random() < faults.drop_prob:
+                    dropped_d += 1
+                    continue
+                if faults.delay_prob and rng.random() < faults.delay_prob:
+                    # held in order, delivered with the next round: a
+                    # catch-up burst, never a reorder
+                    held[i].append(part)
+                    delayed_d += 1
+                    continue
+                parts.append(part)
+            if parts:
+                payload = (
+                    parts[0] if len(parts) == 1 else np.concatenate(parts)
+                )
+                if delivery_log is not None:
+                    delivery_log.append((i, payload))
+                enqueued += server.push(ids[i], payload)
+                delivered += len(payload)
+                deliveries += 1
+        rounds += 1
+        if rounds % poll_every == 0:
+            events.extend(server.poll())
+        if not active:
+            break
+    # end of stream: anything still held was delayed past the end —
+    # deliver it (the transport finally caught up), then drain
+    for i in range(n):
+        if held[i]:
+            payload = np.concatenate(held[i])
+            if delivery_log is not None:
+                delivery_log.append((i, payload))
+            enqueued += server.push(ids[i], payload)
+            delivered += len(payload)
+            deliveries += 1
+            held[i] = []
+    events.extend(server.flush())
+    report = LoadReport(
+        sessions=n,
+        samples_delivered=delivered,
+        deliveries=deliveries,
+        dropped_deliveries=dropped_d,
+        delayed_deliveries=delayed_d,
+        burst_deliveries=burst_d,
+        windows_enqueued=enqueued,
+        duration_s=round(time.perf_counter() - t0, 4),
+    )
+    return events, report

@@ -1,0 +1,121 @@
+"""Fleet equivalence + SLO smoke — the release gate's serving check.
+
+``fleet_slo_smoke()`` runs in a few seconds on the CPU mesh and proves
+the two properties the fleet engine ships on:
+
+  1. equivalence — every fleet-multiplexed session's events are
+     bit-identical (latency fields excepted) to an independent
+     ``StreamingClassifier`` replaying the same delivery chunks;
+  2. SLO — at nominal load, zero dropped windows and the accounting
+     invariant (enqueued == scored + dropped) holds.
+
+``scripts/release_gate.py`` runs it after a green suite and stamps
+``{sessions, p99_ms, dropped}`` into ``artifacts/test_gate.json`` — the
+serving counterpart of the published test counts: generated from a run,
+never typed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from har_tpu.serve.engine import FleetConfig, FleetServer
+from har_tpu.serve.loadgen import (
+    AnalyticDemoModel,
+    drive_fleet,
+    synthetic_sessions,
+)
+from har_tpu.serving import StreamingClassifier
+
+
+def events_equal(fleet_event, independent_event) -> bool:
+    """Bit-identical on every decision field; latency fields excluded —
+    they measure the engines, not the decisions."""
+    a, b = fleet_event, independent_event
+    return (
+        a.t_index == b.t_index
+        and a.label == b.label
+        and a.raw_label == b.raw_label
+        and a.drift == b.drift
+        and np.array_equal(a.probability, b.probability)
+    )
+
+
+def fleet_slo_smoke(
+    sessions: int = 128,
+    *,
+    windows_per_session: int = 2,
+    hop: int = 200,
+    smoothing: str = "ema",
+    seed: int = 0,
+) -> dict:
+    """One JSON-ready verdict: {sessions, p99_ms, dropped, equivalent,
+    windows_per_sec, ...}.  Uses the training-free AnalyticDemoModel so
+    the gate spends its seconds on the scheduler, not on a model fit."""
+    model = AnalyticDemoModel()
+    server = FleetServer(
+        model, window=200, hop=hop, smoothing=smoothing,
+        config=FleetConfig(max_sessions=max(sessions, 1)),
+    )
+    recordings, _ = synthetic_sessions(
+        sessions, windows_per_session=windows_per_session, seed=seed
+    )
+    for i in range(sessions):
+        server.add_session(i)
+    log: list = []
+    events, report = drive_fleet(
+        server, recordings, seed=seed, delivery_log=log
+    )
+
+    # replay the exact delivered chunks through independent classifiers
+    per_session_events: dict[int, list] = {i: [] for i in range(sessions)}
+    for ev in events:
+        per_session_events[ev.session_id].append(ev.event)
+    equivalent = True
+    independent = {
+        i: StreamingClassifier(
+            model, window=200, hop=hop, smoothing=smoothing
+        )
+        for i in range(sessions)
+    }
+    ref_events: dict[int, list] = {i: [] for i in range(sessions)}
+    for i, payload in log:
+        ref_events[i].extend(independent[i].push(payload))
+    for i in range(sessions):
+        got, want = per_session_events[i], ref_events[i]
+        if len(got) != len(want) or not all(
+            events_equal(g, w) for g, w in zip(got, want)
+        ):
+            equivalent = False
+            break
+
+    snap = server.stats_snapshot()
+    p99 = snap["stages"]["event_ms"].get("p99_ms")
+    return {
+        "sessions": sessions,
+        "windows": snap["accounting"]["enqueued"],
+        "p99_ms": p99,
+        "p50_ms": snap["stages"]["event_ms"].get("p50_ms"),
+        "dropped": snap["accounting"]["dropped"],
+        "equivalent": equivalent,
+        "accounting_balanced": (
+            snap["accounting"]["balanced"]
+            and snap["accounting"]["pending"] == 0
+        ),
+        "windows_per_sec": (
+            round(snap["accounting"]["scored"] / report.duration_s, 1)
+            if report.duration_s
+            else None
+        ),
+        "ok": bool(
+            equivalent
+            and snap["accounting"]["dropped"] == 0
+            and snap["accounting"]["pending"] == 0
+        ),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(fleet_slo_smoke()))

@@ -1,0 +1,175 @@
+"""Fleet serving observability: counters, gauges, stage histograms.
+
+Every number a fleet operator needs to tell "the engine is slow" from
+"the chip is slow" from "the load is too high", in one snapshot:
+
+  - counters: enqueued / scored / dropped (by reason) windows, dispatch
+    count/retries/failures, degraded events, admission rejections — the
+    accounting invariant ``enqueued == scored + dropped + pending`` is
+    checked by ``snapshot()`` itself (``accounting.balanced``);
+  - gauges: live queue depth (current + high-water mark), sessions;
+  - per-stage latency histograms over the pipeline
+    enqueue → batch → dispatch → smooth, plus the end-to-end event
+    latency (enqueue→emit) the serving SLO is stated against.
+
+Host-side and allocation-light by design: one histogram record is a
+bisect into a fixed bucket table plus a bounded deque append — the
+stats path must never become the latency it measures.
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import deque
+
+import numpy as np
+
+# log-spaced bucket upper bounds (ms): 0.05 ms .. 50 s, ~half-decade
+# steps — wide enough to cover sub-ms CPU-stub smoothing AND multi-
+# second degraded-tunnel dispatches in the same table
+_BUCKET_BOUNDS_MS = (
+    0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+    100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0, 50000.0,
+)
+
+
+class StageHistogram:
+    """Latency histogram for one pipeline stage.
+
+    Fixed log-spaced buckets (cheap, bounded, mergeable into dashboards)
+    plus a trailing window of raw samples for exact percentiles — the
+    same trailing-window stance as ``StreamingClassifier.latency_stats``
+    (a fleet runs for days; stats must stay current and memory
+    constant).
+    """
+
+    __slots__ = ("count", "total_ms", "max_ms", "buckets", "_recent")
+
+    def __init__(self, window: int = 8192):
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self.buckets = [0] * (len(_BUCKET_BOUNDS_MS) + 1)
+        self._recent: deque[float] = deque(maxlen=window)
+
+    def record(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+        self.buckets[bisect.bisect_left(_BUCKET_BOUNDS_MS, ms)] += 1
+        self._recent.append(ms)
+
+    def percentile(self, q: float) -> float | None:
+        if not self._recent:
+            return None
+        return float(
+            np.percentile(np.asarray(self._recent, np.float64), q)
+        )
+
+    def snapshot(self) -> dict:
+        if not self.count:
+            return {"count": 0}
+        out = {
+            "count": self.count,
+            "mean_ms": round(self.total_ms / self.count, 4),
+            "p50_ms": round(self.percentile(50), 4),
+            "p99_ms": round(self.percentile(99), 4),
+            "max_ms": round(self.max_ms, 4),
+        }
+        # sparse bucket view: only non-empty buckets, keyed by upper
+        # bound — readable in a JSON artifact without 19 zero rows
+        bounds = [*map(str, _BUCKET_BOUNDS_MS), "+inf"]
+        out["buckets_ms"] = {
+            bounds[i]: n for i, n in enumerate(self.buckets) if n
+        }
+        return out
+
+
+class FleetStats:
+    """Counters + gauges + stage histograms for one FleetServer.
+
+    The stage names mirror the pipeline: ``queue_wait`` (enqueue→batch
+    assembly), ``dispatch`` (one batched transform, e2e through the
+    tunnel), ``smooth`` (per-batch host-side smoothing + event build),
+    ``event`` (enqueue→emit, the per-event serving latency the SLO and
+    the bench lane's p50/p99 are stated against).
+    """
+
+    def __init__(self):
+        self.enqueued = 0
+        self.scored = 0
+        self.dropped: dict[str, int] = {}
+        self.dispatches = 0
+        self.dispatch_retries = 0
+        self.dispatch_failures = 0
+        self.degraded_events = 0
+        self.smoothing_shed_transitions = 0
+        self.slo_breaches = 0
+        self.admission_rejections = 0
+        self.sessions = 0
+        self.queue_depth = 0
+        self.queue_depth_max = 0
+        self.batch_sizes: dict[int, int] = {}  # padded size -> count
+        self.queue_wait = StageHistogram()
+        self.dispatch = StageHistogram()
+        self.smooth = StageHistogram()
+        self.event = StageHistogram()
+
+    # ------------------------------------------------------- recording
+
+    def drop(self, n: int, reason: str) -> None:
+        self.dropped[reason] = self.dropped.get(reason, 0) + n
+
+    @property
+    def dropped_total(self) -> int:
+        return sum(self.dropped.values())
+
+    def note_queue_depth(self, depth: int) -> None:
+        self.queue_depth = depth
+        if depth > self.queue_depth_max:
+            self.queue_depth_max = depth
+
+    def note_batch(self, padded: int) -> None:
+        self.batch_sizes[padded] = self.batch_sizes.get(padded, 0) + 1
+
+    # ------------------------------------------------------- reporting
+
+    def accounting(self) -> dict:
+        """The conservation law: every enqueued window is exactly one of
+        scored, dropped, or still pending."""
+        pending = self.enqueued - self.scored - self.dropped_total
+        return {
+            "enqueued": self.enqueued,
+            "scored": self.scored,
+            "dropped": self.dropped_total,
+            "pending": pending,
+            "balanced": pending >= 0,
+        }
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict: the FleetStats export surface (stamped
+        into bench artifacts and the release gate log)."""
+        return {
+            "sessions": self.sessions,
+            "queue_depth": self.queue_depth,
+            "queue_depth_max": self.queue_depth_max,
+            "dispatches": self.dispatches,
+            "dispatch_retries": self.dispatch_retries,
+            "dispatch_failures": self.dispatch_failures,
+            "degraded_events": self.degraded_events,
+            "smoothing_shed_transitions": self.smoothing_shed_transitions,
+            "slo_breaches": self.slo_breaches,
+            "admission_rejections": self.admission_rejections,
+            "dropped_by_reason": dict(self.dropped),
+            "batch_sizes": {
+                str(k): v for k, v in sorted(self.batch_sizes.items())
+            },
+            "accounting": self.accounting(),
+            "stages": {
+                "queue_wait_ms": self.queue_wait.snapshot(),
+                "dispatch_ms": self.dispatch.snapshot(),
+                "smooth_ms": self.smooth.snapshot(),
+                "event_ms": self.event.snapshot(),
+            },
+        }

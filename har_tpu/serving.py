@@ -18,6 +18,12 @@ TPU-native serving path for that gap:
     Bit-identical to streaming the same samples with smoothing off
     (tested: tests/test_serving.py).
 
+Fleet scale — thousands of concurrent sessions multiplexed onto the
+same compiled predict — lives in ``har_tpu.serve`` (``FleetServer``); it
+composes the shared building blocks defined here (``_WindowAssembler``,
+``_Smoother``, ``device_predict_fn``), which is what makes its events
+bit-identical to N independent ``StreamingClassifier`` runs.
+
 TPU design notes:
   - Static shapes everywhere: window length, hop and channel count are
     construction-time constants; ``push`` never changes a traced shape.
@@ -59,10 +65,202 @@ class StreamEvent:
     drift: bool = False  # input stream out of training distribution
     #   (only when a monitoring.DriftMonitor is attached; see
     #   StreamingClassifier(monitor=...))
+    device_ms: float | None = None  # calibrated DEVICE share of
+    #   latency_ms for this window's dispatch (None before a device
+    #   calibration exists); latency_ms - device_ms is host/transfer/
+    #   tunnel overhead — what lets a serving consumer attribute a p99
+    #   spike to the tunnel vs the chip per event
 
 
 def _percentile(values: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+def device_predict_fn(model):
+    """The compiled device predict behind any serving wrapper chain.
+
+    Unwraps NeuralClassifierModel's ``.inner`` and
+    TemperatureScaledModel's ``.model`` (the device program is the same
+    base forward either way — temperature/scaler are host-side); an
+    ExportedPredictor (StableHLO artifact) is reached via its exported
+    ``device_call``.  Shared by ``StreamingClassifier.device_latency_ms``
+    and the fleet engine's dispatch calibration so both report the same
+    device-vs-host decomposition.  Raises ValueError for models without
+    a jitted predict (trees, MLlib replicas, host-side stubs).
+    """
+    inner = model
+    for _ in range(4):
+        if hasattr(inner, "_predict") and hasattr(inner, "params"):
+            return lambda x: inner._predict(inner.params, x)
+        if hasattr(inner, "device_call"):
+            return inner.device_call  # ExportedPredictor
+        nxt = getattr(inner, "inner", None)
+        if nxt is None:
+            nxt = getattr(inner, "model", None)
+        if nxt is None:
+            break
+        inner = nxt
+    raise ValueError(
+        "device timing needs a NeuralModel-backed or exported-"
+        f"artifact classifier (got {type(model).__name__}); "
+        "e2e latency stats are still available"
+    )
+
+
+def measure_device_latency(
+    model, *, window: int, channels: int, batch: int = 1, iters: int = 16
+) -> dict:
+    """Device dispatch+compute p50 for one ``(batch, window, channels)``
+    predict: device-resident input, ``block_until_ready``, no host
+    staging, no scaler, no result fetch.  See
+    ``StreamingClassifier.device_latency_ms`` for the interpretation."""
+    fn = device_predict_fn(model)
+    import jax.numpy as jnp
+
+    x = jnp.zeros((batch, window, channels), jnp.float32)
+    fn(x).block_until_ready()  # warm
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn(x).block_until_ready()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "batch": batch,
+        "iters": iters,
+        "p50_ms": round(_percentile(times, 50), 3),
+        "min_ms": round(min(times), 3),
+    }
+
+
+class _WindowAssembler:
+    """Ring-buffer sliding-window ingestion over an incremental stream.
+
+    One implementation shared by the single-stream StreamingClassifier
+    and the fleet engine's per-session state (har_tpu.serve): a
+    multiplexed session therefore produces bit-identical window
+    snapshots — and drift verdicts, which are chunk-cadence-dependent
+    EWMAs — to a standalone classifier fed the same delivery chunks.
+    """
+
+    __slots__ = (
+        "window", "hop", "channels", "monitor", "drift_report",
+        "_ring", "_n_seen", "_next_emit",
+    )
+
+    def __init__(self, window: int, hop: int, channels: int, monitor=None):
+        self.window = window
+        self.hop = hop
+        self.channels = channels
+        self.monitor = monitor
+        self.drift_report = None
+        self._ring = np.zeros((window, channels), np.float32)
+        self._n_seen = 0
+        self._next_emit = window
+
+    @property
+    def n_seen(self) -> int:
+        return self._n_seen
+
+    def consume(
+        self, samples: np.ndarray
+    ) -> list[tuple[int, np.ndarray, bool]]:
+        """Absorb ``(n, channels)`` samples; return the ``(t_index,
+        window_snapshot, drift)`` tuple for every hop boundary they
+        complete (scoring is the caller's job)."""
+        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        if samples.shape[-1] != self.channels:
+            raise ValueError(
+                f"expected (n, {self.channels}) samples, got "
+                f"{samples.shape}"
+            )
+        pending: list[tuple[int, np.ndarray, bool]] = []
+        pos = 0
+        n = len(samples)
+        while pos < n:
+            # advance at most to the next emission boundary, so no
+            # boundary inside a large chunk is skipped
+            take = min(self._next_emit - self._n_seen, n - pos)
+            chunk = samples[pos : pos + take]
+            if self.monitor is not None and take:
+                # per consumed chunk, NOT per push: a whole recording
+                # pushed at once must step the monitor at the same
+                # cadence live streaming would, or the debounce could
+                # never fire and events would all share one end-of-
+                # recording verdict
+                self.drift_report = self.monitor.update(chunk)
+            # roll the ring by `take`: cheap at stream chunk sizes, and
+            # keeps the window contiguous for the device transfer
+            if take >= self.window:
+                self._ring[:] = chunk[-self.window :]
+            else:
+                self._ring[: self.window - take] = self._ring[take:]
+                self._ring[self.window - take :] = chunk
+            self._n_seen += take
+            pos += take
+            if self._n_seen == self._next_emit:
+                pending.append(
+                    (
+                        self._n_seen,
+                        self._ring.copy(),
+                        bool(
+                            self.drift_report is not None
+                            and self.drift_report.drifting
+                        ),
+                    )
+                )
+                self._next_emit += self.hop
+        return pending
+
+
+class _Smoother:
+    """Sequential decision smoothing over per-window probabilities.
+
+    The one implementation of the EMA / majority-vote / passthrough
+    decision rule, shared by StreamingClassifier and the fleet engine's
+    per-session state — fleet-multiplexed smoothing is bit-identical to
+    standalone smoothing by construction, not by parallel maintenance.
+    """
+
+    __slots__ = ("smoothing", "ema_alpha", "_ema", "_votes")
+
+    def __init__(self, smoothing: str, ema_alpha: float, vote_depth: int):
+        self.smoothing = smoothing
+        self.ema_alpha = ema_alpha
+        self._ema: np.ndarray | None = None
+        self._votes: deque[int] = deque(maxlen=vote_depth)
+
+    def step(self, probs: np.ndarray) -> tuple[int, int, np.ndarray]:
+        """Absorb one window's ``(C,)`` probabilities (in emission
+        order); return ``(label, raw_label, decision_probs)``."""
+        raw_label = int(probs.argmax())
+        if self.smoothing == "ema":
+            self._ema = (
+                probs
+                if self._ema is None
+                else self.ema_alpha * probs
+                + (1.0 - self.ema_alpha) * self._ema
+            )
+            smoothed = self._ema
+            label = int(smoothed.argmax())
+        elif self.smoothing == "vote":
+            self._votes.append(raw_label)
+            counts = np.bincount(
+                np.asarray(self._votes), minlength=probs.shape[0]
+            )
+            best = counts.max()
+            # ties break toward the newest label that achieves the max
+            label = next(
+                v for v in reversed(self._votes) if counts[v] == best
+            )
+            # the event's probability must describe the DECISION, so in
+            # vote mode it is the trailing vote distribution (the raw
+            # window's own distribution stays reachable via raw_label);
+            # probability[label] is then the vote confidence
+            smoothed = counts.astype(np.float64) / counts.sum()
+        else:
+            smoothed = probs
+            label = raw_label
+        return label, raw_label, smoothed
 
 
 class StreamingClassifier:
@@ -172,12 +370,15 @@ class StreamingClassifier:
     def reset(self) -> None:
         """Drop buffered samples and smoothing state (stream restart)."""
         # ring buffer of the newest `window` samples; decisions fire at
-        # sample counts window, window+hop, window+2*hop, ...
-        self._ring = np.zeros((self.window, self.channels), np.float32)
-        self._n_seen = 0
-        self._next_emit = self.window
-        self._ema: np.ndarray | None = None
-        self._votes: deque[int] = deque(maxlen=self.vote_depth)
+        # sample counts window, window+hop, window+2*hop, ... — shared
+        # with the fleet engine's per-session state (har_tpu.serve)
+        self._asm = _WindowAssembler(
+            self.window, self.hop, self.channels,
+            monitor=getattr(self, "monitor", None),
+        )
+        self._smoother = _Smoother(
+            self.smoothing, self.ema_alpha, self.vote_depth
+        )
         # bounded: a deployed 20 Hz session runs for days (the paper's
         # elderly-monitoring use case) — percentiles over a trailing
         # window keep the stats current AND the memory constant; 4096
@@ -187,7 +388,6 @@ class StreamingClassifier:
         # reset() would be wrong — a restarted stream may follow a
         # checkpoint swap, so measurements restart with the session
         self._device_ms: dict[int, dict] = {}
-        self._drift_report = None
         if getattr(self, "monitor", None) is not None:
             self.monitor.reset()
         # the first predict EVER pays compilation; a reset() on a warm
@@ -203,50 +403,11 @@ class StreamingClassifier:
         boundary they complete.  Chunking is irrelevant: pushing a
         recording sample-by-sample or all at once yields identical
         events (the test suite pins this)."""
-        samples = np.atleast_2d(np.asarray(samples, np.float32))
-        if samples.shape[-1] != self.channels:
-            raise ValueError(
-                f"expected (n, {self.channels}) samples, got "
-                f"{samples.shape}"
-            )
         # Pass 1: consume samples, collecting the window snapshot (and
-        # the drift verdict as of that moment) at every boundary.
-        pending: list[tuple[int, np.ndarray, bool]] = []
-        pos = 0
-        n = len(samples)
-        while pos < n:
-            # advance at most to the next emission boundary, so no
-            # boundary inside a large chunk is skipped
-            take = min(self._next_emit - self._n_seen, n - pos)
-            chunk = samples[pos : pos + take]
-            if self.monitor is not None and take:
-                # per consumed chunk, NOT per push: a whole recording
-                # pushed at once must step the monitor at the same
-                # cadence live streaming would, or the debounce could
-                # never fire and events would all share one end-of-
-                # recording verdict
-                self._drift_report = self.monitor.update(chunk)
-            # roll the ring by `take`: cheap at stream chunk sizes, and
-            # keeps the window contiguous for the device transfer
-            if take >= self.window:
-                self._ring[:] = chunk[-self.window :]
-            else:
-                self._ring[: self.window - take] = self._ring[take:]
-                self._ring[self.window - take :] = chunk
-            self._n_seen += take
-            pos += take
-            if self._n_seen == self._next_emit:
-                pending.append(
-                    (
-                        self._n_seen,
-                        self._ring.copy(),
-                        bool(
-                            self._drift_report is not None
-                            and self._drift_report.drifting
-                        ),
-                    )
-                )
-                self._next_emit += self.hop
+        # the drift verdict as of that moment) at every boundary — the
+        # shared _WindowAssembler, so the fleet engine's sessions see
+        # identical snapshots for identical delivery chunks.
+        pending = self._asm.consume(samples)
         # Pass 2: score every completed window with as few dispatches as
         # possible — catch-up bursts (and offline replay through push)
         # pay one batched predict per _MAX_BATCH windows, not one
@@ -293,34 +454,7 @@ class StreamingClassifier:
         self, t_index: int, probs: np.ndarray, latency_ms: float,
         drift: bool,
     ) -> StreamEvent:
-        raw_label = int(probs.argmax())
-        if self.smoothing == "ema":
-            self._ema = (
-                probs
-                if self._ema is None
-                else self.ema_alpha * probs
-                + (1.0 - self.ema_alpha) * self._ema
-            )
-            smoothed = self._ema
-            label = int(smoothed.argmax())
-        elif self.smoothing == "vote":
-            self._votes.append(raw_label)
-            counts = np.bincount(
-                np.asarray(self._votes), minlength=probs.shape[0]
-            )
-            best = counts.max()
-            # ties break toward the newest label that achieves the max
-            label = next(
-                v for v in reversed(self._votes) if counts[v] == best
-            )
-            # the event's probability must describe the DECISION, so in
-            # vote mode it is the trailing vote distribution (the raw
-            # window's own distribution stays reachable via raw_label);
-            # probability[label] is then the vote confidence
-            smoothed = counts.astype(np.float64) / counts.sum()
-        else:
-            smoothed = probs
-            label = raw_label
+        label, raw_label, smoothed = self._smoother.step(probs)
         return StreamEvent(
             t_index=t_index,
             label=label,
@@ -374,47 +508,16 @@ class StreamingClassifier:
         MLlib replicas) — their transform has no single device program
         to time.
         """
-        # unwrap to the compiled predict through any wrapper chain:
-        # NeuralClassifierModel's ``.inner``, TemperatureScaledModel's
-        # ``.model`` — the device program is the same base forward either
-        # way (temperature/scaler are host-side).  An ExportedPredictor
-        # (StableHLO artifact) is timed via its exported call.
-        inner = self.model
-        fn = None
-        for _ in range(4):
-            if hasattr(inner, "_predict") and hasattr(inner, "params"):
-                fn = lambda x: inner._predict(inner.params, x)  # noqa: E731
-                break
-            if hasattr(inner, "device_call"):
-                fn = inner.device_call  # ExportedPredictor
-                break
-            nxt = getattr(inner, "inner", None)
-            if nxt is None:
-                nxt = getattr(inner, "model", None)
-            if nxt is None:
-                break
-            inner = nxt
-        if fn is None:
-            raise ValueError(
-                "device timing needs a NeuralModel-backed or exported-"
-                f"artifact classifier (got {type(self.model).__name__}); "
-                "e2e latency_stats() is still available"
-            )
-        import jax.numpy as jnp
-
-        x = jnp.zeros((batch, self.window, self.channels), jnp.float32)
-        fn(x).block_until_ready()  # warm
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            fn(x).block_until_ready()
-            times.append((time.perf_counter() - t0) * 1e3)
-        result = {
-            "batch": batch,
-            "iters": iters,
-            "p50_ms": round(_percentile(times, 50), 3),
-            "min_ms": round(min(times), 3),
-        }
+        # unwrap + measure via the shared helpers (device_predict_fn /
+        # measure_device_latency) so the fleet engine's calibration
+        # reports the same decomposition this classifier does
+        result = measure_device_latency(
+            self.model,
+            window=self.window,
+            channels=self.channels,
+            batch=batch,
+            iters=iters,
+        )
         self._device_ms[batch] = result
         return result
 
@@ -480,7 +583,7 @@ class StreamingClassifier:
     def drift_report(self):
         """The attached monitor's latest DriftReport (None without a
         monitor or before the first push)."""
-        return self._drift_report
+        return self._asm.drift_report
 
     def label_name(self, label: int) -> str:
         if self.class_names and 0 <= label < len(self.class_names):
@@ -494,12 +597,22 @@ def classify_session(
     *,
     window: int = 200,
     hop: int = 20,
+    timing: bool = False,
 ) -> "SessionResult":
     """Offline sliding-window classification of a full recording.
 
     Builds the strided ``(k, window, C)`` view (zero-copy) and scores it
     in one batched ``transform`` — the throughput path; equals the
     streaming path's raw labels exactly.
+
+    With ``timing=True`` the result carries the same device-vs-host
+    latency decomposition the streaming path reports: ``e2e_ms`` (host
+    staging + transfer + device + fetch for the one batched dispatch),
+    ``device_p50_ms`` (the compiled predict on a device-resident batch
+    of the same shape, ``block_until_ready``, no fetch) and
+    ``host_overhead_ms`` — the tunnel/transfer share a serving consumer
+    attributes p99 spikes to.  ``device_p50_ms`` is None for models
+    without a jitted predict (trees, MLlib replicas).
     """
     samples = np.ascontiguousarray(np.asarray(samples, np.float32))
     if samples.ndim != 2:
@@ -515,12 +628,41 @@ def classify_session(
         strides=(hop * stride0, stride0, samples.strides[1]),
         writeable=False,
     )
+    if timing:
+        # warm the (k, window, C) program OUTSIDE the timed region —
+        # otherwise e2e_ms includes trace+compile and host_overhead_ms
+        # reports compilation as tunnel/host overhead, misdirecting the
+        # exact attribution this mode exists for (the streaming path
+        # warms before timing for the same reason)
+        model.transform(windows)
+    t0 = time.perf_counter()
     preds = model.transform(windows)
+    e2e_ms = (time.perf_counter() - t0) * 1e3
     ends = window + hop * np.arange(k)
+    timing_stats = None
+    if timing:
+        try:
+            dev = measure_device_latency(
+                model, window=window, channels=samples.shape[1], batch=k
+            )
+        except ValueError:
+            dev = None  # no device program behind this model
+        timing_stats = {
+            "n_windows": k,
+            "e2e_ms": round(e2e_ms, 3),
+            "per_window_ms": round(e2e_ms / k, 4),
+            "device_p50_ms": None if dev is None else dev["p50_ms"],
+            "host_overhead_ms": (
+                None
+                if dev is None
+                else round(max(0.0, e2e_ms - dev["p50_ms"]), 3)
+            ),
+        }
     return SessionResult(
         t_index=ends,
         labels=np.asarray(preds.prediction, np.int32),
         probability=np.asarray(preds.probability),
+        timing=timing_stats,
     )
 
 
@@ -531,6 +673,8 @@ class SessionResult:
     t_index: np.ndarray  # (k,) window-end sample indices
     labels: np.ndarray  # (k,)
     probability: np.ndarray  # (k, C)
+    timing: dict | None = None  # device-vs-host decomposition of the
+    #   one batched dispatch (classify_session(timing=True) only)
 
     def __len__(self) -> int:
         return len(self.labels)

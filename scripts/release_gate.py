@@ -22,8 +22,14 @@ What it does:
      lines in README.md (anchored on the ``# smoke tier:`` / ``# full
      suite:`` comments) so the published numbers are *generated from a
      run log*, never prose.
-  3. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
-     git HEAD — the run log the README numbers trace back to.
+  3. Runs the fleet serving equivalence + SLO smoke
+     (``har_tpu.serve.slo.fleet_slo_smoke``): N multiplexed sessions
+     must emit bit-identical events to N independent classifiers with
+     zero dropped windows; a red verdict refuses the snapshot exactly
+     like a red test tier.
+  4. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+     the fleet ``{sessions, p99_ms, dropped}`` verdict, git HEAD — the
+     run log the README numbers trace back to.
 
 The end-of-round snapshot workflow is: run this, commit only on rc 0.
 """
@@ -85,6 +91,40 @@ def _collect_counts() -> tuple[int, int]:
     return smoke, total
 
 
+def _fleet_slo() -> dict:
+    """Run the fleet equivalence + SLO smoke in a fresh interpreter
+    (the gate's own process must not initialize a jax backend) and
+    return its verdict dict.  A crash is a red verdict, not a pass."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import json; from har_tpu.serve.slo import fleet_slo_smoke;"
+            " print(json.dumps(fleet_slo_smoke()))",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        return {
+            "ok": False,
+            "error": (
+                f"fleet_slo_smoke crashed (rc={proc.returncode}): "
+                f"{proc.stderr[-500:]}"
+            ),
+        }
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {
+            "ok": False,
+            "error": f"unparseable fleet_slo_smoke output: "
+                     f"{proc.stdout[-500:]}",
+        }
+
+
 def _git_head() -> str:
     try:
         return subprocess.run(
@@ -137,6 +177,15 @@ def main(argv=None) -> int:
         return 0 if ok else 1
 
     suite = None
+    fleet = None
+    if args.counts_only:
+        # carry the previous run's fleet verdict forward: a counts-only
+        # refresh must not blank the serving evidence the suite's
+        # gate-log test pins (only a full gate run regenerates it)
+        try:
+            fleet = json.loads(GATE_LOG.read_text()).get("fleet_slo")
+        except (OSError, ValueError):
+            fleet = None
     if not args.counts_only:
         t0 = time.perf_counter()
         proc = subprocess.run(
@@ -155,6 +204,16 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # serving gate: fleet equivalence + zero-drop SLO, stamped into
+        # the log below; red refuses the snapshot like a red tier
+        fleet = _fleet_slo()
+        if not fleet.get("ok"):
+            print(
+                "\nrelease_gate: RED fleet SLO smoke "
+                f"({json.dumps(fleet)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -164,6 +223,7 @@ def main(argv=None) -> int:
                 "smoke_count": smoke,
                 "total_count": total,
                 "suite": suite,
+                "fleet_slo": fleet,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -178,6 +238,7 @@ def main(argv=None) -> int:
                 "smoke": smoke,
                 "total": total,
                 "suite_rc": None if suite is None else suite["rc"],
+                "fleet_slo_ok": None if fleet is None else fleet["ok"],
                 "log": str(GATE_LOG.relative_to(REPO)),
             }
         )

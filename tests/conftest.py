@@ -18,12 +18,18 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# Persistent compilation cache (env form covers fresh interpreters; the
-# preloaded-jax branch below re-applies via config, since env vars set
-# after jax import are ignored).  min_compile_time=0: the suite's many
-# sub-second programs are exactly the ones worth caching.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/har_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+# NO persistent compilation cache for tests (r7 root-cause fix for the
+# 5 seed-era equality failures): on this jaxlib (0.4.37 CPU) an
+# executable DESERIALIZED from the persistent cache is not numerically
+# identical to the same HLO compiled fresh — measured directly: a warm
+# /tmp/har_tpu_jax_cache flipped near-tied argmax rows
+# (test_early_stopping_stops_and_restores_best: 0.7647 fresh vs 0.7255
+# warm, same params) and broke resume-equals-uninterrupted, because the
+# SECOND identical fit inside one test round-trips the entry the first
+# fit just wrote.  A suite that pins numeric equality must compare
+# programs compiled the same way, so the cache is off here; bench.py
+# keeps its own cache (throughput numbers aren't equality-pinned).
+os.environ["JAX_COMPILATION_CACHE_DIR"] = ""
 
 if "jax" in sys.modules:
     # The environment preloads jax in every interpreter; the backend is
@@ -38,11 +44,7 @@ if "jax" in sys.modules:
             "run pytest in a fresh interpreter"
         )
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir", "/tmp/har_tpu_jax_cache"
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_compilation_cache_dir", None)
 
 import pytest  # noqa: E402
 

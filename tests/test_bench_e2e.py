@@ -14,6 +14,26 @@ import json
 import pytest
 
 
+def test_real_data_lanes_stay_armed(monkeypatch, tmp_path):
+    """The 91.9% (UCI-HAR) and 0.97 (raw WISDM) claims stay falsifiable
+    on demand (VERDICT r5 item 7): with no real data present both lanes
+    return guidance-carrying skip markers — the exact text bench.main()
+    prints loudly to stderr — never vacuous synthetic numbers."""
+    monkeypatch.chdir(tmp_path)  # no ./data, no ./UCI HAR Dataset
+    monkeypatch.delenv("HAR_TPU_UCIHAR_ROOT", raising=False)
+    monkeypatch.delenv("HAR_TPU_WISDM_RAW", raising=False)
+    monkeypatch.setenv("HOME", str(tmp_path))  # defeat the ~/data probe
+
+    from har_tpu.parity import ucihar_parity_lane, wisdm_raw_lane
+
+    u = ucihar_parity_lane()
+    assert "UCI HAR Dataset" in u["skipped"]
+    assert u["expected"]["fig2_accuracy"] == 0.919
+    w = wisdm_raw_lane()
+    assert "WISDM_ar_v1.1_raw.txt" in w["skipped"]
+    assert w["target_accuracy"] == 0.97
+
+
 @pytest.mark.slow
 def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("HAR_TPU_BENCH_SMOKE", "1")
@@ -30,7 +50,8 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
 
     bench.main()
 
-    line = capsys.readouterr().out.strip().splitlines()[-1]
+    captured = capsys.readouterr()
+    line = captured.out.strip().splitlines()[-1]
     result = json.loads(line)
 
     # the driver's contract: one JSON line with these keys
@@ -43,7 +64,18 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     # every lane must be present (ran or carried a skip/error marker)
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
+        "fleet_serving",
     }
+    # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
+    # load) or carried a deadline-skip marker — never silently absent
+    fleet = extra["lanes"]["fleet_serving"]
+    if "skipped" not in fleet:
+        assert fleet["n_runs"] >= 3
+        assert fleet["windows_per_sec_median"] > 0
+        assert fleet["event_p99_ms_median"] >= 0
+        assert fleet["dropped_windows"] == 0
+        assert "chip_state_probe" in fleet
+        assert extra["fleet_event_p99_ms"] == fleet["event_p99_ms_median"]
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",
@@ -61,6 +93,12 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         "skipped" in extra["wisdm_raw_parity"]
         or "accuracy" in extra["wisdm_raw_parity"]
     )
+    # real-data lanes stay LOUD (VERDICT r5 item 7): a skipped lane
+    # announces itself on stderr, not only inside the JSON extra
+    if extra["ucihar_parity"].get("skipped"):
+        assert "ucihar_parity lane skipped" in captured.err
+    if extra["wisdm_raw_parity"].get("skipped"):
+        assert "wisdm_raw_parity lane skipped" in captured.err
     # smoke draws are throwaway: they must not touch (or carry) the
     # healthy-state cross-reference machinery
     assert "healthy_state_reference" not in extra

@@ -41,6 +41,25 @@ def test_readme_counts_match_gate_log():
     assert _readme_counts() == (log["smoke_count"], log["total_count"])
 
 
+def test_gate_log_carries_fleet_slo_verdict():
+    """The serving counterpart of the generated test counts: the gate
+    log must carry a green fleet equivalence + SLO verdict with the
+    {sessions, p99_ms, dropped} keys the README's serving story cites."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    fleet = log.get("fleet_slo")
+    assert fleet, (
+        "artifacts/test_gate.json lacks the fleet_slo verdict — run "
+        "scripts/release_gate.py"
+    )
+    for key in ("sessions", "p99_ms", "dropped"):
+        assert key in fleet
+    assert fleet["ok"] is True
+    assert fleet["equivalent"] is True
+    assert fleet["dropped"] == 0
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(

@@ -458,6 +458,50 @@ def test_device_timing_on_exported_artifact(tmp_path):
     assert "host_overhead_p50_ms" in stats
 
 
+def test_classify_session_timing_decomposition():
+    """classify_session(timing=True) carries the device-vs-host split:
+    e2e dispatch wall, device p50 at the same batch shape, and the
+    host/tunnel overhead a p99 investigation attributes spikes to."""
+    from har_tpu.data.raw_windows import synthetic_raw_stream
+    from har_tpu.features.wisdm_pipeline import FeatureSet
+    from har_tpu.models.neural_classifier import NeuralClassifier
+    from har_tpu.train.trainer import TrainerConfig
+
+    raw = synthetic_raw_stream(n_windows=64, seed=0)
+    model = NeuralClassifier(
+        "cnn1d",
+        config=TrainerConfig(batch_size=64, epochs=1, learning_rate=1e-3,
+                             seed=0),
+        model_kwargs={"channels": (8,)},
+    ).fit(FeatureSet(features=raw.windows,
+                     label=raw.labels.astype(np.int32)))
+    rec = raw.windows[:4].reshape(-1, 3)
+    res = classify_session(model, rec, window=200, hop=200, timing=True)
+    t = res.timing
+    assert t is not None
+    assert t["n_windows"] == len(res) == 4
+    assert t["e2e_ms"] > 0
+    # per_window_ms is computed from the pre-rounding e2e; compare with
+    # the rounding slack, not exactly
+    assert abs(t["per_window_ms"] - t["e2e_ms"] / 4) <= 1e-3
+    assert t["device_p50_ms"] is not None and t["device_p50_ms"] > 0
+    assert t["host_overhead_ms"] == round(
+        max(0.0, t["e2e_ms"] - t["device_p50_ms"]), 3
+    )
+    # default stays timing-free (and labels are unaffected by timing)
+    res2 = classify_session(model, rec, window=200, hop=200)
+    assert res2.timing is None
+    np.testing.assert_array_equal(res.labels, res2.labels)
+
+    # a host-side stub has no device program: e2e only, None device keys
+    res3 = classify_session(
+        _StubModel(), rec, window=200, hop=200, timing=True
+    )
+    assert res3.timing["e2e_ms"] > 0
+    assert res3.timing["device_p50_ms"] is None
+    assert res3.timing["host_overhead_ms"] is None
+
+
 def test_latency_window_bounded():
     """A long-lived session's latency memory is constant: stats cover a
     trailing window (deque maxlen), count included."""
