@@ -41,6 +41,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <a href="architecture.html">architecture</a> ·
 <a href="parallelism.html">parallelism</a> ·
 <a href="serving.html">serving</a> ·
+<a href="adaptation.html">adaptation</a> ·
 <a href="api.html">api</a></nav>
 {body}
 </body>
@@ -65,7 +66,8 @@ def build() -> list[str]:
         # other .md files (SURVEY.md, BASELINE.md, the reference's
         # README.md) have no HTML export and must stay as written
         body = re.sub(
-            r'href="(index|architecture|parallelism|api)\.md"',
+            r'href="(index|architecture|parallelism|serving|adaptation'
+            r'|api|roofline|bilstm_profile)\.md"',
             r'href="\1.html"',
             body,
         )

@@ -1,0 +1,67 @@
+"""Online adaptation: the closed loop that keeps a deployed fleet's
+model matched to its drifting input distribution.
+
+The paper's deployment scenario — continuous monitoring of elderly
+wearers — is exactly where a frozen classifier decays (remounted
+sensors, gait changes, new users).  ``har_tpu.monitoring`` detects the
+decay per session and ``har_tpu.serve`` serves one compiled model
+forever; this package closes the loop between them:
+
+  registry.py   versioned model lineage (monotone ids, parent hashes,
+                data fingerprints, atomic current pointer,
+                promote/rollback/prune)
+  trigger.py    fleet-level drift aggregation → debounced RetrainJob
+                (K sessions, common channels, onset-deduplicated,
+                hysteresis on recovery) + bounded replay buffer
+  shadow.py     candidate scoring on mirrored live dispatches
+                (bounded fraction, off the serving critical path) with
+                promotion gates
+  swap.py       the AdaptationEngine controller: retrain → shadow →
+                zero-drop hot-swap at a dispatch boundary → probation
+                with automatic rollback
+  smoke.py      the release gate's end-to-end loop check
+
+See docs/adaptation.md for the architecture and the test-pinned
+contracts (zero-drop swap, gate-failure containment, auto-rollback).
+"""
+
+from har_tpu.adapt.registry import (
+    ModelRegistry,
+    ModelVersion,
+    data_fingerprint,
+    register_classical,
+    register_neural,
+)
+from har_tpu.adapt.shadow import ShadowConfig, ShadowEvaluator
+from har_tpu.adapt.smoke import adapt_smoke
+from har_tpu.adapt.swap import (
+    AdaptationConfig,
+    AdaptationEngine,
+    RetrainPending,
+)
+from har_tpu.adapt.trigger import (
+    DriftAggregator,
+    ReplayBuffer,
+    RetrainJob,
+    RetrainTrigger,
+    TriggerConfig,
+)
+
+__all__ = [
+    "AdaptationConfig",
+    "AdaptationEngine",
+    "DriftAggregator",
+    "ModelRegistry",
+    "ModelVersion",
+    "ReplayBuffer",
+    "RetrainJob",
+    "RetrainPending",
+    "RetrainTrigger",
+    "ShadowConfig",
+    "ShadowEvaluator",
+    "TriggerConfig",
+    "adapt_smoke",
+    "data_fingerprint",
+    "register_classical",
+    "register_neural",
+]

@@ -1,0 +1,455 @@
+"""The closed adaptation loop: drift → retrain → shadow → hot-swap →
+probation → (rollback | accept).
+
+``AdaptationEngine`` is the controller that wires the pieces together
+around a live ``FleetServer``:
+
+  serving ──trigger fires──▶ retrain (caller's ``retrainer(job)``)
+     ▲                            │ candidate registered (ModelRegistry)
+     │                            ▼
+     │◀──gates fail (incumbent  shadowing: candidate scores a mirrored
+     │    keeps serving)          sample of live dispatches
+     │                            │ gates pass
+     │                            ▼
+     │                        hot swap: registry.promote + FleetServer.
+     │                          swap_model at a dispatch boundary —
+     │                          zero windows dropped, in-flight batches
+     │                          finish on the old model
+     │                            │
+     │◀──probation clean──────────┤
+     │                            │ SLO / agreement regression
+     │◀──auto-rollback: registry.rollback + swap back to the prior
+             incumbent (stats.rollbacks counted)
+
+Single-threaded like the engine it controls: ``step()`` is called from
+the serving loop (the CLI's drive loop, a bench lane, or a transport
+shim's timer) and never blocks serving beyond the synchronous
+``retrainer`` call the caller chose to run there — a deployment that
+wants retraining off-thread passes a retrainer that submits and returns
+the handle's result on a later step (``RetrainPending``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+from har_tpu.adapt.registry import ModelRegistry
+from har_tpu.adapt.shadow import ShadowConfig, ShadowEvaluator
+from har_tpu.adapt.trigger import (
+    ReplayBuffer,
+    RetrainJob,
+    RetrainTrigger,
+    TriggerConfig,
+)
+
+
+class RetrainPending(Exception):
+    """A retrainer may raise this to signal "job submitted, candidate
+    not ready" — the engine stays in ``serving`` and re-runs the
+    retrainer with the SAME job on later steps until it returns."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptationConfig:
+    """Loop-level knobs (trigger/shadow carry their own configs)."""
+
+    # dispatches a candidate may shadow before an undecided evaluation
+    # is rejected (gates that cannot accumulate evidence must not pin
+    # the loop in `shadowing` forever)
+    max_shadow_dispatches: int = 64
+    # post-swap watch: this many dispatches must complete without
+    # regression before the swap is accepted
+    probation_dispatches: int = 8
+    # regression criteria inside probation: reverse-shadow agreement of
+    # the OLD model vs the new incumbent below this floor ...
+    probation_min_agreement: float = 0.95
+    # ... with at least this much reverse evidence before agreement can
+    # condemn the swap
+    probation_min_windows: int = 16
+    # ... or this fraction of probation dispatches breaching SLO
+    probation_max_breach_frac: float = 0.5
+    # ... or ANY dispatch failure during probation (the strictest
+    # signal: the new model cannot score the live traffic at all)
+    probation_fail_on_dispatch_failure: bool = True
+
+    def __post_init__(self):
+        if self.probation_dispatches < 1:
+            raise ValueError("probation_dispatches must be >= 1")
+
+
+class AdaptationEngine:
+    """Drift-triggered retrain/shadow/swap/rollback controller.
+
+    Parameters
+    ----------
+    server:
+        The live ``FleetServer``.  The engine owns its dispatch tap.
+    registry:
+        Model lineage store.  A fresh registry gets the serving
+        incumbent registered + promoted as the bootstrap version.
+    retrainer:
+        ``retrainer(job: RetrainJob) -> model`` — produces a candidate
+        from the drifted-session replay (mixed into the caller's seed
+        set; the engine does not prescribe how).  May raise
+        ``RetrainPending`` to keep the job in flight across steps; any
+        other exception rejects the job (counted, serving untouched).
+    saver:
+        Optional ``saver(model, path)`` used to persist candidates into
+        their registry version dir (e.g. ``checkpoint.save_model``
+        partial).  Without it candidates register metadata-only.
+    clock:
+        Injectable monotonic-seconds source shared with the trigger
+        debounce — tests drive the whole loop with a FakeClock.
+    """
+
+    def __init__(
+        self,
+        server,
+        registry: ModelRegistry,
+        retrainer: Callable[[RetrainJob], object],
+        *,
+        config: AdaptationConfig | None = None,
+        trigger: RetrainTrigger | None = None,
+        trigger_config: TriggerConfig | None = None,
+        shadow_config: ShadowConfig | None = None,
+        saver: Callable | None = None,
+        clock: Callable[[], float] | None = None,
+    ):
+        self.server = server
+        self.registry = registry
+        self.retrainer = retrainer
+        self.config = config or AdaptationConfig()
+        self.shadow_config = shadow_config or ShadowConfig()
+        self._saver = saver
+        self._clock = clock or time.monotonic
+        self.trigger = trigger or RetrainTrigger(
+            trigger_config, replay=ReplayBuffer(), clock=self._clock
+        )
+        self.state = "serving"
+        self.log: list[dict] = []
+        self.retrain_jobs = 0
+        self.rejected_candidates = 0
+        self.retrain_errors = 0
+        self.registry_errors = 0
+        # lineage bootstrap: the serving model becomes the promoted
+        # incumbent so the first candidate has a parent and rollback
+        # always has a target.  On a REUSED registry the convention is
+        # that the caller serves the promoted incumbent's model — the
+        # server's version label is synced to it either way, so
+        # scored_by_version keys always map onto registry versions.
+        cur = registry.current()
+        if cur is None:
+            cur = registry.register(
+                None, note="incumbent:bootstrap", promote=True
+            )
+        server.model_version = cur.name
+        self._pending_job: RetrainJob | None = None
+        self._exclude: frozenset = frozenset()  # drifted sessions of
+        #   the job under evaluation (agreement-gate exclusion set)
+        self._shadow: ShadowEvaluator | None = None
+        self._candidate = None  # (ModelVersion, model) under shadow
+        self._shadow_start = 0  # stats.dispatches at shadow start
+        self._probation = None  # baseline dict during probation
+        server.set_dispatch_tap(self._tap)
+
+    # ----------------------------------------------------------- tap
+
+    def _tap(self, session_ids, windows, probs) -> bool:
+        """The engine's single dispatch tap: replay capture always,
+        shadow scoring (candidate or probation reverse-shadow) when one
+        is active.  Return value = "shadow actually scored" (engine
+        accounting)."""
+        self.trigger.replay.add_batch(session_ids, windows)
+        if self._shadow is not None:
+            return self._shadow(session_ids, windows, probs)
+        return False
+
+    # ---------------------------------------------------------- step
+
+    def step(self) -> dict:
+        """Advance the loop one tick: pull drift state, run whichever
+        transition is due, return ``status()``.  Safe to call at any
+        cadence — every transition is edge-triggered and debounced."""
+        self.trigger.observe_server(self.server)
+        if self.state == "serving":
+            self._step_serving()
+        elif self.state == "shadowing":
+            self._step_shadowing()
+        elif self.state == "probation":
+            self._step_probation()
+        return self.status()
+
+    def _note(self, event: str, **fields) -> None:
+        self.log.append({"event": event, "at": self._clock(), **fields})
+
+    def _step_serving(self) -> None:
+        job = self._pending_job or self.trigger.poll()
+        if job is None:
+            return
+        if self._pending_job is None:
+            self.retrain_jobs += 1
+            self._note(
+                "trigger_fired",
+                job_id=job.job_id,
+                sessions=len(job.session_ids),
+                channels=list(job.channels),
+                reason=job.reason,
+            )
+        try:
+            candidate = self.retrainer(job)
+        except RetrainPending:
+            self._pending_job = job  # re-poll the same job next step
+            return
+        except Exception as exc:
+            self.retrain_errors += 1
+            self._pending_job = None
+            # re-arm the job's episodes: a persistent drift must be
+            # able to fire again (after the cooldown) — one transient
+            # retrain error must not disarm adaptation forever
+            self.trigger.reopen(job)
+            self._note(
+                "retrain_failed",
+                job_id=job.job_id,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            return
+        self._pending_job = None
+        save = (
+            None
+            if self._saver is None
+            else (lambda path: self._saver(candidate, path))
+        )
+        from har_tpu.adapt.registry import data_fingerprint
+
+        try:
+            mv = self.registry.register(
+                save,
+                data_fingerprint=(
+                    None
+                    if job.replay is None
+                    else data_fingerprint(job.replay)
+                ),
+                note=f"candidate:job{job.job_id}",
+            )
+        except Exception as exc:
+            # registry I/O (disk full, permissions) must be contained
+            # exactly like a retrainer failure: the candidate is
+            # dropped, the incumbent keeps serving, the loop survives
+            self.registry_errors += 1
+            self.trigger.reopen(job)  # same re-arm as a retrain error
+            self._note(
+                "registry_failed",
+                op="register",
+                job_id=job.job_id,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            return
+        # the drifted sessions are excluded from the agreement gate on
+        # BOTH sides of the swap: pre-swap the incumbent is not a
+        # trustworthy reference on them (a corrective candidate SHOULD
+        # disagree there), post-swap the replaced model isn't either
+        self._exclude = frozenset(job.session_ids)
+        self._shadow = ShadowEvaluator(
+            candidate,
+            self.shadow_config,
+            exclude_sessions=self._exclude,
+            clock=self._clock,
+        )
+        self._candidate = (mv, candidate)
+        # budget baseline counts dispatch ATTEMPT outcomes (successes
+        # AND failures): a fleet whose every dispatch fails must still
+        # run the evidence budget down and reject, not pin `shadowing`
+        self._shadow_start = (
+            self.server.stats.dispatches
+            + self.server.stats.dispatch_failures
+        )
+        self.state = "shadowing"
+        self._note("shadow_started", version=mv.name, job_id=job.job_id)
+
+    def _step_shadowing(self) -> None:
+        # live incumbent baseline for the optional latency gate: the
+        # engine's own dispatch-stage mean (replaced each step — the
+        # gate compares means, so only the latest baseline matters)
+        disp = self.server.stats.dispatch
+        if disp.count:
+            self._shadow.set_incumbent_ms(disp.total_ms / disp.count)
+        gates = self._shadow.gates()
+        mv, candidate = self._candidate
+        if gates["passed"]:
+            self._swap_to(mv, candidate, gates)
+            return
+        waited = (
+            self.server.stats.dispatches
+            + self.server.stats.dispatch_failures
+            - self._shadow_start
+        )
+        if waited >= self.config.max_shadow_dispatches:
+            # undecided or failing after the evidence budget: the
+            # incumbent keeps serving, the candidate stays in the
+            # registry unpromoted (auditable, prunable)
+            self.rejected_candidates += 1
+            self._note(
+                "candidate_rejected",
+                version=mv.name,
+                gates=gates,
+                dispatches_waited=waited,
+            )
+            self._shadow = None
+            self._candidate = None
+            self.trigger.hold()
+            self.state = "serving"
+
+    def _swap_to(self, mv, candidate, gates: dict) -> None:
+        stats = self.server.stats
+        prev_version = self.server.model_version
+        prev_model = self.server.model
+        try:
+            self.registry.promote(mv.version)
+        except Exception as exc:
+            # cannot record the promotion → do not swap: an unrecorded
+            # incumbent would have no rollback trail.  The candidate is
+            # rejected, the incumbent keeps serving.
+            self.registry_errors += 1
+            self.rejected_candidates += 1
+            self._note(
+                "registry_failed",
+                op="promote",
+                version=mv.name,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            self._shadow = None
+            self._candidate = None
+            self.trigger.hold()
+            self.state = "serving"
+            return
+        self.server.swap_model(candidate, version=mv.name)
+        self.server.reset_monitors()  # re-arm: fresh episodes only
+        self.trigger.aggregator.reset()
+        self.trigger.hold()
+        # probation: reverse-shadow the REPLACED model against the new
+        # incumbent's live traffic — disagreement now means the swap
+        # changed fleet decisions more than the shadow sample promised
+        self._shadow = ShadowEvaluator(
+            prev_model,
+            ShadowConfig(
+                sample_every=1,
+                min_windows=self.config.probation_min_windows,
+            ),
+            exclude_sessions=self._exclude,
+            clock=self._clock,
+        )
+        self._candidate = None
+        self._probation = {
+            "version": mv.name,
+            "prev_version": prev_version,
+            "prev_model": prev_model,
+            "dispatches0": stats.dispatches,
+            "breaches0": stats.slo_breaches,
+            "failures0": stats.dispatch_failures,
+        }
+        self.state = "probation"
+        self._note(
+            "swapped",
+            version=mv.name,
+            from_version=prev_version,
+            shadow=gates,
+        )
+
+    def _step_probation(self) -> None:
+        cfg = self.config
+        stats = self.server.stats
+        p = self._probation
+        dispatches = stats.dispatches - p["dispatches0"]
+        breaches = stats.slo_breaches - p["breaches0"]
+        failures = stats.dispatch_failures - p["failures0"]
+        regression = None
+        if cfg.probation_fail_on_dispatch_failure and failures > 0:
+            regression = f"{failures} dispatch failure(s) post-swap"
+        elif (
+            dispatches >= 2
+            and breaches / dispatches > cfg.probation_max_breach_frac
+        ):
+            regression = (
+                f"SLO regression: {breaches}/{dispatches} post-swap "
+                "dispatches breached"
+            )
+        else:
+            agr = self._shadow.agreement
+            if (
+                agr is not None
+                and self._shadow.n_windows >= cfg.probation_min_windows
+                and agr < cfg.probation_min_agreement
+            ):
+                regression = (
+                    f"agreement regression: {agr:.4f} < "
+                    f"{cfg.probation_min_agreement} vs prior incumbent"
+                )
+        if regression is not None:
+            self._rollback(regression)
+            return
+        if dispatches >= cfg.probation_dispatches:
+            self._note(
+                "probation_passed",
+                version=p["version"],
+                dispatches=dispatches,
+                reverse_agreement=self._shadow.agreement,
+            )
+            self._shadow = None
+            self._probation = None
+            self.state = "serving"
+
+    def _rollback(self, reason: str) -> None:
+        p = self._probation
+        try:
+            rolled = self.registry.rollback()
+            registry_version = rolled.name
+        except Exception as exc:
+            # serving correctness over lineage: swap the prior model
+            # back even when the registry write fails (the pointer can
+            # be repaired; a regressing model serving the fleet cannot)
+            self.registry_errors += 1
+            registry_version = None
+            self._note(
+                "registry_failed",
+                op="rollback",
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+        self.server.swap_model(p["prev_model"], version=p["prev_version"])
+        self.server.stats.rollbacks += 1
+        self.server.reset_monitors()
+        self.trigger.aggregator.reset()
+        self.trigger.hold()
+        self._note(
+            "rolled_back",
+            to_version=p["prev_version"],
+            registry_version=registry_version,
+            from_version=p["version"],
+            reason=reason,
+        )
+        self._shadow = None
+        self._probation = None
+        self.state = "serving"
+
+    # -------------------------------------------------------- status
+
+    def status(self) -> dict:
+        """JSON-ready loop state for CLIs, bench lanes and the gate."""
+        stats = self.server.stats
+        out = {
+            "state": self.state,
+            "serving_version": self.server.model_version,
+            "retrain_jobs": self.retrain_jobs,
+            "retrain_errors": self.retrain_errors,
+            "registry_errors": self.registry_errors,
+            "rejected_candidates": self.rejected_candidates,
+            "swaps": stats.model_swaps,
+            "rollbacks": stats.rollbacks,
+            "shadow_batches": stats.shadow_batches,
+            "shadow_windows": stats.shadow_windows,
+        }
+        if self._shadow is not None:
+            key = "shadow" if self.state == "shadowing" else "probation_shadow"
+            out[key] = self._shadow.report()
+        return out

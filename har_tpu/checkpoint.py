@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import Any
 
 import jax
@@ -41,6 +42,31 @@ def _abspath(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
 
+def version_info(meta: dict) -> dict:
+    """Lineage fields from checkpoint meta, ``None``-defaulted so
+    checkpoints saved before the adapt subsystem (no version/parent/
+    created stamps) load identically — the one accessor every lineage
+    consumer (the model registry, `har serve --adapt`) reads through."""
+    return {
+        "version": meta.get("version"),
+        "parent_sha256": meta.get("parent_sha256"),
+        "created_unix": meta.get("created_unix"),
+    }
+
+
+def _stamp_lineage(meta: dict, version, parent_sha256, created_unix) -> None:
+    """version / parent_sha256 / created_unix into meta (shared by both
+    save paths).  created_unix defaults to now — every new checkpoint is
+    lineage-dateable even outside a registry."""
+    if version is not None:
+        meta["version"] = int(version)
+    if parent_sha256 is not None:
+        meta["parent_sha256"] = str(parent_sha256)
+    meta["created_unix"] = (
+        int(time.time()) if created_unix is None else int(created_unix)
+    )
+
+
 def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                model_kwargs: dict | None = None,
                dataset: str | None = None,
@@ -49,14 +75,20 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
                split_method: str | None = None,
                input_shape: tuple | None = None,
                split_seed: int | None = None,
-               train_fraction: float | None = None) -> str:
+               train_fraction: float | None = None,
+               version: int | None = None,
+               parent_sha256: str | None = None,
+               created_unix: int | None = None) -> str:
     """Persist a trained neural classifier (params + scaler + config).
 
     ``dataset`` (and ``synthetic_rows`` for synthetic fallbacks,
     ``drop_binned`` for the feature-view width, ``split_method`` for the
     train/test draw) records what the model was trained on, so
     `evaluate_checkpoint` can re-derive the matching test features without
-    the caller re-stating it.
+    the caller re-stating it.  ``version``/``parent_sha256``/
+    ``created_unix`` are the adapt registry's lineage stamps (see
+    har_tpu.adapt.registry); old checkpoints without them load unchanged
+    (``version_info`` defaults the missing fields to None).
     """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -71,6 +103,7 @@ def save_model(path: str, model: NeuralClassifierModel, model_name: str,
         "model_kwargs": model_kwargs or {},
         "num_classes": model.num_classes,
     }
+    _stamp_lineage(meta, version, parent_sha256, created_unix)
     if dataset is not None:
         meta["dataset"] = dataset
     if synthetic_rows is not None:
@@ -268,13 +301,18 @@ def save_classical_model(
     pipeline=None,
     split_seed: int | None = None,
     train_fraction: float | None = None,
+    version: int | None = None,
+    parent_sha256: str | None = None,
+    created_unix: int | None = None,
 ) -> str:
     """Persist a classical model (and optionally its feature pipeline).
 
     The reference never saves models (SURVEY §5.4); here every family is a
     servable artifact.  ``pipeline`` — the fitted PipelineModel whose
     vocabularies produced the model's design matrix — is bundled so the
-    checkpoint can featurize raw tables without refitting.
+    checkpoint can featurize raw tables without refitting.  Lineage
+    stamps (``version``/``parent_sha256``/``created_unix``) follow the
+    same contract as :func:`save_model`.
     """
     path = _abspath(path)
     os.makedirs(path, exist_ok=True)
@@ -289,6 +327,7 @@ def save_classical_model(
             for k, v in scalars.items()
         },
     }
+    _stamp_lineage(meta, version, parent_sha256, created_unix)
     if dataset is not None:
         meta["dataset"] = dataset
     if synthetic_rows is not None:

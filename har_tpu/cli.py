@@ -223,6 +223,23 @@ def _parser() -> argparse.ArgumentParser:
                     help="attach a per-session DriftMonitor (synthetic "
                          "training stats); drift verdicts flow into "
                          "the multiplexed event stream")
+    sv.add_argument("--adapt", action="store_true",
+                    help="close the drift loop (har_tpu.adapt): "
+                         "per-session monitors feed a fleet-level "
+                         "retrain trigger; a candidate shadow-scores "
+                         "mirrored live batches and is hot-swapped in "
+                         "(zero dropped windows) when the gates pass, "
+                         "with automatic rollback on post-swap "
+                         "regression.  Implies --monitor.")
+    sv.add_argument("--inject-drift", type=float, default=0.0,
+                    help="fraction of sessions whose streams shift "
+                         "mid-recording (a population-scale sensor "
+                         "re-mount) — with --adapt this exercises the "
+                         "full retrain→shadow→swap loop")
+    sv.add_argument("--registry", default=None,
+                    help="model-registry root for --adapt (versioned "
+                         "lineage + promotions log); default is a "
+                         "temp dir discarded after the run")
     sv.add_argument("--calibrate-device", action="store_true",
                     help="measure device p50 per dispatched batch "
                          "shape (checkpoint models only) so the stats "
@@ -563,6 +580,29 @@ def main(argv=None) -> int:
             window=window,
             seed=args.seed,
         )
+        # reference stats come from the CLEAN pool (computed before the
+        # drift mutation, so injected drift is drift relative to the
+        # trained distribution) — and only when a monitor needs them:
+        # a plain `serve` must not duplicate the whole fleet's samples,
+        # and the concatenated copy is dropped as soon as the two
+        # per-channel moments are out
+        monitor_ref = None
+        if args.monitor or args.adapt:
+            pool = np.concatenate(recordings)
+            monitor_ref = (pool.mean(axis=0), pool.std(axis=0))
+            del pool
+        # a fraction: clamp to [0, 1] so --inject-drift 1.5 means "all
+        # sessions", not an index past the recordings list
+        n_drifted = int(
+            args.sessions * min(max(args.inject_drift, 0.0), 1.0)
+        )
+        if n_drifted:
+            # population-scale sensor re-mount: the first n_drifted
+            # sessions' second halves shift far out of distribution
+            for i in range(n_drifted):
+                rec = recordings[i].copy()
+                rec[len(rec) // 2 :] += 25.0
+                recordings[i] = rec
         fault_hook = None
         if args.inject_stall_every:
             fault_hook = DispatchFaults(
@@ -583,68 +623,131 @@ def main(argv=None) -> int:
             ),
             fault_hook=fault_hook,
         )
-        monitor_ref = None
-        if args.monitor:
-            # population statistics of the generated fleet as the
-            # training reference; one independent DriftMonitor per
-            # session (per-session EWMA state)
-            pool = np.concatenate(recordings)
-            monitor_ref = (pool.mean(axis=0), pool.std(axis=0))
         from har_tpu.monitoring import DriftMonitor
 
+        # --adapt tightens the monitor (faster EWMA, shorter debounce)
+        # so the demo loop closes within a short synthetic drive; plain
+        # --monitor keeps the r7 defaults (20 s halflife, patience 3)
+        mon_kwargs = (
+            {"halflife": 100.0, "patience": 2} if args.adapt else {}
+        )
         for i in range(args.sessions):
             server.add_session(
                 i,
                 monitor=(
-                    DriftMonitor(*monitor_ref)
+                    DriftMonitor(*monitor_ref, **mon_kwargs)
                     if monitor_ref is not None
                     else None
                 ),
             )
-        events, report = drive_fleet(
-            server,
-            recordings,
-            seed=args.seed,
-            faults=DeliveryFaults(
-                drop_prob=args.inject_drop, delay_prob=args.inject_delay
-            ),
-        )
-        if args.calibrate_device:
-            try:
-                server.calibrate_device()
-            except ValueError as e:
-                print(f"warning: device calibration skipped: {e}",
-                      file=sys.stderr)
-        snap = server.stats_snapshot()
-        acct = snap["accounting"]
-        print(
-            json.dumps(
-                {
-                    "sessions": args.sessions,
-                    "n_events": len(events),
-                    "enqueued": acct["enqueued"],
-                    "scored": acct["scored"],
-                    "dropped": acct["dropped"],
-                    "windows_per_sec": (
-                        round(acct["scored"] / report.duration_s, 1)
-                        if report.duration_s
-                        else None
+        engine = None
+        registry_tmp = None
+        try:
+            if args.adapt:
+                import tempfile
+
+                from har_tpu.adapt import (
+                    AdaptationConfig,
+                    AdaptationEngine,
+                    ModelRegistry,
+                    ShadowConfig,
+                    TriggerConfig,
+                )
+
+                registry_root = args.registry
+                if registry_root is None:
+                    registry_tmp = registry_root = tempfile.mkdtemp(
+                        prefix="har_registry_"
+                    )
+
+                # demo retrainer: a deterministic same-family refit —
+                # the loop's plumbing (trigger → shadow → swap →
+                # probation) is what this subcommand demonstrates; a
+                # real deployment passes a retrainer that fits on
+                # job.replay + its seed set
+                def retrainer(job):
+                    return (
+                        AnalyticDemoModel()
+                        if args.checkpoint is None
+                        else model
+                    )
+
+                engine = AdaptationEngine(
+                    server,
+                    ModelRegistry(registry_root),
+                    retrainer,
+                    config=AdaptationConfig(probation_dispatches=2),
+                    trigger_config=TriggerConfig(
+                        min_sessions=(
+                            max(2, n_drifted // 2) if n_drifted else 3
+                        ),
+                        window_s=1e9,
+                        cooldown_s=1e9,
                     ),
-                    "event_p50_ms": snap["stages"]["event_ms"].get(
-                        "p50_ms"
+                    shadow_config=ShadowConfig(
+                        sample_every=1, min_windows=16
                     ),
-                    "event_p99_ms": snap["stages"]["event_ms"].get(
-                        "p99_ms"
-                    ),
-                    "degraded_events": snap["degraded_events"],
-                    "drift_events": sum(
-                        1 for ev in events if ev.event.drift
-                    ),
-                    "load": dataclasses.asdict(report),
-                    "stats": snap,
-                }
+                )
+            events, report = drive_fleet(
+                server,
+                recordings,
+                seed=args.seed,
+                faults=DeliveryFaults(
+                    drop_prob=args.inject_drop,
+                    delay_prob=args.inject_delay,
+                ),
+                on_poll=(
+                    None
+                    if engine is None
+                    else (lambda srv, rnd: engine.step())
+                ),
             )
-        )
+            if args.calibrate_device:
+                try:
+                    server.calibrate_device()
+                except ValueError as e:
+                    print(f"warning: device calibration skipped: {e}",
+                          file=sys.stderr)
+            snap = server.stats_snapshot()
+            acct = snap["accounting"]
+            print(
+                json.dumps(
+                    {
+                        "sessions": args.sessions,
+                        "n_events": len(events),
+                        "enqueued": acct["enqueued"],
+                        "scored": acct["scored"],
+                        "dropped": acct["dropped"],
+                        "windows_per_sec": (
+                            round(acct["scored"] / report.duration_s, 1)
+                            if report.duration_s
+                            else None
+                        ),
+                        "event_p50_ms": snap["stages"]["event_ms"].get(
+                            "p50_ms"
+                        ),
+                        "event_p99_ms": snap["stages"]["event_ms"].get(
+                            "p99_ms"
+                        ),
+                        "degraded_events": snap["degraded_events"],
+                        "drift_events": sum(
+                            1 for ev in events if ev.event.drift
+                        ),
+                        "adapt": (
+                            None if engine is None else engine.status()
+                        ),
+                        "load": dataclasses.asdict(report),
+                        "stats": snap,
+                    }
+                )
+            )
+        finally:
+            # the throwaway registry must not survive a failed drive
+            # (KeyboardInterrupt included) any more than a clean one
+            if registry_tmp is not None:
+                import shutil
+
+                shutil.rmtree(registry_tmp, ignore_errors=True)
         return 0
 
     if args.command == "stream":

@@ -41,6 +41,16 @@ class DriftReport:
     location_z: np.ndarray  # (C,) |ewma_mean - ref_mean| / ref_std
     scale_log_ratio: np.ndarray  # (C,) log(ewma_std / ref_std)
     n_samples: int  # total samples absorbed so far
+    onset: int | None = None  # sample index (n_samples at the flip) of
+    #   the CURRENT drift episode's onset; None while not drifting.  A
+    #   stable episode id: every report of one uninterrupted episode
+    #   carries the same onset, so an alert consumer (the adapt
+    #   trigger) can de-duplicate per episode — and a reset() re-arm
+    #   after a model swap starts a fresh episode by construction.
+    generation: int = 0  # reset() count of the emitting monitor: onset
+    #   indices restart at every reset, so (generation, onset) — not
+    #   onset alone — is the globally unambiguous episode id (a post-
+    #   reset episode can land on a numerically equal onset).
 
     @property
     def worst_channel(self) -> int:
@@ -122,11 +132,20 @@ class DriftMonitor:
         return cls(flat.mean(axis=0), flat.std(axis=0), **kwargs)
 
     def reset(self) -> None:
+        """Re-arm: back to the reference state, debounce cleared, any
+        current drift episode ended (the next episode gets a fresh
+        ``onset``).  Called after a stream restart or a model swap —
+        the new model was trained on the drifted data, so the old
+        episode's evidence must not re-alert against it."""
         self._mean = self.ref_mean.copy()
         self._var = self.ref_std.copy() ** 2
         self._n = 0
         self._over = 0
         self._drifting = False
+        self._onset: int | None = None
+        # 0 on construction, +1 per re-arm: reports stamp it so episode
+        # ids (generation, onset) never collide across resets
+        self._generation = getattr(self, "_generation", -1) + 1
 
     def update(self, samples) -> DriftReport:
         """Absorb ``(n, C)`` samples; return the current verdict."""
@@ -161,12 +180,17 @@ class DriftMonitor:
         )
         self._over = self._over + 1 if over else 0
         if self._over >= self.patience:
+            if not self._drifting:
+                self._onset = self._n  # episode starts at THIS flip
             self._drifting = True
         elif not over:
             self._drifting = False
+            self._onset = None  # recovery ends the episode
         return DriftReport(
             drifting=self._drifting,
             location_z=z,
             scale_log_ratio=ratio,
             n_samples=self._n,
+            onset=self._onset,
+            generation=self._generation,
         )

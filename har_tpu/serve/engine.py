@@ -54,6 +54,7 @@ from har_tpu.serving import (
     _Smoother,
     _WindowAssembler,
     measure_device_latency,
+    pad_pow2,
 )
 
 
@@ -185,6 +186,7 @@ class FleetServer:
         config: FleetConfig | None = None,
         fault_hook: Callable[[np.ndarray], None] | None = None,
         clock: Callable[[], float] | None = None,
+        model_version: str = "v0",
     ):
         if window <= 0 or hop <= 0:
             raise ValueError("window and hop must be positive")
@@ -198,6 +200,7 @@ class FleetServer:
         if smoothing == "vote" and vote_depth < 1:
             raise ValueError("vote_depth must be >= 1")
         self.model = model
+        self.model_version = str(model_version)
         self.window = int(window)
         self.hop = int(hop)
         self.channels = int(channels)
@@ -218,6 +221,14 @@ class FleetServer:
         self._ok_streak = 0
         # device calibration results keyed by padded batch size
         self._device_ms: dict[int, dict] = {}
+        # hot-swap state (har_tpu.adapt): a staged swap applies at the
+        # next dispatch BOUNDARY, so an in-flight batch always completes
+        # on the model that started scoring it
+        self._staged_swap: tuple | None = None
+        self._in_dispatch = False
+        # dispatch tap (shadow evaluation): called AFTER a batch's
+        # events are finalized, off the per-event latency path
+        self._dispatch_tap: Callable | None = None
 
     # ------------------------------------------------------- sessions
 
@@ -269,6 +280,17 @@ class FleetServer:
     def drift_report(self, session_id: Hashable):
         """The session's latest DriftReport (None without a monitor)."""
         return self._sessions[session_id].asm.drift_report
+
+    def reset_monitors(self) -> None:
+        """Re-arm every session's DriftMonitor (post-swap: the replaced
+        model's drift episodes must not re-alert against the model that
+        was just trained on that drifted data).  Each monitor restarts
+        at its reference state and the next episode gets a fresh
+        ``DriftReport.onset``."""
+        for sess in self._sessions.values():
+            if sess.asm.monitor is not None:
+                sess.asm.monitor.reset()
+                sess.asm.drift_report = None
 
     # ------------------------------------------------------- ingestion
 
@@ -363,6 +385,10 @@ class FleetServer:
         events: list[FleetEvent] = []
         while self._n_live and (force or self.due()):
             events.extend(self._dispatch_batch())
+        if self._staged_swap is not None:
+            # a completed dispatch IS a boundary: a swap staged from a
+            # dispatch tap applies as soon as its batch has finished
+            self._apply_swap()
         self.stats.note_queue_depth(self._n_live)
         return events
 
@@ -372,8 +398,51 @@ class FleetServer:
 
     # ------------------------------------------------------ dispatch
 
+    def swap_model(self, model, *, version: str | None = None) -> str:
+        """Stage a zero-drop hot-swap of the serving model.
+
+        The swap applies at the next dispatch BOUNDARY: queued windows
+        are never dropped, and a batch that has started scoring always
+        completes on the model that started it (calling this from a
+        dispatch tap defers to the end of that dispatch; calling it
+        between polls applies immediately — the engine is idle then).
+        Device calibration is cleared with the old model: its padded-
+        batch programs are not the new model's.  Returns the version
+        label the swap was recorded under (``stats.model_swaps``,
+        ``scored_by_version``).
+        """
+        if version is None:
+            version = f"swap{self.stats.model_swaps + 1}"
+        self._staged_swap = (model, str(version))
+        if not self._in_dispatch:
+            self._apply_swap()
+        return str(version)
+
+    def _apply_swap(self) -> None:
+        model, version = self._staged_swap
+        self._staged_swap = None
+        self.model = model
+        self.model_version = version
+        self._device_ms.clear()
+        self.stats.model_swaps += 1
+
+    def set_dispatch_tap(self, tap: Callable | None) -> None:
+        """Install (or clear, with None) the mirrored-dispatch consumer.
+
+        ``tap(session_ids, windows, probs) -> bool`` receives every
+        dispatched batch's unpadded windows and incumbent probabilities
+        AFTER the batch's events are finalized — per-event latencies
+        never include it.  A True return means the tap actually scored
+        the mirror (shadow accounting + stage timing recorded); False
+        means it sampled past the batch.  A raising tap is counted
+        (``shadow_errors``) and never interrupts serving.
+        """
+        self._dispatch_tap = tap
+
     def _dispatch_batch(self) -> list[FleetEvent]:
         cfg = self.config
+        if self._staged_swap is not None:
+            self._apply_swap()  # the dispatch boundary
         batch: list[_Pending] = []
         while self._queue and len(batch) < cfg.target_batch:
             p = self._queue.popleft()
@@ -387,14 +456,10 @@ class FleetServer:
                 (t_assembled - p.t_enqueue) * 1e3
             )
         k = len(batch)
-        pad_k = 1 << (k - 1).bit_length()
-        windows = np.stack([p.window for p in batch])
-        if pad_k != k:
-            # power-of-two padding, same policy as StreamingClassifier:
-            # at most log2(target_batch)+1 programs ever compile
-            windows = np.concatenate(
-                [windows, np.repeat(windows[-1:], pad_k - k, axis=0)]
-            )
+        # the shared power-of-two policy (serving.pad_pow2): at most
+        # log2(target_batch)+1 programs ever compile
+        windows = pad_pow2(np.stack([p.window for p in batch]))
+        pad_k = len(windows)
         try:
             probs, dispatch_ms = self._score(windows, k)
         except DispatchError:
@@ -459,11 +524,33 @@ class FleetServer:
             sess.n_live -= 1
             sess.n_scored += 1
             self._n_live -= 1
-            self.stats.scored += 1
+            # per-version attribution: the invariant holds across swaps
+            self.stats.note_scored(1, self.model_version)
             self._unlink_scored(p)
             self.stats.event.record((t_smooth0 - p.t_enqueue) * 1e3)
             events.append(FleetEvent(sess.sid, ev, degraded=shed))
         self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
+        if self._dispatch_tap is not None:
+            # mirrored sample for shadow evaluation — after the events
+            # are finalized (their latencies are already recorded), and
+            # never able to take the engine down.  _in_dispatch makes a
+            # swap_model() called from inside the tap defer to the next
+            # dispatch boundary.
+            self._in_dispatch = True
+            t_tap = self._clock()
+            try:
+                scored = self._dispatch_tap(
+                    [p.session.sid for p in batch], windows[:k], probs
+                )
+            except Exception:
+                self.stats.shadow_errors += 1
+            else:
+                if scored:
+                    self.stats.note_shadow(
+                        k, (self._clock() - t_tap) * 1e3
+                    )
+            finally:
+                self._in_dispatch = False
         return events
 
     @staticmethod
@@ -572,6 +659,7 @@ class FleetServer:
         """FleetStats snapshot + device calibration + p99 attribution."""
         snap = self.stats.snapshot()
         snap["smoothing_shed"] = self._smoothing_shed
+        snap["model_version"] = self.model_version
         if self._device_ms:
             snap["device_ms"] = {
                 str(b): d["p50_ms"]

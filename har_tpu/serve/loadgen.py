@@ -122,9 +122,16 @@ def drive_fleet(
     poll_every: int = 1,
     session_ids: list | None = None,
     delivery_log: list | None = None,
+    on_poll=None,
 ) -> tuple[list, LoadReport]:
     """Deliver every recording through the fleet engine; return
     (events, LoadReport).
+
+    ``on_poll(server, round_index)`` — optional hook invoked after each
+    scheduler poll (and once after the final flush): where a controller
+    that must run from the serving loop lives — e.g. an
+    ``AdaptationEngine.step`` driving drift-triggered retraining while
+    the fleet serves (``har serve --adapt``).
 
     Delivery is round-robin over sessions in hop-sized chunks (override
     with ``chunk``), with a seeded per-session phase offset on the
@@ -198,6 +205,8 @@ def drive_fleet(
         rounds += 1
         if rounds % poll_every == 0:
             events.extend(server.poll())
+            if on_poll is not None:
+                on_poll(server, rounds)
         if not active:
             break
     # end of stream: anything still held was delayed past the end —
@@ -212,6 +221,8 @@ def drive_fleet(
             deliveries += 1
             held[i] = []
     events.extend(server.flush())
+    if on_poll is not None:
+        on_poll(server, rounds + 1)
     report = LoadReport(
         sessions=n,
         samples_delivered=delivered,

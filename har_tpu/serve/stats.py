@@ -93,7 +93,18 @@ class FleetStats:
     assembly), ``dispatch`` (one batched transform, e2e through the
     tunnel), ``smooth`` (per-batch host-side smoothing + event build),
     ``event`` (enqueue→emit, the per-event serving latency the SLO and
-    the bench lane's p50/p99 are stated against).
+    the bench lane's p50/p99 are stated against), ``shadow`` (one
+    candidate-model scoring of a mirrored batch — off the serving
+    critical path, timed so "the shadow is slow" is observable).
+
+    Adaptation counters (har_tpu.adapt): ``model_swaps`` / ``rollbacks``
+    count hot-swap transitions, ``scored_by_version`` attributes every
+    scored window to the model version that scored it — summing it
+    reproduces ``scored``, so the conservation law ``enqueued == scored
+    + dropped + pending`` holds ACROSS a swap, per version and in total.
+    ``shadow_batches``/``shadow_windows`` count mirrored scoring
+    (never part of ``scored``: shadow work is observability, not
+    serving); ``shadow_errors`` counts swallowed shadow failures.
     """
 
     def __init__(self):
@@ -111,10 +122,18 @@ class FleetStats:
         self.queue_depth = 0
         self.queue_depth_max = 0
         self.batch_sizes: dict[int, int] = {}  # padded size -> count
+        # adaptation lifecycle (har_tpu.adapt)
+        self.model_swaps = 0
+        self.rollbacks = 0
+        self.shadow_batches = 0
+        self.shadow_windows = 0
+        self.shadow_errors = 0
+        self.scored_by_version: dict[str, int] = {}
         self.queue_wait = StageHistogram()
         self.dispatch = StageHistogram()
         self.smooth = StageHistogram()
         self.event = StageHistogram()
+        self.shadow = StageHistogram()
 
     # ------------------------------------------------------- recording
 
@@ -133,6 +152,19 @@ class FleetStats:
     def note_batch(self, padded: int) -> None:
         self.batch_sizes[padded] = self.batch_sizes.get(padded, 0) + 1
 
+    def note_scored(self, n: int, version: str) -> None:
+        """n windows scored by model ``version`` — the per-version leg
+        of the conservation law (sum over versions == scored)."""
+        self.scored += n
+        self.scored_by_version[version] = (
+            self.scored_by_version.get(version, 0) + n
+        )
+
+    def note_shadow(self, n_windows: int, ms: float) -> None:
+        self.shadow_batches += 1
+        self.shadow_windows += n_windows
+        self.shadow.record(ms)
+
     # ------------------------------------------------------- reporting
 
     def accounting(self) -> dict:
@@ -144,7 +176,13 @@ class FleetStats:
             "scored": self.scored,
             "dropped": self.dropped_total,
             "pending": pending,
-            "balanced": pending >= 0,
+            # balanced now ALSO requires the per-version attribution to
+            # conserve: a swap that lost or double-counted a window
+            # would break scored_by_version before it broke the total
+            "balanced": (
+                pending >= 0
+                and sum(self.scored_by_version.values()) == self.scored
+            ),
         }
 
     def snapshot(self) -> dict:
@@ -165,11 +203,18 @@ class FleetStats:
             "batch_sizes": {
                 str(k): v for k, v in sorted(self.batch_sizes.items())
             },
+            "model_swaps": self.model_swaps,
+            "rollbacks": self.rollbacks,
+            "shadow_batches": self.shadow_batches,
+            "shadow_windows": self.shadow_windows,
+            "shadow_errors": self.shadow_errors,
+            "scored_by_version": dict(self.scored_by_version),
             "accounting": self.accounting(),
             "stages": {
                 "queue_wait_ms": self.queue_wait.snapshot(),
                 "dispatch_ms": self.dispatch.snapshot(),
                 "smooth_ms": self.smooth.snapshot(),
                 "event_ms": self.event.snapshot(),
+                "shadow_ms": self.shadow.snapshot(),
             },
         }

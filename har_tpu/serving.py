@@ -76,6 +76,21 @@ def _percentile(values: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(values, np.float64), q))
 
 
+def pad_pow2(windows: np.ndarray) -> np.ndarray:
+    """Pad a ``(k, ...)`` batch to the next power-of-two rows by
+    repeating the last row — THE batch-shape policy of every scoring
+    path (streaming catch-up bursts, fleet dispatches, shadow mirrors),
+    so at most log2(max_batch)+1 programs ever compile and no path can
+    silently diverge from the others' compiled-shape budget."""
+    k = len(windows)
+    pad_k = 1 << (k - 1).bit_length()
+    if pad_k == k:
+        return windows
+    return np.concatenate(
+        [windows, np.repeat(windows[-1:], pad_k - k, axis=0)]
+    )
+
+
 def device_predict_fn(model):
     """The compiled device predict behind any serving wrapper chain.
 
@@ -435,11 +450,7 @@ class StreamingClassifier:
         """(probs (k, C), per-window latency share in ms) — ONE timed
         model.transform for the whole block."""
         k = len(windows)
-        pad_k = 1 << (k - 1).bit_length()
-        if pad_k != k:
-            windows = np.concatenate(
-                [windows, np.repeat(windows[-1:], pad_k - k, axis=0)]
-            )
+        windows = pad_pow2(windows)
         t0 = time.perf_counter()
         preds = self.model.transform(windows)
         latency_ms = (time.perf_counter() - t0) * 1e3

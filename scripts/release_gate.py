@@ -27,8 +27,13 @@ What it does:
      must emit bit-identical events to N independent classifiers with
      zero dropped windows; a red verdict refuses the snapshot exactly
      like a red test tier.
-  4. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
-     the fleet ``{sessions, p99_ms, dropped}`` verdict, git HEAD — the
+  4. Runs the adaptation-loop smoke (``har_tpu.adapt.smoke.adapt_smoke``):
+     injected population drift must escalate through the trigger, a
+     stub retrain must shadow-pass and hot-swap with ZERO dropped
+     windows and no rollback; red refuses the snapshot.
+  5. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+     the fleet ``{sessions, p99_ms, dropped}`` verdict, the adapt
+     ``{swaps, rollbacks, shadow_agreement}`` verdict, git HEAD — the
      run log the README numbers trace back to.
 
 The end-of-round snapshot workflow is: run this, commit only on rc 0.
@@ -91,16 +96,19 @@ def _collect_counts() -> tuple[int, int]:
     return smoke, total
 
 
-def _fleet_slo() -> dict:
-    """Run the fleet equivalence + SLO smoke in a fresh interpreter
-    (the gate's own process must not initialize a jax backend) and
-    return its verdict dict.  A crash is a red verdict, not a pass."""
+def _run_smoke(module: str, func: str) -> dict:
+    """Run one smoke check (``from {module} import {func}; func()``) in
+    a fresh interpreter — the gate's own process must not initialize a
+    jax backend — and return its verdict dict.  A crash or unparseable
+    output is a red verdict, not a pass.  The one runner for the fleet
+    SLO smoke and the adapt loop smoke, so their plumbing cannot
+    diverge."""
     proc = subprocess.run(
         [
             sys.executable,
             "-c",
-            "import json; from har_tpu.serve.slo import fleet_slo_smoke;"
-            " print(json.dumps(fleet_slo_smoke()))",
+            f"import json; from {module} import {func};"
+            f" print(json.dumps({func}()))",
         ],
         cwd=REPO,
         capture_output=True,
@@ -111,7 +119,7 @@ def _fleet_slo() -> dict:
         return {
             "ok": False,
             "error": (
-                f"fleet_slo_smoke crashed (rc={proc.returncode}): "
+                f"{func} crashed (rc={proc.returncode}): "
                 f"{proc.stderr[-500:]}"
             ),
         }
@@ -120,9 +128,18 @@ def _fleet_slo() -> dict:
     except (ValueError, IndexError):
         return {
             "ok": False,
-            "error": f"unparseable fleet_slo_smoke output: "
-                     f"{proc.stdout[-500:]}",
+            "error": f"unparseable {func} output: {proc.stdout[-500:]}",
         }
+
+
+def _fleet_slo() -> dict:
+    """Fleet equivalence + SLO smoke verdict."""
+    return _run_smoke("har_tpu.serve.slo", "fleet_slo_smoke")
+
+
+def _adapt_smoke() -> dict:
+    """Drift→retrain→shadow→swap loop smoke verdict."""
+    return _run_smoke("har_tpu.adapt.smoke", "adapt_smoke")
 
 
 def _git_head() -> str:
@@ -178,14 +195,18 @@ def main(argv=None) -> int:
 
     suite = None
     fleet = None
+    adapt = None
     if args.counts_only:
-        # carry the previous run's fleet verdict forward: a counts-only
-        # refresh must not blank the serving evidence the suite's
-        # gate-log test pins (only a full gate run regenerates it)
+        # carry the previous run's fleet + adapt verdicts forward: a
+        # counts-only refresh must not blank the serving evidence the
+        # suite's gate-log test pins (only a full gate run regenerates)
         try:
-            fleet = json.loads(GATE_LOG.read_text()).get("fleet_slo")
+            prior = json.loads(GATE_LOG.read_text())
+            fleet = prior.get("fleet_slo")
+            adapt = prior.get("adapt_smoke")
         except (OSError, ValueError):
             fleet = None
+            adapt = None
     if not args.counts_only:
         t0 = time.perf_counter()
         proc = subprocess.run(
@@ -214,6 +235,16 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # adaptation gate: drift injected → retrain stub → shadow pass
+        # → hot swap → zero dropped; red refuses like a red tier
+        adapt = _adapt_smoke()
+        if not adapt.get("ok"):
+            print(
+                "\nrelease_gate: RED adapt smoke "
+                f"({json.dumps(adapt)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -224,6 +255,7 @@ def main(argv=None) -> int:
                 "total_count": total,
                 "suite": suite,
                 "fleet_slo": fleet,
+                "adapt_smoke": adapt,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -239,6 +271,7 @@ def main(argv=None) -> int:
                 "total": total,
                 "suite_rc": None if suite is None else suite["rc"],
                 "fleet_slo_ok": None if fleet is None else fleet["ok"],
+                "adapt_smoke_ok": None if adapt is None else adapt["ok"],
                 "log": str(GATE_LOG.relative_to(REPO)),
             }
         )

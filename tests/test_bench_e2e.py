@@ -64,7 +64,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     # every lane must be present (ran or carried a skip/error marker)
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
-        "fleet_serving",
+        "fleet_serving", "adaptive_serving",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -76,6 +76,21 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         assert fleet["dropped_windows"] == 0
         assert "chip_state_probe" in fleet
         assert extra["fleet_event_p99_ms"] == fleet["event_p99_ms_median"]
+    # r8 adaptive-serving lane: the fleet numbers across a forced
+    # mid-run hot-swap — zero drops and the swap contract, or a
+    # deadline-skip marker; never silently absent
+    adaptive = extra["lanes"]["adaptive_serving"]
+    if "skipped" not in adaptive:
+        assert adaptive["n_runs"] >= 3
+        assert adaptive["windows_per_sec_median"] > 0
+        assert adaptive["dropped_windows"] == 0
+        assert adaptive["swap_contract_ok"] is True
+        assert set(adaptive["scored_by_version"]) == {"v1", "v2"}
+        assert "chip_state_probe" in adaptive
+        assert (
+            extra["adaptive_event_p99_ms"]
+            == adaptive["event_p99_ms_median"]
+        )
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",

@@ -40,6 +40,75 @@ def test_model_checkpoint_roundtrip(tmp_path):
     assert restored.scaler is not None
 
 
+def test_neural_checkpoint_lineage_meta_roundtrip(tmp_path):
+    """version/parent_sha256/created_unix ride the neural meta and come
+    back through version_info; checkpoints saved WITHOUT them (the
+    pre-adapt format) load unchanged with None defaults."""
+    from har_tpu.checkpoint import load_model_meta, version_info
+
+    data, model = _small_fit(tmp_path)
+    path = save_model(
+        str(tmp_path / "ck"), model, "mlp", {"hidden": (32,)},
+        version=7, parent_sha256="ab" * 32, created_unix=1234567890,
+    )
+    info = version_info(load_model_meta(path))
+    assert info == {
+        "version": 7,
+        "parent_sha256": "ab" * 32,
+        "created_unix": 1234567890,
+    }
+    # the lineage stamps change nothing about restoring
+    restored = load_model(path)
+    np.testing.assert_allclose(
+        model.transform(data).raw, restored.transform(data).raw,
+        rtol=1e-6,
+    )
+    # a save without explicit lineage: version/parent default to None,
+    # created_unix is auto-stamped (every new artifact is dateable)
+    p2 = save_model(str(tmp_path / "ck2"), model, "mlp", {"hidden": (32,)})
+    info2 = version_info(load_model_meta(p2))
+    assert info2["version"] is None
+    assert info2["parent_sha256"] is None
+    assert isinstance(info2["created_unix"], int)
+    # a pre-adapt checkpoint's meta (no lineage keys at all)
+    assert version_info({"model_name": "mlp"}) == {
+        "version": None, "parent_sha256": None, "created_unix": None,
+    }
+
+
+def test_classical_checkpoint_lineage_meta_roundtrip(tmp_path):
+    from har_tpu.checkpoint import (
+        load_classical_model,
+        load_model_meta,
+        save_classical_model,
+        version_info,
+    )
+    from har_tpu.models.logistic_regression import LogisticRegressionModel
+
+    model = LogisticRegressionModel(
+        coefficients=np.arange(12, dtype=np.float32).reshape(4, 3),
+        intercept=np.ones(3, np.float32),
+        num_classes=3,
+    )
+    path = save_classical_model(
+        str(tmp_path / "ck"), model,
+        version=3, parent_sha256="cd" * 32, created_unix=42,
+    )
+    info = version_info(load_model_meta(path))
+    assert info == {
+        "version": 3, "parent_sha256": "cd" * 32, "created_unix": 42,
+    }
+    restored = load_classical_model(path)
+    np.testing.assert_array_equal(
+        restored.coefficients, model.coefficients
+    )
+    # lineage-less classical save: None defaults, auto-dated
+    p2 = save_classical_model(str(tmp_path / "ck2"), model)
+    info2 = version_info(load_model_meta(p2))
+    assert info2["version"] is None and info2["parent_sha256"] is None
+    assert isinstance(info2["created_unix"], int)
+
+
 def test_train_checkpointer_resume(tmp_path):
     params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
     opt = optax.adam(1e-3)

@@ -72,6 +72,57 @@ def test_recovery_clears_flag():
     assert not report.drifting
 
 
+def test_drift_onset_is_a_stable_episode_id():
+    """onset = the sample index where the debounced flag flipped; every
+    report of one uninterrupted episode carries the SAME onset (the
+    adapt trigger de-duplicates alerts by it), and recovery clears it."""
+    mon = _monitor()  # patience=2
+    rng = np.random.default_rng(11)
+    r = mon.update(_stream(rng, 200))
+    assert r.onset is None
+    reports = [
+        mon.update(_stream(rng, 200, mean=(9.8, 0.0, 0.0)))
+        for _ in range(6)
+    ]
+    # debounce: the first over-threshold chunk has no onset yet
+    assert reports[0].onset is None and not reports[0].drifting
+    drifting = [r for r in reports if r.drifting]
+    assert drifting
+    # the onset is the flip point's sample count and never moves while
+    # the episode lasts
+    assert drifting[0].onset == drifting[0].n_samples
+    assert {r.onset for r in drifting} == {drifting[0].onset}
+    # recovery ends the episode: flag AND onset clear together
+    for _ in range(12):
+        r = mon.update(_stream(rng, 200))
+    assert not r.drifting and r.onset is None
+
+
+def test_debounce_drift_reset_redrift():
+    """The satellite contract: debounce → drift → reset() re-arm →
+    re-drift fires again as a FRESH episode (new onset, debounce
+    honored again) — what lets the trigger de-duplicate alerts across
+    a model swap."""
+    mon = _monitor()  # patience=2, halflife=100
+    rng = np.random.default_rng(12)
+    mon.update(_stream(rng, 200))
+    assert not mon.update(
+        _stream(rng, 200, mean=(9.8, 0.0, 0.0))
+    ).drifting  # debounce holds at one chunk
+    r = mon.update(_stream(rng, 200, mean=(9.8, 0.0, 0.0)))
+    assert r.drifting and r.onset == 600
+    mon.reset()
+    # re-armed: clean state, no episode, counters restarted
+    r = mon.update(_stream(rng, 200))
+    assert not r.drifting and r.onset is None and r.n_samples == 200
+    # re-drift: the debounce applies afresh, then a NEW episode fires
+    assert not mon.update(
+        _stream(rng, 200, mean=(9.8, 0.0, 0.0))
+    ).drifting
+    r = mon.update(_stream(rng, 200, mean=(9.8, 0.0, 0.0)))
+    assert r.drifting and r.onset == 600  # fresh post-reset indexing
+
+
 def test_from_windows_and_from_model_stats():
     rng = np.random.default_rng(4)
     windows = rng.normal(size=(32, 200, 3)).astype(np.float32) * 2.0 + 1.0

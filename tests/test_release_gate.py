@@ -60,6 +60,26 @@ def test_gate_log_carries_fleet_slo_verdict():
     assert fleet["dropped"] == 0
 
 
+def test_gate_log_carries_adapt_smoke_verdict():
+    """The adaptation counterpart of the fleet verdict: the gate log
+    must carry a green drift→retrain→shadow→swap loop check with the
+    {swaps, rollbacks, shadow_agreement} keys it stamps."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    adapt = log.get("adapt_smoke")
+    assert adapt, (
+        "artifacts/test_gate.json lacks the adapt_smoke verdict — run "
+        "scripts/release_gate.py"
+    )
+    for key in ("swaps", "rollbacks", "shadow_agreement"):
+        assert key in adapt
+    assert adapt["ok"] is True
+    assert adapt["swaps"] >= 1
+    assert adapt["rollbacks"] == 0
+    assert adapt["dropped"] == 0
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
