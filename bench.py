@@ -1067,6 +1067,33 @@ def main() -> None:
 
     _, adaptive_stats = deadline_lane("adaptive_serving", 25, _adaptive_lane)
 
+    # Fleet-recovery lane (r9 tentpole, har_tpu.serve.journal/recover):
+    # recovery time vs session count for a journaled fleet — write the
+    # journal under live load (every push/ack journaled, fsync-batched),
+    # kill (FleetJournal.kill drops the un-flushed buffer, the SIGKILL
+    # model), then time FleetServer.restore (snapshot + journal-suffix
+    # replay) at n_runs>=3 with median+std.  The lane's claim is the
+    # recovery CONTRACT under measurement: every run must come back with
+    # the accounting invariant intact and zero pending scored twice.
+    # Host-side by design (journal + replay are numpy/IO work); the
+    # chip probe is stamped so a degraded-draw artifact stays labeled.
+    def _recovery_lane():
+        # THE shared measurement (recover.recovery_benchmark) — also
+        # behind scripts/recovery_bench.py's committed artifact, so the
+        # lane and the artifact cannot silently diverge
+        from har_tpu.serve.recover import (
+            recovery_benchmark,
+            recovery_benchmark_summary,
+        )
+
+        session_counts = [16, 64] if smoke else [64, 256, 512]
+        rows = recovery_benchmark(session_counts, n_runs=lane_runs)
+        stats = recovery_benchmark_summary(rows, lane_runs)
+        stats["chip_state_probe"] = chip_probe
+        return None, stats
+
+    _, recovery_stats = deadline_lane("fleet_recovery", 20, _recovery_lane)
+
     # Chip-saturation lane (VERDICT r2 weak #1/item 3): a transformer
     # sized for the MXU — embed 768 (12 heads x 64), 4 layers, bf16
     # params/activations, batch 1024 over a larger synthetic stream —
@@ -1254,6 +1281,14 @@ def main() -> None:
         "adaptive_event_p99_ms": adaptive_stats.get("event_p99_ms_median"),
         "adaptive_dropped_windows": adaptive_stats.get("dropped_windows"),
         "adaptive_swap_contract_ok": adaptive_stats.get("swap_contract_ok"),
+        # crash recovery (har_tpu.serve.journal): time to restore a
+        # killed journaled fleet (snapshot + journal-suffix replay) at
+        # the largest measured session count — contract_ok pins the
+        # accounting invariant across every measured recovery
+        "fleet_recovery_ms_median": recovery_stats.get(
+            "recovery_ms_median"
+        ),
+        "fleet_recovery_contract_ok": recovery_stats.get("contract_ok"),
         "ucihar_parity": ucihar,
         "wisdm_raw_parity": wisdm_raw,
         "cv_sweep_scaling": cv_scaling,
@@ -1319,6 +1354,7 @@ def main() -> None:
         "saturation_transformer": sat_stats,
         "fleet_serving": fleet_stats,
         "adaptive_serving": adaptive_stats,
+        "fleet_recovery": recovery_stats,
     }
     result = {
         "metric": "wisdm_mlp_train_throughput",

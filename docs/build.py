@@ -42,6 +42,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <a href="parallelism.html">parallelism</a> ·
 <a href="serving.html">serving</a> ·
 <a href="adaptation.html">adaptation</a> ·
+<a href="recovery.html">recovery</a> ·
 <a href="api.html">api</a></nav>
 {body}
 </body>
@@ -67,7 +68,7 @@ def build() -> list[str]:
         # README.md) have no HTML export and must stay as written
         body = re.sub(
             r'href="(index|architecture|parallelism|serving|adaptation'
-            r'|api|roofline|bilstm_profile)\.md"',
+            r'|recovery|api|roofline|bilstm_profile)\.md"',
             r'href="\1.html"',
             body,
         )

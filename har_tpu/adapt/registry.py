@@ -37,6 +37,14 @@ import shutil
 import time
 from typing import Callable, Iterable
 
+# the shared fsync discipline (har_tpu.utils.durable): a crash after a
+# bare os.replace could surface an empty/old CURRENT or NEXT_ID, and an
+# un-synced promotions.jsonl entry would leave rollback() blind to the
+# transition it is supposed to walk back
+from har_tpu.utils.durable import atomic_write as _atomic_write
+from har_tpu.utils.durable import durable_append as _durable_append
+from har_tpu.utils.durable import fsync_dir as _fsync_dir
+
 _VERSIONS = "versions"
 _CURRENT = "CURRENT"
 _NEXT_ID = "NEXT_ID"
@@ -120,10 +128,7 @@ class ModelRegistry:
         except (OSError, ValueError):
             existing = [v.version for v in self.versions()]
             nxt = max(existing, default=0) + 1
-        tmp = counter + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(nxt + 1))
-        os.replace(tmp, counter)
+        _atomic_write(counter, str(nxt + 1))
         return nxt
 
     # ------------------------------------------------------- registry
@@ -244,21 +249,24 @@ class ModelRegistry:
         try:
             os.symlink(target, tmp)
         except OSError:
-            with open(tmp, "w") as f:  # symlink-less filesystem
-                f.write(target)
-        os.replace(tmp, ptr)
-        with open(os.path.join(self.root, _LOG), "a") as f:
-            f.write(
-                json.dumps(
-                    {
-                        "event": event,
-                        "version": mv.version,
-                        "from_version": None if prev is None else prev.version,
-                        "at_unix": int(self._clock()),
-                    }
-                )
-                + "\n"
+            _atomic_write(ptr, target)  # symlink-less filesystem
+        else:
+            os.replace(tmp, ptr)
+            # a symlink has no data to fsync; the rename's durability
+            # lives entirely in the directory entry
+            _fsync_dir(self.root)
+        _durable_append(
+            os.path.join(self.root, _LOG),
+            json.dumps(
+                {
+                    "event": event,
+                    "version": mv.version,
+                    "from_version": None if prev is None else prev.version,
+                    "at_unix": int(self._clock()),
+                }
             )
+            + "\n",
+        )
         return mv
 
     def rollback(self) -> ModelVersion:

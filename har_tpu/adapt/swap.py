@@ -116,6 +116,8 @@ class AdaptationEngine:
         shadow_config: ShadowConfig | None = None,
         saver: Callable | None = None,
         clock: Callable[[], float] | None = None,
+        resume: bool = False,
+        loader: Callable[[str], object] | None = None,
     ):
         self.server = server
         self.registry = registry
@@ -123,6 +125,7 @@ class AdaptationEngine:
         self.config = config or AdaptationConfig()
         self.shadow_config = shadow_config or ShadowConfig()
         self._saver = saver
+        self._loader = loader
         self._clock = clock or time.monotonic
         self.trigger = trigger or RetrainTrigger(
             trigger_config, replay=ReplayBuffer(), clock=self._clock
@@ -133,18 +136,6 @@ class AdaptationEngine:
         self.rejected_candidates = 0
         self.retrain_errors = 0
         self.registry_errors = 0
-        # lineage bootstrap: the serving model becomes the promoted
-        # incumbent so the first candidate has a parent and rollback
-        # always has a target.  On a REUSED registry the convention is
-        # that the caller serves the promoted incumbent's model — the
-        # server's version label is synced to it either way, so
-        # scored_by_version keys always map onto registry versions.
-        cur = registry.current()
-        if cur is None:
-            cur = registry.register(
-                None, note="incumbent:bootstrap", promote=True
-            )
-        server.model_version = cur.name
         self._pending_job: RetrainJob | None = None
         self._exclude: frozenset = frozenset()  # drifted sessions of
         #   the job under evaluation (agreement-gate exclusion set)
@@ -152,7 +143,39 @@ class AdaptationEngine:
         self._candidate = None  # (ModelVersion, model) under shadow
         self._shadow_start = 0  # stats.dispatches at shadow start
         self._probation = None  # baseline dict during probation
+        if resume:
+            # crash recovery (har_tpu.serve.recover): reconcile the
+            # recovered fleet with the registry pointer and the
+            # journaled episode state instead of bootstrapping
+            self._resume()
+        else:
+            # lineage bootstrap: the serving model becomes the promoted
+            # incumbent so the first candidate has a parent and
+            # rollback always has a target.  On a REUSED registry the
+            # convention is that the caller serves the promoted
+            # incumbent's model — the server's version label is synced
+            # to it either way, so scored_by_version keys always map
+            # onto registry versions.
+            cur = registry.current()
+            if cur is None:
+                cur = registry.register(
+                    None, note="incumbent:bootstrap", promote=True
+                )
+            server.model_version = cur.name
         server.set_dispatch_tap(self._tap)
+        # durability: the engine's episode/probation state rides the
+        # fleet journal's snapshots, and every transition is journaled
+        # as an `adapt` record — a half-finished promotion survives a
+        # SIGKILL and resumes (or rolls back) on restore
+        providers = getattr(server, "snapshot_providers", None)
+        if providers is not None:
+            providers["adapt"] = self._snapshot_state
+            if getattr(server, "journal", None) is not None:
+                # the server's attach-time snapshot predates this
+                # registration: write one that carries the adapt extra,
+                # so episode state recovers even when a crash lands
+                # before the first cadence snapshot
+                server.write_snapshot()
 
     # ----------------------------------------------------------- tap
 
@@ -183,6 +206,25 @@ class AdaptationEngine:
 
     def _note(self, event: str, **fields) -> None:
         self.log.append({"event": event, "at": self._clock(), **fields})
+        # every transition also lands in the fleet journal (t="adapt"),
+        # so recovery can tell a promotion that concluded from one the
+        # crash interrupted
+        journal = getattr(self.server, "journal", None)
+        if journal is not None:
+            try:
+                journal.append(
+                    {"t": "adapt", "ev": event, "at": self._clock(),
+                     **fields}
+                )
+            except TypeError:
+                # a non-JSON-serializable field (shouldn't happen; all
+                # note fields are scalars/lists) must not kill serving
+                journal.append({"t": "adapt", "ev": event})
+
+    def _chaos(self, point: str) -> None:
+        journal = getattr(self.server, "journal", None)
+        if journal is not None:
+            journal.chaos_point(point)
 
     def _step_serving(self) -> None:
         job = self._pending_job or self.trigger.poll()
@@ -324,6 +366,10 @@ class AdaptationEngine:
             self.trigger.hold()
             self.state = "serving"
             return
+        # the registry pointer is durable, the fleet swap is not yet: a
+        # kill HERE is the half-finished promotion the recovery path
+        # must complete (serve CURRENT) — the chaos harness pins it
+        self._chaos("mid_promote")
         self.server.swap_model(candidate, version=mv.name)
         self.server.reset_monitors()  # re-arm: fresh episodes only
         self.trigger.aggregator.reset()
@@ -356,6 +402,13 @@ class AdaptationEngine:
             from_version=prev_version,
             shadow=gates,
         )
+        # the 'swapped' record must be durable WITH the swap record: a
+        # kill after the swap flushed but before this note would
+        # otherwise recover into plain serving and skip probation —
+        # the promoted candidate would run with no watchdog
+        journal = getattr(self.server, "journal", None)
+        if journal is not None:
+            journal.flush()
 
     def _step_probation(self) -> None:
         cfg = self.config
@@ -416,6 +469,16 @@ class AdaptationEngine:
                 op="rollback",
                 error=f"{type(exc).__name__}: {str(exc)[:200]}",
             )
+        # the rollback DECISION (the registry event, or the journaled
+        # registry_failed record when the pointer write failed) must be
+        # durable before the swap-back: a kill in between must leave
+        # recovery knowing a rollback was owed, not guessing
+        journal = getattr(self.server, "journal", None)
+        if journal is not None:
+            journal.flush()
+        # mirror of mid_promote: pointer rolled back, swap-back not yet
+        # applied — recovery must land the fleet on CURRENT
+        self._chaos("mid_rollback")
         self.server.swap_model(p["prev_model"], version=p["prev_version"])
         self.server.stats.rollbacks += 1
         self.server.reset_monitors()
@@ -428,9 +491,302 @@ class AdaptationEngine:
             from_version=p["version"],
             reason=reason,
         )
+        # durable WITH the swap-back it concludes (mirror of _swap_to's
+        # flush): a kill between them would otherwise re-enter a
+        # phantom probation for the already-rolled-back version
+        journal = getattr(self.server, "journal", None)
+        if journal is not None:
+            journal.flush()
         self._shadow = None
         self._probation = None
         self.state = "serving"
+
+    # ---------------------------------------------------- durability
+
+    def _snapshot_state(self) -> dict:
+        """Episode + loop state persisted inside the fleet journal's
+        snapshots (FleetServer.snapshot_providers).  A candidate under
+        shadow is deliberately NOT persisted — a model object has no
+        journal form; recovery abandons an in-flight shadow evaluation
+        (the candidate stays registered unpromoted, the trigger
+        re-fires for a persistent drift)."""
+        agg = {}
+        for sid, st in self.trigger.aggregator._sessions.items():
+            agg[str(sid)] = {
+                "onset": st.onset,
+                "channels": sorted(st.channels),
+                "last_seen": st.last_seen,
+                "clean_streak": st.clean_streak,
+                "alerted_onset": st.alerted_onset,
+                "last_n": st.last_n,
+                "last_gen": st.last_gen,
+            }
+        return {
+            "state": self.state,
+            "probation": (
+                None
+                if self._probation is None
+                else {
+                    "version": self._probation["version"],
+                    "prev_version": self._probation["prev_version"],
+                }
+            ),
+            "trigger": {
+                "last_fired": self.trigger._last_fired,
+                "n_jobs": self.trigger._n_jobs,
+            },
+            "counters": {
+                "retrain_jobs": self.retrain_jobs,
+                "rejected_candidates": self.rejected_candidates,
+                "retrain_errors": self.retrain_errors,
+                "registry_errors": self.registry_errors,
+            },
+            "aggregator": agg,
+        }
+
+    def _resume(self) -> None:
+        """Crash-recovery reconciliation (``resume=True``): restore the
+        journaled loop state and resolve any half-finished transition.
+
+        The registry pointer is the durable source of truth for WHICH
+        version should serve: a kill between ``registry.promote`` and
+        the fleet swap (or between ``registry.rollback`` and the
+        swap-back) leaves the pointer ahead of the fleet — recovery
+        completes the swap to CURRENT via ``loader`` and, for a
+        promotion, resumes probation from a fresh baseline.  An
+        in-flight shadow evaluation is abandoned cleanly (candidate
+        stays registered unpromoted; a persistent drift re-fires after
+        the cooldown)."""
+        from har_tpu.adapt.trigger import _SessionDrift
+
+        server = self.server
+        snap = (getattr(server, "recovered_extra", None) or {}).get(
+            "adapt"
+        ) or {}
+        for k, v in (snap.get("counters") or {}).items():
+            if hasattr(self, k):
+                setattr(self, k, int(v))
+        trig = snap.get("trigger") or {}
+        if "last_fired" in trig:
+            self.trigger._last_fired = float(trig["last_fired"])
+        if "n_jobs" in trig:
+            self.trigger._n_jobs = int(trig["n_jobs"])
+        # episode state: restored per session so recovery does not
+        # double-count drift evidence or forget an alerted episode
+        sid_map = {str(sid): sid for sid in server.sessions}
+        for key, st in (snap.get("aggregator") or {}).items():
+            sid = sid_map.get(key)
+            if sid is None:
+                continue
+            s = _SessionDrift()
+            s.onset = st.get("onset")
+            s.channels = set(st.get("channels") or [])
+            s.last_seen = float(st.get("last_seen", -float("inf")))
+            s.clean_streak = int(st.get("clean_streak", 0))
+            s.alerted_onset = st.get("alerted_onset")
+            s.last_n = int(st.get("last_n", -1))
+            s.last_gen = st.get("last_gen")
+            self.trigger.aggregator._sessions[sid] = s
+        # loop state at the crash: journal suffix overrides snapshot
+        state = snap.get("state", "serving")
+        probation = snap.get("probation")
+        pending_rollback = None  # regression decided, swap-back unproven
+        for rec in getattr(server, "recovered_adapt_records", []):
+            ev = rec.get("ev")
+            if ev == "shadow_started":
+                state = "shadowing"
+            elif ev in ("swapped", "recovery_completed_promotion"):
+                state = "probation"
+                probation = {
+                    "version": rec.get("version"),
+                    "prev_version": rec.get("from_version"),
+                }
+                pending_rollback = None
+            elif ev == "recovery_resumed_probation":
+                # a PRIOR recovery resumed probation: a second crash
+                # must resume it again, not forget it
+                state = "probation"
+                if probation is None:
+                    probation = {
+                        "version": rec.get("version"),
+                        "prev_version": None,
+                    }
+            elif ev in (
+                "recovery_completed_rollback",
+                "recovery_abandoned_shadow",
+                "recovery_probation_unresumable",
+                "recovery_probation_superseded",
+                "recovery_rollback_unresumable",
+            ):
+                state = "serving"
+                probation = None
+                pending_rollback = None
+            elif ev == "registry_failed" and rec.get("op") == "rollback":
+                # the live path swaps back even when the pointer write
+                # fails ("serving correctness over lineage"); a kill
+                # between this record and the swap-back must not leave
+                # the regressing model serving — remember the intent
+                pending_rollback = probation
+                state = "serving"
+                probation = None
+            elif ev in (
+                "rolled_back", "probation_passed", "candidate_rejected",
+                "retrain_failed", "registry_failed",
+            ):
+                if ev == "rolled_back":
+                    # the swap-back is noted AFTER it applies: proven
+                    pending_rollback = None
+                state = "serving"
+                probation = None
+        # a regression verdict whose rollback never finished (registry
+        # write failed, then the kill hit before the swap-back): finish
+        # it now, exactly as the live path would have
+        completed_pending_rollback = False
+        if (
+            pending_rollback is not None
+            and server.model_version == pending_rollback.get("version")
+        ):
+            prev_version = pending_rollback.get("prev_version")
+            prev_model = None
+            if self._loader is not None:
+                try:
+                    prev_model = self._loader(prev_version)
+                except Exception:
+                    prev_model = None
+            if prev_model is None:
+                # cannot load the prior incumbent: the condemned model
+                # keeps serving, but NEVER silently — the operator (and
+                # the journal) get the unresumable verdict
+                self._note(
+                    "recovery_rollback_unresumable",
+                    version=pending_rollback.get("version"),
+                    prev_version=prev_version,
+                )
+            else:
+                try:
+                    self.registry.rollback()  # retry the pointer write
+                except Exception:
+                    self.registry_errors += 1
+                server.swap_model(prev_model, version=prev_version)
+                server.stats.rollbacks += 1
+                server.reset_monitors()
+                self.trigger.aggregator.reset()
+                self.trigger.hold()
+                completed_pending_rollback = True
+                self._note(
+                    "recovery_completed_rollback",
+                    version=prev_version,
+                    from_version=pending_rollback.get("version"),
+                )
+        # registry reconciliation: the pointer moved but the fleet
+        # didn't — complete the half-finished transition.  Skipped
+        # after a completed pending rollback whose pointer retry failed
+        # again: the pointer then still names the REGRESSING version,
+        # and "serving correctness over lineage" wins.
+        cur = self.registry.current()
+        if completed_pending_rollback:
+            cur = None
+        completed_promote = False
+        if cur is not None and cur.name != server.model_version:
+            if self._loader is None:
+                raise RuntimeError(
+                    "recovery found registry CURRENT "
+                    f"({cur.name}) != serving version "
+                    f"({server.model_version}) but no loader was given; "
+                    "pass loader=version_label->model to resume"
+                )
+            prev_version = server.model_version
+            prev_model = server.model
+            last_event = None
+            for line in self.registry.history():
+                last_event = line.get("event")
+            server.swap_model(self._loader(cur.name), version=cur.name)
+            server.reset_monitors()
+            self.trigger.aggregator.reset()
+            self.trigger.hold()
+            if last_event == "promote":
+                # finish the promotion: watch the completed swap
+                completed_promote = True
+                state = "probation"
+                probation = {
+                    "version": cur.name, "prev_version": prev_version,
+                }
+                self._probation_models = (prev_version, prev_model)
+                self._note(
+                    "recovery_completed_promotion",
+                    version=cur.name,
+                    from_version=prev_version,
+                )
+            else:  # rollback concluded: serve the restored incumbent
+                state = "serving"
+                probation = None
+                self._note(
+                    "recovery_completed_rollback",
+                    version=cur.name,
+                    from_version=prev_version,
+                )
+        if state == "shadowing":
+            # the candidate model died with the process: abandon the
+            # evaluation; the registry still holds the artifact
+            self.trigger.hold()
+            self._note("recovery_abandoned_shadow")
+            state = "serving"
+        if (
+            state == "probation"
+            and probation is not None
+            and probation.get("version") != server.model_version
+        ):
+            # the journal proves a later swap superseded the probation
+            # target (e.g. the swap-back applied but its 'rolled_back'
+            # note died in the buffer): nothing left to watch
+            self._note(
+                "recovery_probation_superseded",
+                version=probation.get("version"),
+                serving=server.model_version,
+            )
+            state = "serving"
+            probation = None
+        if state == "probation" and probation is not None:
+            prev_version = probation.get("prev_version")
+            prev_model = None
+            if completed_promote:
+                prev_model = self._probation_models[1]
+            elif self._loader is not None and prev_version:
+                try:
+                    prev_model = self._loader(prev_version)
+                except Exception:
+                    prev_model = None
+            if prev_model is None:
+                # cannot reverse-shadow or roll back without the prior
+                # model: keep serving the incumbent, say so loudly
+                self._note(
+                    "recovery_probation_unresumable",
+                    version=probation.get("version"),
+                )
+                state = "serving"
+            else:
+                stats = server.stats
+                self._shadow = ShadowEvaluator(
+                    prev_model,
+                    ShadowConfig(
+                        sample_every=1,
+                        min_windows=self.config.probation_min_windows,
+                    ),
+                    clock=self._clock,
+                )
+                self._probation = {
+                    "version": probation.get("version"),
+                    "prev_version": prev_version,
+                    "prev_model": prev_model,
+                    "dispatches0": stats.dispatches,
+                    "breaches0": stats.slo_breaches,
+                    "failures0": stats.dispatch_failures,
+                }
+                self._note(
+                    "recovery_resumed_probation",
+                    version=probation.get("version"),
+                )
+        self.state = state
 
     # -------------------------------------------------------- status
 

@@ -244,6 +244,29 @@ def _parser() -> argparse.ArgumentParser:
                     help="measure device p50 per dispatched batch "
                          "shape (checkpoint models only) so the stats "
                          "attribute p99 spikes to tunnel vs chip")
+    sv.add_argument("--journal", default=None,
+                    help="write-ahead journal directory "
+                         "(har_tpu.serve.journal): session state, "
+                         "pushed samples, scored-event acks and swap "
+                         "records become crash-recoverable; pair with "
+                         "--resume after a kill")
+    sv.add_argument("--resume", action="store_true",
+                    help="recover the fleet from --journal DIR "
+                         "(snapshot + journal-suffix replay) and resume "
+                         "delivery from each session's recovered "
+                         "watermark — acked events are never re-emitted")
+    sv.add_argument("--journal-flush-every", type=int, default=64,
+                    help="journal records buffered between fsync "
+                         "batches (acks always flush at poll "
+                         "boundaries); bounds the crash loss window")
+    sv.add_argument("--journal-snapshot-every", type=int, default=4096,
+                    help="journal records between state snapshots; "
+                         "bounds recovery replay cost")
+    sv.add_argument("--kill-after-polls", type=int, default=0,
+                    help="TESTING: os._exit(17) after N scheduler polls "
+                         "— a SIGKILL stand-in for crash-recovery "
+                         "drills (nothing is flushed beyond what the "
+                         "journal already made durable)")
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--inject-drop", type=float, default=0.0,
                     help="probability a delivery chunk is lost")
@@ -609,37 +632,74 @@ def main(argv=None) -> int:
                 stall_every=args.inject_stall_every,
                 stall_ms=args.inject_stall_ms,
             )
-        server = FleetServer(
-            model,
-            window=window,
-            channels=channels,
-            hop=args.hop,
-            smoothing=args.smoothing,
-            class_names=class_names,
-            config=FleetConfig(
-                max_sessions=args.sessions,
-                target_batch=args.target_batch,
-                max_delay_ms=args.max_delay_ms,
-            ),
-            fault_hook=fault_hook,
-        )
-        from har_tpu.monitoring import DriftMonitor
+        journal_cfg = None
+        if args.journal:
+            from har_tpu.serve import JournalConfig
 
-        # --adapt tightens the monitor (faster EWMA, shorter debounce)
-        # so the demo loop closes within a short synthetic drive; plain
-        # --monitor keeps the r7 defaults (20 s halflife, patience 3)
-        mon_kwargs = (
-            {"halflife": 100.0, "patience": 2} if args.adapt else {}
-        )
-        for i in range(args.sessions):
-            server.add_session(
-                i,
-                monitor=(
-                    DriftMonitor(*monitor_ref, **mon_kwargs)
-                    if monitor_ref is not None
-                    else None
-                ),
+            journal_cfg = JournalConfig(
+                flush_every=args.journal_flush_every,
+                snapshot_every=args.journal_snapshot_every,
             )
+        recovered_events = []
+        if args.resume:
+            if not args.journal:
+                raise SystemExit("--resume requires --journal DIR")
+            if args.adapt and args.registry is None:
+                raise SystemExit(
+                    "--resume --adapt needs a durable --registry DIR "
+                    "(the registry pointer is what recovery reconciles "
+                    "the fleet against)"
+                )
+            # recovery: snapshot + journal-suffix replay rebuilds the
+            # sessions (monitors included) and the pending queue; the
+            # synthetic transport then re-delivers from each session's
+            # recovered watermark — zero windows lost, zero re-emitted
+            server = FleetServer.restore(
+                args.journal,
+                lambda ver: model,
+                fault_hook=fault_hook,
+                journal_config=journal_cfg,
+            )
+            recovered_events = server.poll(force=True)
+            recordings = [
+                rec[server.watermark(i):] if i in server._sessions else rec
+                for i, rec in enumerate(recordings)
+            ]
+        else:
+            server = FleetServer(
+                model,
+                window=window,
+                channels=channels,
+                hop=args.hop,
+                smoothing=args.smoothing,
+                class_names=class_names,
+                config=FleetConfig(
+                    max_sessions=args.sessions,
+                    target_batch=args.target_batch,
+                    max_delay_ms=args.max_delay_ms,
+                ),
+                fault_hook=fault_hook,
+                journal=args.journal,
+                journal_config=journal_cfg,
+            )
+            from har_tpu.monitoring import DriftMonitor
+
+            # --adapt tightens the monitor (faster EWMA, shorter
+            # debounce) so the demo loop closes within a short
+            # synthetic drive; plain --monitor keeps the r7 defaults
+            # (20 s halflife, patience 3)
+            mon_kwargs = (
+                {"halflife": 100.0, "patience": 2} if args.adapt else {}
+            )
+            for i in range(args.sessions):
+                server.add_session(
+                    i,
+                    monitor=(
+                        DriftMonitor(*monitor_ref, **mon_kwargs)
+                        if monitor_ref is not None
+                        else None
+                    ),
+                )
         engine = None
         registry_tmp = None
         try:
@@ -687,7 +747,30 @@ def main(argv=None) -> int:
                     shadow_config=ShadowConfig(
                         sample_every=1, min_windows=16
                     ),
+                    resume=args.resume,
+                    loader=(lambda ver: retrainer(None)),
                 )
+            polls = {"n": 0}
+
+            def on_poll(srv, rnd):
+                if engine is not None:
+                    engine.step()
+                polls["n"] += 1
+                if (
+                    args.kill_after_polls
+                    and polls["n"] >= args.kill_after_polls
+                ):
+                    # SIGKILL stand-in: no flush, no cleanup — only
+                    # what the journal already fsynced survives
+                    import os as _os
+
+                    print(
+                        f"kill-after-polls: exiting hard at poll "
+                        f"{polls['n']}",
+                        file=sys.stderr,
+                    )
+                    _os._exit(17)
+
             events, report = drive_fleet(
                 server,
                 recordings,
@@ -697,11 +780,12 @@ def main(argv=None) -> int:
                     delay_prob=args.inject_delay,
                 ),
                 on_poll=(
-                    None
-                    if engine is None
-                    else (lambda srv, rnd: engine.step())
+                    on_poll
+                    if (engine is not None or args.kill_after_polls)
+                    else None
                 ),
             )
+            events = recovered_events + events
             if args.calibrate_device:
                 try:
                     server.calibrate_device()
@@ -736,6 +820,10 @@ def main(argv=None) -> int:
                         "adapt": (
                             None if engine is None else engine.status()
                         ),
+                        "journal": args.journal,
+                        "resumed": bool(args.resume),
+                        "recoveries": snap["recoveries"],
+                        "lost_in_crash": acct["lost_in_crash"],
                         "load": dataclasses.asdict(report),
                         "stats": snap,
                     }

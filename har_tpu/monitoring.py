@@ -147,6 +147,50 @@ class DriftMonitor:
         # ids (generation, onset) never collide across resets
         self._generation = getattr(self, "_generation", -1) + 1
 
+    def state(self) -> dict:
+        """Full JSON-serializable state — knobs, reference stats, EWMA
+        state and the live episode (onset/generation) — so a recovered
+        stream's drift verdicts continue the pre-crash episode instead
+        of restarting cold.  Serialization lives HERE, next to the
+        fields it depends on: a representation change must update both
+        sides in one place (the fleet journal snapshots call this)."""
+        return {
+            "ref_mean": [float(v) for v in self.ref_mean],
+            "ref_std": [float(v) for v in self.ref_std],
+            "halflife": self.halflife,
+            "z_threshold": self.z_threshold,
+            "scale_threshold": self.scale_threshold,
+            "patience": self.patience,
+            "mean": [float(v) for v in self._mean],
+            "var": [float(v) for v in self._var],
+            "n": self._n,
+            "over": self._over,
+            "drifting": self._drifting,
+            "onset": self._onset,
+            "generation": self._generation,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "DriftMonitor":
+        """Rebuild a monitor from ``state()`` output."""
+        m = cls(
+            state["ref_mean"],
+            state["ref_std"],
+            halflife=state.get("halflife", 400.0),
+            z_threshold=state.get("z_threshold", 3.0),
+            scale_threshold=state.get("scale_threshold", 0.69),
+            patience=state.get("patience", 3),
+        )
+        m._mean = np.asarray(state["mean"], np.float64)
+        m._var = np.asarray(state["var"], np.float64)
+        m._n = int(state.get("n", 0))
+        m._over = int(state.get("over", 0))
+        m._drifting = bool(state.get("drifting", False))
+        onset = state.get("onset")
+        m._onset = None if onset is None else int(onset)
+        m._generation = int(state.get("generation", 0))
+        return m
+
     def update(self, samples) -> DriftReport:
         """Absorb ``(n, C)`` samples; return the current verdict."""
         x = np.atleast_2d(np.asarray(samples, np.float64))

@@ -6,11 +6,23 @@ Public surface:
   FleetStats                              — observability (stats.py)
   DispatchFaults / DeliveryFaults / FakeClock — fault injection
   AnalyticDemoModel / synthetic_sessions / drive_fleet — load generation
+  FleetJournal / JournalConfig            — durability (journal.py)
+  restore_server / recovery_smoke         — crash recovery (recover.py)
+  KILL_POINTS / run_kill_point            — kill-point chaos (chaos.py)
   fleet_slo_smoke                         — the release gate's check
 
-See docs/serving.md for the architecture and the equivalence contract.
+See docs/serving.md for the architecture and the equivalence contract,
+docs/recovery.md for the journal format and the recovery invariants.
 """
 
+from har_tpu.serve.chaos import (
+    ENGINE_KILL_POINTS,
+    KILL_POINTS,
+    KillPlan,
+    SimulatedCrash,
+    run_kill_point,
+    run_random_kill,
+)
 from har_tpu.serve.engine import (
     AdmissionError,
     DispatchError,
@@ -24,11 +36,21 @@ from har_tpu.serve.faults import (
     FakeClock,
     InjectedDispatchFailure,
 )
+from har_tpu.serve.journal import (
+    FleetJournal,
+    JournalConfig,
+    JournalError,
+)
 from har_tpu.serve.loadgen import (
     AnalyticDemoModel,
     LoadReport,
     drive_fleet,
     synthetic_sessions,
+)
+from har_tpu.serve.recover import (
+    RecoveryError,
+    recovery_smoke,
+    restore_server,
 )
 from har_tpu.serve.slo import events_equal, fleet_slo_smoke
 from har_tpu.serve.stats import FleetStats, StageHistogram
@@ -39,16 +61,28 @@ __all__ = [
     "DeliveryFaults",
     "DispatchError",
     "DispatchFaults",
+    "ENGINE_KILL_POINTS",
     "FakeClock",
     "FleetConfig",
     "FleetEvent",
+    "FleetJournal",
     "FleetServer",
     "FleetStats",
     "InjectedDispatchFailure",
+    "JournalConfig",
+    "JournalError",
+    "KILL_POINTS",
+    "KillPlan",
     "LoadReport",
+    "RecoveryError",
+    "SimulatedCrash",
     "StageHistogram",
     "drive_fleet",
     "events_equal",
     "fleet_slo_smoke",
+    "recovery_smoke",
+    "restore_server",
+    "run_kill_point",
+    "run_random_kill",
     "synthetic_sessions",
 ]

@@ -48,11 +48,17 @@ from typing import Callable, Hashable, Sequence
 
 import numpy as np
 
+from har_tpu.serve.journal import (
+    FleetJournal,
+    JournalConfig,
+    monitor_state,
+)
 from har_tpu.serve.stats import FleetStats
 from har_tpu.serving import (
     StreamEvent,
     _Smoother,
     _WindowAssembler,
+    finite_rows,
     measure_device_latency,
     pad_pow2,
 )
@@ -99,6 +105,11 @@ class FleetConfig:
     # fraction of the live queue shed (stalest first) at degradation
     # level 2 — scoring shed, the last resort before unbounded latency
     shed_fraction: float = 0.5
+    # ingest guard: sample rows that are non-finite or exceed this
+    # magnitude are rejected per-session (counted, never raised) before
+    # they can poison a micro-batch; None disables the range check but
+    # never the NaN/Inf one (serving.finite_rows)
+    max_abs_sample: float | None = 1e6
 
     def __post_init__(self):
         if self.max_sessions <= 0 or self.target_batch <= 0:
@@ -142,7 +153,7 @@ class _FleetSession:
     """Per-session state: ring buffer + smoother + bounded queue."""
 
     __slots__ = ("sid", "asm", "smoother", "pending", "n_live",
-                 "n_enqueued", "n_scored", "n_dropped")
+                 "n_enqueued", "n_scored", "n_dropped", "raw_seen")
 
     def __init__(self, sid, asm, smoother):
         self.sid = sid
@@ -155,6 +166,11 @@ class _FleetSession:
         self.n_enqueued = 0
         self.n_scored = 0
         self.n_dropped = 0
+        # samples delivered by the transport INCLUDING rows the ingest
+        # guard rejected — the watermark must speak the transport's raw
+        # stream coordinates, or one rejected NaN row would shift every
+        # post-crash re-delivery by one sample
+        self.raw_seen = 0
 
 
 class FleetServer:
@@ -187,6 +203,8 @@ class FleetServer:
         fault_hook: Callable[[np.ndarray], None] | None = None,
         clock: Callable[[], float] | None = None,
         model_version: str = "v0",
+        journal: FleetJournal | str | None = None,
+        journal_config: JournalConfig | None = None,
     ):
         if window <= 0 or hop <= 0:
             raise ValueError("window and hop must be positive")
@@ -229,6 +247,208 @@ class FleetServer:
         # dispatch tap (shadow evaluation): called AFTER a batch's
         # events are finalized, off the per-event latency path
         self._dispatch_tap: Callable | None = None
+        # durability (har_tpu.serve.journal): an attached journal makes
+        # every mutation below crash-recoverable; _replaying suppresses
+        # re-journaling while recovery replays the suffix through these
+        # same code paths
+        self._journal: FleetJournal | None = None
+        self._replaying = False
+        # extra snapshot state registered by controllers riding this
+        # server (the AdaptationEngine persists its episode/probation
+        # state here), and what recovery read back for them
+        self.snapshot_providers: dict[str, Callable[[], dict]] = {}
+        self.recovered_extra: dict = {}
+        if journal is not None:
+            self.attach_journal(journal, journal_config)
+
+    # ----------------------------------------------------- durability
+
+    def attach_journal(
+        self,
+        journal: FleetJournal | str,
+        config: JournalConfig | None = None,
+        *,
+        snapshot: bool = True,
+        require_fresh: bool = True,
+    ) -> FleetJournal:
+        """Attach a write-ahead journal (a FleetJournal or a directory
+        path) and write the attach-time snapshot — from then on every
+        fleet mutation is crash-recoverable via ``FleetServer.restore``.
+        The snapshot makes recovery unconditional: a journal directory
+        always holds at least one complete state to replay from.
+
+        A FRESH attach onto a directory that already holds a journal is
+        refused (``require_fresh``): the attach snapshot's rotation
+        would silently destroy the crashed fleet's recovery data —
+        restore first (``FleetServer.restore`` / ``--resume``) or point
+        at an empty directory.  ``FleetServer.restore`` re-attaches
+        with ``require_fresh=False`` after it has replayed the state."""
+        if isinstance(journal, str):
+            journal = FleetJournal(journal, config)
+        if require_fresh and journal.has_state():
+            from har_tpu.serve.journal import JournalError
+
+            raise JournalError(
+                f"journal directory {journal.root} already holds a "
+                "fleet journal; attaching fresh would destroy its "
+                "crash-recovery data — resume it (FleetServer.restore "
+                "/ `har serve --resume`) or use an empty directory"
+            )
+        self._journal = journal
+        if snapshot:
+            self.write_snapshot()
+        return journal
+
+    @property
+    def journal(self) -> FleetJournal | None:
+        return self._journal
+
+    def _chaos(self, point: str) -> None:
+        """Kill-point hook: no-op in production, raises a simulated
+        crash at the chaos harness's chosen stage boundary."""
+        if self._journal is not None:
+            self._journal.chaos_point(point)
+
+    def _jappend(self, meta: dict, payload: bytes = b"") -> None:
+        if self._journal is not None and not self._replaying:
+            self._journal.append(meta, payload)
+
+    def write_snapshot(self) -> None:
+        """Persist full fleet state to the journal (atomic; rotates the
+        journal segment).  Called automatically at the snapshot cadence
+        (JournalConfig.snapshot_every) from poll()."""
+        if self._journal is None:
+            return
+        state, arrays = self._snapshot_state()
+        self._journal.write_snapshot(state, arrays)
+
+    def _snapshot_state(self) -> tuple[dict, dict]:
+        """Everything a dead process needs restated: geometry + config,
+        per-session assembler/smoother/monitor state, the live queue in
+        global FIFO order, stats counters, and controller extras."""
+        sids = list(self._sessions)
+        sessions = []
+        arrays: dict[str, np.ndarray] = {}
+        for i, sid in enumerate(sids):
+            sess = self._sessions[sid]
+            asm = sess.asm
+            arrays[f"ring{i}"] = asm._ring
+            sm = sess.smoother
+            if sm._ema is not None:
+                arrays[f"ema{i}"] = np.asarray(sm._ema, np.float64)
+            sessions.append(
+                {
+                    "sid": sid,
+                    "n_seen": asm._n_seen,
+                    "raw_seen": sess.raw_seen,
+                    "next_emit": asm._next_emit,
+                    "n_enqueued": sess.n_enqueued,
+                    "n_scored": sess.n_scored,
+                    "n_dropped": sess.n_dropped,
+                    "votes": list(sm._votes),
+                    "monitor": monitor_state(asm.monitor),
+                }
+            )
+        sid_index = {sid: i for i, sid in enumerate(sids)}
+        pending_meta = []
+        pending_windows = []
+        for p in self._queue:
+            if p.dropped:
+                continue
+            pending_meta.append(
+                [sid_index[p.session.sid], p.t_index, bool(p.drift)]
+            )
+            pending_windows.append(p.window)
+        if pending_windows:
+            arrays["pending"] = np.stack(pending_windows)
+        state = {
+            "geometry": {
+                "window": self.window,
+                "hop": self.hop,
+                "channels": self.channels,
+                "smoothing": self.smoothing,
+                "ema_alpha": self.ema_alpha,
+                "vote_depth": self.vote_depth,
+                "class_names": self.class_names,
+                "model_version": self.model_version,
+            },
+            "config": dataclasses.asdict(self.config),
+            "ladder": {
+                "smoothing_shed": self._smoothing_shed,
+                "breaches": self._breaches,
+                "ok_streak": self._ok_streak,
+            },
+            "stats": self.stats.state(),
+            "sessions": sessions,
+            "pending": pending_meta,
+            "extra": {
+                name: fn() for name, fn in self.snapshot_providers.items()
+            },
+        }
+        return state, arrays
+
+    @classmethod
+    def restore(cls, journal_dir: str, model, **kwargs) -> "FleetServer":
+        """Recover a crashed fleet: load the newest snapshot, replay the
+        journal suffix, re-attach the journal.  See
+        ``har_tpu.serve.recover.restore_server`` for the full contract
+        (``model`` may be one model object or a ``version -> model``
+        loader callable)."""
+        from har_tpu.serve.recover import restore_server
+
+        return restore_server(journal_dir, model, **kwargs)
+
+    def watermark(self, session_id: Hashable) -> int:
+        """Samples durably delivered for this session, in the
+        TRANSPORT's raw stream coordinates (rows the ingest guard
+        rejected included) — where a resuming transport should restart
+        delivery after a crash.  Re-delivering from here makes recovery
+        lossless (windows_lost == 0): the assembler applies the same
+        guard to the same rows, so its state is deterministic in the
+        raw stream."""
+        return self._sessions[session_id].raw_seen
+
+    def declare_lost(self, session_id: Hashable, stream_position: int) -> int:
+        """A resuming transport that CANNOT replay declares the gap:
+        samples between the recovered watermark and ``stream_position``
+        are gone.  The assembler fast-forwards (the next window needs a
+        full fresh fill — no window may silently mix pre-gap zeros with
+        post-gap samples), and every window an uninterrupted run would
+        have emitted from the gap is counted as enqueued AND
+        lost_in_crash, extending the conservation law to
+        ``enqueued == scored + dropped + pending + lost_in_crash``.
+        Returns the number of windows lost; bounded by the journal
+        flush interval times the push rate."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise AdmissionError(f"unknown session {session_id!r}")
+        asm = sess.asm
+        pos = int(stream_position)
+        gap = pos - sess.raw_seen  # transport coordinates
+        if gap <= 0:
+            return 0
+        # the gap is applied in ACCEPTED-sample space assuming the lost
+        # rows were clean (what the guard would have rejected in them
+        # is unknowable); boundaries b (grid next_emit, next_emit+hop,
+        # ...) need samples (b-window, b] — any b < end+window would
+        # include lost samples
+        end = asm._n_seen + gap
+        first_ok = end + self.window
+        lost = max(
+            0, -(-(first_ok - asm._next_emit) // self.hop)  # ceil div
+        )
+        asm._next_emit += lost * self.hop
+        asm._ring[:] = 0.0
+        asm._n_seen = end
+        sess.raw_seen = pos
+        if lost:
+            sess.n_enqueued += lost
+            self.stats.enqueued += lost
+            self.stats.lost_in_crash += lost
+            self._jappend(
+                {"t": "lost", "sid": session_id, "pos": pos, "n": lost}
+            )
+        return lost
 
     # ------------------------------------------------------- sessions
 
@@ -252,6 +472,11 @@ class FleetServer:
             _Smoother(self.smoothing, self.ema_alpha, self.vote_depth),
         )
         self.stats.sessions = len(self._sessions)
+        # the add record carries the monitor's full state so a session
+        # admitted after the last snapshot recovers WITH its monitor
+        self._jappend(
+            {"t": "add", "sid": session_id, "mon": monitor_state(monitor)}
+        )
 
     def remove_session(self, session_id: Hashable) -> None:
         """Evict a session; its queued windows are dropped (reason
@@ -272,6 +497,9 @@ class FleetServer:
             self.stats.drop(n, "session_removed")
         self.stats.sessions = len(self._sessions)
         self.stats.note_queue_depth(self._n_live)
+        # replay re-derives the dropped windows from the same queue
+        # state, so the record carries only the eviction itself
+        self._jappend({"t": "remove", "sid": session_id})
 
     @property
     def sessions(self) -> tuple:
@@ -305,6 +533,40 @@ class FleetServer:
                 f"unknown session {session_id!r}; add_session first"
             )
         now = self._clock()
+        # ingest guard (serving.finite_rows — the same guard a
+        # standalone StreamingClassifier applies, so equivalence holds
+        # on poisoned streams too): one NaN row must never ride a
+        # window into a 256-session micro-batch
+        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        if samples.shape[-1] != self.channels:
+            # validate BEFORE journaling or advancing the watermark: a
+            # malformed push must raise to its caller, never write a
+            # record replay cannot reshape (which would poison the
+            # journal and make the whole fleet unrecoverable)
+            raise ValueError(
+                f"expected (n, {self.channels}) samples, got "
+                f"{samples.shape}"
+            )
+        raw_len = len(samples)
+        sess.raw_seen += raw_len
+        samples, n_bad = finite_rows(samples, self.config.max_abs_sample)
+        self.stats.rejected_samples += n_bad
+        # journal the CLEAN samples before consuming them: replay feeds
+        # exactly these rows back through the same assembler, so the
+        # recovered ring/monitor state is bit-identical by construction.
+        # ``rn`` records the RAW delivered length (rejected rows
+        # included) so the recovered watermark stays in transport
+        # coordinates.
+        if len(samples) or n_bad:
+            self._jappend(
+                {
+                    "t": "push",
+                    "sid": session_id,
+                    "n": len(samples),
+                    "rn": raw_len,
+                },
+                samples.tobytes(),
+            )
         completed = sess.asm.consume(samples)
         for t_index, win, drift in completed:
             p = _Pending(sess, t_index, win, drift, now)
@@ -324,6 +586,7 @@ class FleetServer:
         if overflow > 0:
             self._shed_stalest(overflow, "backpressure")
         self.stats.note_queue_depth(self._n_live)
+        self._chaos("post_enqueue")
         return len(completed)
 
     def _drop_oldest_of(self, sess: _FleetSession, reason: str) -> None:
@@ -338,15 +601,28 @@ class FleetServer:
                 self.stats.drop(1, reason)
                 return
 
-    def _shed_stalest(self, n: int, reason: str) -> int:
+    def _shed_stalest(self, n: int, reason: str, record: bool = False) -> int:
         """Drop up to n live windows from the global FIFO head (the
         stalest enqueued data).  The queue entry is left in place with
-        its flag set; scoring and session queues skip flagged entries."""
+        its flag set; scoring and session queues skip flagged entries.
+        ``record`` journals each drop — needed for dispatch-time sheds
+        (slo_shed), whose trigger (wall-clock SLO breaches) a journal
+        replay cannot re-derive; push-time sheds are deterministic in
+        the record stream and re-derive instead."""
         shed = 0
         for p in self._queue:
             if shed >= n:
                 break
             if not p.dropped:
+                if record:
+                    self._jappend(
+                        {
+                            "t": "drop",
+                            "sid": p.session.sid,
+                            "ti": p.t_index,
+                            "reason": reason,
+                        }
+                    )
                 p.dropped = True
                 p.window = None
                 p.session.n_live -= 1
@@ -382,6 +658,17 @@ class FleetServer:
         dispatch that fails after retries drops its own windows and
         keeps the engine serving — the error is counted, not raised.
         """
+        if (
+            self._journal is not None
+            and not self._replaying
+            and self._journal.snapshot_due()
+        ):
+            # snapshot at the START of a poll: a dispatch boundary with
+            # no not-yet-returned acks in the buffer — a kill inside
+            # the snapshot can only lose re-scorable pending windows,
+            # never an acked-but-undelivered event
+            self.write_snapshot()
+        self._chaos("pre_dispatch")
         events: list[FleetEvent] = []
         while self._n_live and (force or self.due()):
             events.extend(self._dispatch_batch())
@@ -390,6 +677,11 @@ class FleetServer:
             # dispatch tap applies as soon as its batch has finished
             self._apply_swap()
         self.stats.note_queue_depth(self._n_live)
+        if self._journal is not None and not self._replaying:
+            # THE ack boundary: every event about to be returned has its
+            # ack durable first, so a consumer can never see an event
+            # that recovery would emit again (zero double-scored)
+            self._journal.flush()
         return events
 
     def flush(self) -> list[FleetEvent]:
@@ -425,6 +717,14 @@ class FleetServer:
         self.model_version = version
         self._device_ms.clear()
         self.stats.model_swaps += 1
+        # journaled swap boundary: the record is appended, the chaos
+        # hook may kill here (record buffered, NOT durable — recovery
+        # then serves the pre-swap version and the controller re-issues
+        # the swap), then the flush makes it durable
+        self._jappend({"t": "swap", "ver": version})
+        self._chaos("mid_swap")
+        if self._journal is not None and not self._replaying:
+            self._journal.flush()
 
     def set_dispatch_tap(self, tap: Callable | None) -> None:
         """Install (or clear, with None) the mirrored-dispatch consumer.
@@ -450,6 +750,7 @@ class FleetServer:
                 batch.append(p)
         if not batch:
             return []
+        self._chaos("mid_dispatch")
         t_assembled = self._clock()
         for p in batch:
             self.stats.queue_wait.record(
@@ -464,7 +765,9 @@ class FleetServer:
             probs, dispatch_ms = self._score(windows, k)
         except DispatchError:
             # graceful degradation: this batch's windows are shed, the
-            # engine keeps serving every other stream
+            # engine keeps serving every other stream.  Journaled per
+            # window: unlike push-side sheds, a dispatch failure is not
+            # derivable from the replayed record stream.
             for p in batch:
                 p.dropped = True
                 p.window = None
@@ -472,6 +775,14 @@ class FleetServer:
                 p.session.n_dropped += 1
                 self._n_live -= 1
                 self._unlink_scored(p)
+                self._jappend(
+                    {
+                        "t": "drop",
+                        "sid": p.session.sid,
+                        "ti": p.t_index,
+                        "reason": "dispatch_failed",
+                    }
+                )
             self.stats.drop(k, "dispatch_failed")
             self.stats.dispatch_failures += 1
             self._note_slo(breached=True)
@@ -493,6 +804,7 @@ class FleetServer:
         lat_share = dispatch_ms / k
 
         t_smooth0 = self._clock()
+        self._chaos("post_score_pre_ack")
         events: list[FleetEvent] = []
         for p, pr in zip(batch, probs):
             sess = p.session
@@ -528,6 +840,21 @@ class FleetServer:
             self.stats.note_scored(1, self.model_version)
             self._unlink_scored(p)
             self.stats.event.record((t_smooth0 - p.t_enqueue) * 1e3)
+            # the scored-event ack: carries the probabilities so replay
+            # re-steps the smoother to the exact pre-crash state
+            # without re-scoring (and `shed` so a frozen smoother stays
+            # frozen); durable at the end-of-poll flush, BEFORE the
+            # consumer can observe the event
+            self._jappend(
+                {
+                    "t": "ack",
+                    "sid": sess.sid,
+                    "ti": p.t_index,
+                    "ver": self.model_version,
+                    "shed": shed,
+                },
+                np.asarray(pr, np.float64).tobytes(),
+            )
             events.append(FleetEvent(sess.sid, ev, degraded=shed))
         self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
         if self._dispatch_tap is not None:
@@ -607,10 +934,12 @@ class FleetServer:
                 if not self._smoothing_shed:
                     self._smoothing_shed = True
                     self.stats.smoothing_shed_transitions += 1
+                    self._jappend({"t": "shed", "on": True})
                 else:
                     self._shed_stalest(
                         max(1, int(self._n_live * cfg.shed_fraction)),
                         "slo_shed",
+                        record=True,
                     )
                 self._breaches = 0  # each ladder step needs fresh evidence
         else:
@@ -622,6 +951,7 @@ class FleetServer:
             ):
                 self._smoothing_shed = False
                 self._ok_streak = 0
+                self._jappend({"t": "shed", "on": False})
 
     @property
     def smoothing_shed(self) -> bool:

@@ -1,0 +1,360 @@
+"""Crash-safe durability for the fleet serving engine: an append-only,
+fsync-batched write-ahead journal plus periodic state snapshots.
+
+PR 2/3 made the fleet fault-tolerant *while alive* (retry ladders,
+zero-drop swaps, contained registry I/O) — and kept every byte of it in
+process memory, so one SIGKILL erased a 1,000-session fleet.  Spark's
+core robustness claim is exactly the property that rewrite dropped:
+lineage-based recomputation after worker loss.  This module is the
+JAX-side equivalent, shaped for a serving loop instead of an RDD DAG:
+
+  - ``FleetJournal`` — an append-only log of fleet MUTATIONS (session
+    add/remove, pushed samples, scored-event acks, drops, declared
+    losses, swap records, adaptation transitions).  Records are
+    buffered in memory and written+fsynced in batches
+    (``JournalConfig.flush_every``) plus at every ack boundary — so a
+    kill loses AT MOST the un-flushed suffix, never a torn or
+    reordered prefix;
+  - periodic SNAPSHOTS of full per-session state (ring buffers,
+    smoother state, drift-monitor state, queued windows, stats
+    counters, adaptation episode state) written atomically
+    (tmp + fsync + rename + dir fsync) with the journal rotated to a
+    fresh segment — recovery cost is bounded by the snapshot cadence,
+    not the fleet's lifetime;
+  - recovery (har_tpu.serve.recover) = load newest snapshot + replay
+    the journal suffix.  The binary framing is torn-tail-safe: each
+    record carries its length and a CRC, so a record half-written at
+    the kill instant is detected and discarded instead of corrupting
+    the replay.
+
+Durability contract (test-pinned by the kill-point chaos harness,
+har_tpu.serve.chaos):
+
+  - an event DELIVERED to the consumer has its ack on disk (poll()
+    flushes acks before returning), so recovery never re-emits it —
+    zero double-scored, zero double-counted events;
+  - a window enqueued but not acked is recovered as pending and scored
+    after restart — with a deterministic model, bit-identically to an
+    uninterrupted run;
+  - windows whose push records never reached disk are re-deliverable
+    from the recovered per-session watermark (``FleetServer.
+    watermark``); a transport that cannot replay declares them lost
+    (``FleetServer.declare_lost``) and the accounting extends to
+    ``enqueued == scored + dropped + pending + lost_in_crash``, with
+    ``lost_in_crash`` bounded by the flush interval.
+
+Record framing (little-endian):
+
+    u32 meta_len | u32 payload_len | u32 crc32(meta+payload)
+    | meta (UTF-8 JSON) | payload (raw bytes, usually float arrays)
+
+Directory layout::
+
+    root/
+      wal.<k>.log     journal segments; <k> bumps at every snapshot
+      snap.<k>/       snapshot covering everything before wal.<k>.log
+        state.json    scalars + per-session metadata + stats + extras
+        arrays.npz    ring buffers, pending windows, smoother arrays
+
+Session ids must be JSON-round-trippable (str or int) to be journaled —
+a tuple id would come back as a list and break ack matching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import struct
+import zlib
+from typing import Callable
+
+import numpy as np
+
+from har_tpu.utils.durable import fsync_dir as _fsync_dir
+
+_HDR = struct.Struct("<III")
+_SEG_PREFIX = "wal."
+_SEG_SUFFIX = ".log"
+_SNAP_PREFIX = "snap."
+_STATE = "state.json"
+_ARRAYS = "arrays.npz"
+
+# the on-disk format version, stamped into every snapshot: a future
+# layout change bumps it and keeps this loader working on old dirs
+JOURNAL_FORMAT = 1
+
+
+class JournalError(RuntimeError):
+    """Journal directory unreadable or internally inconsistent."""
+
+
+@dataclasses.dataclass(frozen=True)
+class JournalConfig:
+    """Durability/cost knobs for a FleetJournal."""
+
+    # records buffered before an automatic write+fsync; poll() forces a
+    # flush at every ack boundary regardless, so this bounds how many
+    # PUSH records (the loss window) a kill can erase
+    flush_every: int = 64
+    # records appended between automatic snapshots (0 = only the
+    # attach-time snapshot and explicit snapshot() calls) — bounds
+    # recovery replay cost, not durability
+    snapshot_every: int = 4096
+    # fsync on flush: the durability claim needs it; tests that only
+    # exercise replay logic may turn it off for speed
+    fsync: bool = True
+
+    def __post_init__(self):
+        if self.flush_every < 1:
+            raise ValueError("flush_every must be >= 1")
+        if self.snapshot_every < 0:
+            raise ValueError("snapshot_every must be >= 0")
+
+
+def encode_record(meta: dict, payload: bytes = b"") -> bytes:
+    m = json.dumps(meta, separators=(",", ":")).encode()
+    crc = zlib.crc32(m + payload) & 0xFFFFFFFF
+    return _HDR.pack(len(m), len(payload), crc) + m + payload
+
+
+def read_segment(path: str) -> tuple[list[tuple[dict, bytes]], bool]:
+    """Decode one segment file; returns (records, torn_tail).  A
+    truncated or CRC-failing record ends the read — that is the normal
+    signature of a kill mid-write, not an error."""
+    records: list[tuple[dict, bytes]] = []
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as exc:
+        raise JournalError(f"unreadable journal segment {path}: {exc}")
+    pos, n = 0, len(data)
+    while pos + _HDR.size <= n:
+        meta_len, payload_len, crc = _HDR.unpack_from(data, pos)
+        end = pos + _HDR.size + meta_len + payload_len
+        if end > n:
+            return records, True  # torn tail: record half-written
+        body = data[pos + _HDR.size : end]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return records, True
+        try:
+            meta = json.loads(body[:meta_len].decode())
+        except ValueError:
+            return records, True
+        records.append((meta, body[meta_len:]))
+        pos = end
+    return records, pos < n
+
+
+class FleetJournal:
+    """Append-only fleet mutation log + snapshot writer.
+
+    ``chaos`` is the kill-point hook: the engine (and the adaptation
+    controller) call ``journal.chaos_point(name)`` at every stage
+    boundary; the chaos harness installs a callable that raises a
+    simulated crash at a chosen point, and ``kill()`` then models the
+    SIGKILL — the un-flushed buffer is discarded, exactly what the
+    kernel would have lost.
+    """
+
+    def __init__(self, root: str, config: JournalConfig | None = None):
+        self.root = os.path.abspath(os.path.expanduser(root))
+        self.config = config or JournalConfig()
+        os.makedirs(self.root, exist_ok=True)
+        self.chaos: Callable[[str], None] | None = None
+        self._buf: list[bytes] = []
+        self._since_snapshot = 0
+        self._segment = self._next_segment_index()
+        self._fh = open(self._segment_path(self._segment), "ab")
+        self._killed = False
+
+    # ----------------------------------------------------- file layout
+
+    def _segment_path(self, k: int) -> str:
+        return os.path.join(self.root, f"{_SEG_PREFIX}{k}{_SEG_SUFFIX}")
+
+    def _snap_path(self, k: int) -> str:
+        return os.path.join(self.root, f"{_SNAP_PREFIX}{k}")
+
+    def _next_segment_index(self) -> int:
+        return max(
+            (idx for _, idx in _list_indexed(self.root, _SEG_PREFIX)),
+            default=-1,
+        ) + 1
+
+    # ------------------------------------------------------- appending
+
+    def chaos_point(self, name: str) -> None:
+        if self.chaos is not None:
+            self.chaos(name)
+
+    def append(self, meta: dict, payload: bytes = b"") -> None:
+        if self._killed:
+            return
+        self._buf.append(encode_record(meta, payload))
+        self._since_snapshot += 1
+        if len(self._buf) >= self.config.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write + fsync the buffered records: everything appended so
+        far is durable once this returns."""
+        if self._killed or not self._buf:
+            return
+        self._fh.write(b"".join(self._buf))
+        self._buf.clear()
+        self._fh.flush()
+        if self.config.fsync:
+            os.fsync(self._fh.fileno())
+
+    @property
+    def pending_records(self) -> int:
+        return len(self._buf)
+
+    def has_state(self) -> bool:
+        """True when the directory already holds a recoverable journal
+        (at least one complete snapshot) — what a fresh attach must
+        refuse to silently destroy."""
+        return bool(_list_indexed(self.root, _SNAP_PREFIX))
+
+    def snapshot_due(self) -> bool:
+        return (
+            self.config.snapshot_every > 0
+            and self._since_snapshot >= self.config.snapshot_every
+        )
+
+    # ------------------------------------------------------- snapshots
+
+    def write_snapshot(self, state: dict, arrays: dict) -> str:
+        """Atomically persist a full-state snapshot and rotate to a
+        fresh segment.  Crash-ordering: the snapshot only becomes
+        visible (rename + dir fsync) after its contents are on disk,
+        and old segments are deleted only after that — a kill at ANY
+        instant leaves either the old snapshot+segments or the new
+        ones, never neither."""
+        self.flush()
+        nxt = self._segment + 1
+        snap = self._snap_path(nxt)
+        tmp = snap + ".tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        state = dict(state)
+        state["journal_format"] = JOURNAL_FORMAT
+        state["segment"] = nxt
+        with open(os.path.join(tmp, _ARRAYS), "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, _STATE), "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        self.chaos_point("mid_snapshot")
+        os.replace(tmp, snap)
+        _fsync_dir(self.root)
+        # rotate: the new snapshot covers every earlier segment
+        self._fh.close()
+        self._segment = nxt
+        self._fh = open(self._segment_path(nxt), "ab")
+        self._since_snapshot = 0
+        for kind, idx in _list_indexed(self.root, _SEG_PREFIX):
+            if idx < nxt:
+                try:
+                    os.remove(kind)
+                except OSError:
+                    pass
+        for kind, idx in _list_indexed(self.root, _SNAP_PREFIX):
+            if idx < nxt:
+                shutil.rmtree(kind, ignore_errors=True)
+        return snap
+
+    # ------------------------------------------------------ lifecycle
+
+    def kill(self) -> None:
+        """Simulate SIGKILL: drop the un-flushed buffer and abandon the
+        file handle.  What is on disk afterwards is exactly what a real
+        kill would have left (the chaos harness's crash model)."""
+        self._killed = True
+        self._buf.clear()
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.flush()
+        self._killed = True
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+def _list_indexed(root: str, prefix: str) -> list[tuple[str, int]]:
+    out = []
+    for name in os.listdir(root):
+        if not name.startswith(prefix) or name.endswith(".tmp"):
+            continue
+        stem = name[len(prefix):]
+        if stem.endswith(_SEG_SUFFIX):
+            stem = stem[: -len(_SEG_SUFFIX)]
+        try:
+            out.append((os.path.join(root, name), int(stem)))
+        except ValueError:
+            continue
+    return sorted(out, key=lambda t: t[1])
+
+
+def monitor_state(monitor) -> dict | None:
+    """None-tolerant wrapper over ``DriftMonitor.state()`` — the
+    serialization itself lives on the monitor class, next to the fields
+    it depends on."""
+    return None if monitor is None else monitor.state()
+
+
+def monitor_from_state(state: dict | None):
+    """None-tolerant wrapper over ``DriftMonitor.from_state``."""
+    if state is None:
+        return None
+    from har_tpu.monitoring import DriftMonitor
+
+    return DriftMonitor.from_state(state)
+
+
+def load_journal(root: str) -> tuple[dict, dict, list[tuple[dict, bytes]]]:
+    """Read a journal directory back: (snapshot_state, snapshot_arrays,
+    suffix_records).  The newest COMPLETE snapshot wins (a mid-snapshot
+    kill leaves a ``.tmp`` dir, ignored by construction); the suffix is
+    every decodable record in segments at or after the snapshot's
+    rotation point, torn tails discarded."""
+    root = os.path.abspath(os.path.expanduser(root))
+    if not os.path.isdir(root):
+        raise JournalError(f"no journal directory at {root}")
+    snaps = _list_indexed(root, _SNAP_PREFIX)
+    state: dict = {}
+    arrays: dict = {}
+    base = 0
+    for path, idx in reversed(snaps):
+        try:
+            with open(os.path.join(path, _STATE)) as f:
+                state = json.load(f)
+            with np.load(os.path.join(path, _ARRAYS)) as z:
+                arrays = {k: z[k] for k in z.files}
+        except (OSError, ValueError) as exc:
+            raise JournalError(f"unreadable snapshot {path}: {exc}")
+        base = idx
+        break
+    if not state:
+        raise JournalError(
+            f"no snapshot in {root} — a journaled fleet always writes "
+            "one at attach time; is this a journal directory?"
+        )
+    records: list[tuple[dict, bytes]] = []
+    for path, idx in _list_indexed(root, _SEG_PREFIX):
+        if idx < base:
+            continue
+        recs, _torn = read_segment(path)
+        records.extend(recs)
+    return state, arrays, records

@@ -85,6 +85,30 @@ class StageHistogram:
         }
         return out
 
+    # ------------------------------------------- durability (journal)
+
+    def state(self) -> dict:
+        """JSON-serializable full state for a recovery snapshot.  The
+        trailing raw-sample window is dropped by design: percentiles
+        restart after a recovery (they measure THIS process's serving),
+        while counts/totals/buckets — the durable aggregates — survive."""
+        return {
+            "count": self.count,
+            "total_ms": self.total_ms,
+            "max_ms": self.max_ms,
+            "buckets": list(self.buckets),
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore from ``state()`` output; missing keys (pre-journal
+        snapshots) keep their zero defaults."""
+        self.count = int(state.get("count", 0))
+        self.total_ms = float(state.get("total_ms", 0.0))
+        self.max_ms = float(state.get("max_ms", 0.0))
+        buckets = state.get("buckets")
+        if buckets is not None and len(buckets) == len(self.buckets):
+            self.buckets = [int(b) for b in buckets]
+
 
 class FleetStats:
     """Counters + gauges + stage histograms for one FleetServer.
@@ -122,6 +146,15 @@ class FleetStats:
         self.queue_depth = 0
         self.queue_depth_max = 0
         self.batch_sizes: dict[int, int] = {}  # padded size -> count
+        # ingest guard: non-finite / wildly out-of-range samples refused
+        # at push() — never an exception on the serving loop
+        self.rejected_samples = 0
+        # durability (har_tpu.serve.journal): process restarts this
+        # fleet has survived, and windows the pre-crash process enqueued
+        # whose data could not be recovered (bounded by the journal
+        # flush interval; see FleetServer.declare_lost)
+        self.recoveries = 0
+        self.lost_in_crash = 0
         # adaptation lifecycle (har_tpu.adapt)
         self.model_swaps = 0
         self.rollbacks = 0
@@ -169,13 +202,26 @@ class FleetStats:
 
     def accounting(self) -> dict:
         """The conservation law: every enqueued window is exactly one of
-        scored, dropped, or still pending."""
-        pending = self.enqueued - self.scored - self.dropped_total
+        scored, dropped, still pending, or lost in a crash.
+
+        ``lost_in_crash`` counts windows a pre-crash process enqueued
+        whose data never reached the durable journal AND whose samples
+        the resuming transport declared unreplayable
+        (``FleetServer.declare_lost``) — bounded by the journal flush
+        interval, zero for transports that re-deliver from the recovered
+        watermark."""
+        pending = (
+            self.enqueued
+            - self.scored
+            - self.dropped_total
+            - self.lost_in_crash
+        )
         return {
             "enqueued": self.enqueued,
             "scored": self.scored,
             "dropped": self.dropped_total,
             "pending": pending,
+            "lost_in_crash": self.lost_in_crash,
             # balanced now ALSO requires the per-version attribution to
             # conserve: a swap that lost or double-counted a window
             # would break scored_by_version before it broke the total
@@ -200,6 +246,8 @@ class FleetStats:
             "slo_breaches": self.slo_breaches,
             "admission_rejections": self.admission_rejections,
             "dropped_by_reason": dict(self.dropped),
+            "rejected_samples": self.rejected_samples,
+            "recoveries": self.recoveries,
             "batch_sizes": {
                 str(k): v for k, v in sorted(self.batch_sizes.items())
             },
@@ -218,3 +266,53 @@ class FleetStats:
                 "shadow_ms": self.shadow.snapshot(),
             },
         }
+
+    # ------------------------------------------- durability (journal)
+
+    _COUNTERS = (
+        "enqueued", "scored", "dispatches", "dispatch_retries",
+        "dispatch_failures", "degraded_events",
+        "smoothing_shed_transitions", "slo_breaches",
+        "admission_rejections", "queue_depth_max", "rejected_samples",
+        "recoveries", "lost_in_crash", "model_swaps", "rollbacks",
+        "shadow_batches", "shadow_windows", "shadow_errors",
+    )
+    _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")
+
+    def state(self) -> dict:
+        """JSON-serializable full counter state for a recovery snapshot
+        (har_tpu.serve.journal).  Every field the conservation law and
+        the per-version attribution need survives a crash; histogram
+        trailing windows restart (see StageHistogram.state)."""
+        return {
+            "counters": {k: getattr(self, k) for k in self._COUNTERS},
+            "dropped": dict(self.dropped),
+            "batch_sizes": {str(k): v for k, v in self.batch_sizes.items()},
+            "scored_by_version": dict(self.scored_by_version),
+            "stages": {
+                name: getattr(self, name).state() for name in self._STAGES
+            },
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore from ``state()`` output.  Pre-journal state dicts
+        missing the newer fields (``lost_in_crash``, ``recoveries``,
+        ``rejected_samples``) load with zero defaults — back-compat is
+        pinned in the test suite."""
+        for k, v in (state.get("counters") or {}).items():
+            if k in self._COUNTERS:
+                setattr(self, k, int(v))
+        self.dropped = {
+            str(k): int(v) for k, v in (state.get("dropped") or {}).items()
+        }
+        self.batch_sizes = {
+            int(k): int(v)
+            for k, v in (state.get("batch_sizes") or {}).items()
+        }
+        self.scored_by_version = {
+            str(k): int(v)
+            for k, v in (state.get("scored_by_version") or {}).items()
+        }
+        for name, st in (state.get("stages") or {}).items():
+            if name in self._STAGES:
+                getattr(self, name).load_state(st)

@@ -76,6 +76,31 @@ def _percentile(values: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(values, np.float64), q))
 
 
+def finite_rows(
+    samples: np.ndarray, max_abs: float | None = 1e6
+) -> tuple[np.ndarray, int]:
+    """THE ingest guard shared by StreamingClassifier.push and
+    FleetServer.push: drop sample rows that are non-finite (NaN/Inf) or
+    wildly out of range (any |value| > max_abs; None disables the range
+    check).  Returns ``(clean_rows, n_rejected)``.
+
+    One poisoned row would otherwise ride a window into the compiled
+    predict and NaN-poison the whole micro-batch — on the fleet path
+    that is 256 sessions' windows dying to one broken sensor.  Rejection
+    is per ROW and silent by design (counted, never raised): the
+    serving loop must keep serving the finite samples it does get.
+    """
+    bad = ~np.isfinite(samples).all(axis=-1)
+    if max_abs is not None:
+        # NaN compares False everywhere, but isfinite already caught it
+        with np.errstate(invalid="ignore"):
+            bad |= (np.abs(samples) > max_abs).any(axis=-1)
+    n_bad = int(bad.sum())
+    if n_bad:
+        return samples[~bad], n_bad
+    return samples, 0
+
+
 def pad_pow2(windows: np.ndarray) -> np.ndarray:
     """Pad a ``(k, ...)`` batch to the next power-of-two rows by
     repeating the last row — THE batch-shape policy of every scoring
@@ -312,6 +337,7 @@ class StreamingClassifier:
         vote_depth: int = 5,
         class_names: Sequence[str] | None = None,
         monitor=None,
+        max_abs_sample: float | None = 1e6,
     ):
         if window <= 0 or hop <= 0:
             raise ValueError("window and hop must be positive")
@@ -333,6 +359,11 @@ class StreamingClassifier:
         # events carry drift=True while the stream is out of the
         # training distribution
         self.monitor = monitor
+        # ingest guard (finite_rows): rejected rows are counted here,
+        # never raised — the same per-session guard FleetServer applies,
+        # so a multiplexed session stays bit-identical to this class
+        self.max_abs_sample = max_abs_sample
+        self.rejected_samples = 0
         self.reset()
 
     @classmethod
@@ -418,6 +449,12 @@ class StreamingClassifier:
         boundary they complete.  Chunking is irrelevant: pushing a
         recording sample-by-sample or all at once yields identical
         events (the test suite pins this)."""
+        # Pass 0: the ingest guard — a NaN/Inf or out-of-range row must
+        # never reach the compiled predict (it would poison the whole
+        # window, and on the fleet path the whole micro-batch)
+        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        samples, n_bad = finite_rows(samples, self.max_abs_sample)
+        self.rejected_samples += n_bad
         # Pass 1: consume samples, collecting the window snapshot (and
         # the drift verdict as of that moment) at every boundary — the
         # shared _WindowAssembler, so the fleet engine's sessions see

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Standalone fleet-recovery measurement → artifacts/fleet_recovery.json.
+
+The bench's ``fleet_recovery`` lane (bench.py) runs the same
+measurement inside the budgeted round-end draw; this script is the
+standalone path that produces a committed artifact on any host —
+recovery time is host-side work (journal I/O + numpy replay), so the
+number is meaningful without a TPU attached, and the chip-state label
+is recorded as absent rather than faked.
+
+    python scripts/recovery_bench.py          # writes the artifact
+    python scripts/recovery_bench.py --smoke  # tiny sizes, no write
+
+Per session count: build a journaled fleet under live load (every
+push/ack journaled, fsync-batched), kill it (``FleetJournal.kill``
+drops the un-flushed buffer — the SIGKILL model), then time
+``FleetServer.restore`` (snapshot + journal-suffix replay) at
+n_runs >= 3 with median + std.  Every run must come back with the
+accounting invariant intact or the artifact is refused.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # runnable from any cwd, no install
+    sys.path.insert(0, str(REPO))
+ARTIFACT = REPO / "artifacts" / "fleet_recovery.json"
+
+
+def measure(session_counts, n_runs: int) -> dict:
+    # THE shared measurement + summary (recover.recovery_benchmark /
+    # recovery_benchmark_summary) — also behind bench.py's
+    # fleet_recovery lane, so the lane and this committed artifact
+    # cannot silently diverge
+    from har_tpu.serve.recover import (
+        recovery_benchmark,
+        recovery_benchmark_summary,
+    )
+
+    rows = recovery_benchmark(session_counts, n_runs=n_runs)
+    for row in rows:
+        print(
+            f"sessions={row['n_sessions']}: recovery "
+            f"{row['recovery_ms_median']} ms median "
+            f"(std {row['recovery_ms_std']}), "
+            f"journal {row['journal_mb']} MB, "
+            f"contract_ok={row['contract_ok']}",
+            file=sys.stderr,
+        )
+    return {"lane": "fleet_recovery",
+            **recovery_benchmark_summary(rows, n_runs)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, print only (no artifact write)")
+    ap.add_argument("--n-runs", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    counts = [8] if args.smoke else [64, 256, 512]
+    result = measure(counts, args.n_runs)
+    if not result["contract_ok"]:
+        print("recovery contract violated — artifact refused",
+              file=sys.stderr)
+        return 1
+    result["source"] = "scripts/recovery_bench.py"
+    result["host_side"] = (
+        "journal write + snapshot/replay are host I/O + numpy; no "
+        "device program in the timed region"
+    )
+    try:
+        import jax
+
+        result["backend"] = jax.default_backend()
+    except Exception:
+        result["backend"] = None
+    try:
+        result["git_head"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=REPO, capture_output=True, text=True,
+        ).stdout.strip()
+    except OSError:
+        result["git_head"] = "unknown"
+    result["captured_at"] = int(time.time())
+    if args.smoke:
+        print(json.dumps(result))
+        return 0
+    ARTIFACT.parent.mkdir(exist_ok=True)
+    ARTIFACT.write_text(json.dumps(result, indent=1))
+    print(json.dumps({"artifact": str(ARTIFACT.relative_to(REPO)),
+                      **{k: result[k] for k in
+                         ("recovery_ms_median", "recovery_ms_std",
+                          "contract_ok")}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

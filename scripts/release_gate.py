@@ -31,10 +31,16 @@ What it does:
      injected population drift must escalate through the trigger, a
      stub retrain must shadow-pass and hot-swap with ZERO dropped
      windows and no rollback; red refuses the snapshot.
-  5. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+  5. Runs the crash-recovery smoke (``har_tpu.serve.recover.
+     recovery_smoke``): a journaled fleet is killed at representative
+     stage boundaries and recovered — accounting intact, zero windows
+     lost, acked scores bit-identical to an uninterrupted run; red
+     refuses the snapshot.
+  6. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
      the fleet ``{sessions, p99_ms, dropped}`` verdict, the adapt
-     ``{swaps, rollbacks, shadow_agreement}`` verdict, git HEAD — the
-     run log the README numbers trace back to.
+     ``{swaps, rollbacks, shadow_agreement}`` verdict, the recovery
+     ``{kill_points, recovered, windows_lost, recovery_ms}`` stamp,
+     git HEAD — the run log the README numbers trace back to.
 
 The end-of-round snapshot workflow is: run this, commit only on rc 0.
 """
@@ -142,6 +148,14 @@ def _adapt_smoke() -> dict:
     return _run_smoke("har_tpu.adapt.smoke", "adapt_smoke")
 
 
+def _recovery_smoke() -> dict:
+    """Crash-recovery smoke verdict: kill a journaled fleet at
+    representative stage boundaries, recover each one, demand intact
+    accounting + zero lost windows + bit-identical acked scores
+    (har_tpu.serve.recover.recovery_smoke)."""
+    return _run_smoke("har_tpu.serve.recover", "recovery_smoke")
+
+
 def _git_head() -> str:
     try:
         return subprocess.run(
@@ -196,17 +210,21 @@ def main(argv=None) -> int:
     suite = None
     fleet = None
     adapt = None
+    recovery = None
     if args.counts_only:
-        # carry the previous run's fleet + adapt verdicts forward: a
-        # counts-only refresh must not blank the serving evidence the
-        # suite's gate-log test pins (only a full gate run regenerates)
+        # carry the previous run's fleet + adapt + recovery verdicts
+        # forward: a counts-only refresh must not blank the serving
+        # evidence the suite's gate-log test pins (only a full gate run
+        # regenerates)
         try:
             prior = json.loads(GATE_LOG.read_text())
             fleet = prior.get("fleet_slo")
             adapt = prior.get("adapt_smoke")
+            recovery = prior.get("recovery_smoke")
         except (OSError, ValueError):
             fleet = None
             adapt = None
+            recovery = None
     if not args.counts_only:
         t0 = time.perf_counter()
         proc = subprocess.run(
@@ -245,6 +263,17 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # durability gate: kill at representative stage boundaries,
+        # recover, assert accounting + bit-identical acked scores; red
+        # refuses like a red tier
+        recovery = _recovery_smoke()
+        if not recovery.get("ok"):
+            print(
+                "\nrelease_gate: RED crash-recovery smoke "
+                f"({json.dumps(recovery)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -256,6 +285,7 @@ def main(argv=None) -> int:
                 "suite": suite,
                 "fleet_slo": fleet,
                 "adapt_smoke": adapt,
+                "recovery_smoke": recovery,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -272,6 +302,9 @@ def main(argv=None) -> int:
                 "suite_rc": None if suite is None else suite["rc"],
                 "fleet_slo_ok": None if fleet is None else fleet["ok"],
                 "adapt_smoke_ok": None if adapt is None else adapt["ok"],
+                "recovery_smoke_ok": (
+                    None if recovery is None else recovery["ok"]
+                ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }
         )

@@ -211,6 +211,72 @@ def test_register_classical_roundtrip_with_lineage(tmp_path):
     assert mv.data_fingerprint == fp
 
 
+def test_registry_atomic_writes_fsync_and_leave_no_tmp(tmp_path):
+    """The r9 durability fix: CURRENT / NEXT_ID / promotions.jsonl go
+    through the fsync-before-rename helper — no stray .tmp files
+    survive a clean pass, and the pointer round-trips through a fresh
+    handle (the on-disk format is unchanged)."""
+    import os
+
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    reg.register(None, promote=True)
+    reg.register(None)
+    reg.promote(2)
+    reg.rollback()
+    leftovers = [
+        f for f in os.listdir(root) if f.endswith(".tmp")
+    ]
+    assert leftovers == []
+    reg2 = ModelRegistry(root)
+    assert reg2.current().version == 1
+    assert [h["event"] for h in reg2.history()] == [
+        "promote", "promote", "rollback",
+    ]
+
+
+def test_pre_fsync_registry_loads_with_defaults(tmp_path):
+    """A registry directory written by the pre-r9 code (plain writes,
+    no fsync discipline; possibly no NEXT_ID at all) loads unchanged —
+    and a registry written today reads back through the same plain
+    file semantics (round-trip both ways, no format change)."""
+    import json
+    import os
+
+    root = str(tmp_path / "reg")
+    vdir = os.path.join(root, "versions", "v0000001")
+    os.makedirs(vdir)
+    with open(os.path.join(vdir, "registry.json"), "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "sha256": "metadata-only:v0000001",
+                "parent_sha256": None,
+                "created_unix": 100,
+                "data_fingerprint": None,
+                "metrics": {},
+                "note": "pre-fsync era",
+            },
+            f,
+        )
+    # an old-style plain-text CURRENT pointer, no NEXT_ID, no log
+    with open(os.path.join(root, "CURRENT"), "w") as f:
+        f.write(os.path.join("versions", "v0000001"))
+    reg = ModelRegistry(root)
+    assert reg.current().version == 1
+    assert reg.history() == []  # no promotions.jsonl: empty, not error
+    # registering on top continues the sequence (NEXT_ID falls back to
+    # max(existing)+1) and everything re-reads via plain open()
+    mv = reg.register(None, promote=True)
+    assert mv.version == 2
+    with open(os.path.join(root, "NEXT_ID")) as f:
+        assert int(f.read().strip()) == 3
+    with open(os.path.join(root, "promotions.jsonl")) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines[-1]["version"] == 2
+    assert ModelRegistry(root).current().version == 2
+
+
 # ----------------------------------------------------------------- trigger
 
 

@@ -64,7 +64,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     # every lane must be present (ran or carried a skip/error marker)
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
-        "fleet_serving", "adaptive_serving",
+        "fleet_serving", "adaptive_serving", "fleet_recovery",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -91,6 +91,24 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             extra["adaptive_event_p99_ms"]
             == adaptive["event_p99_ms_median"]
         )
+    # r9 fleet-recovery lane: restore-from-journal timing at n_runs>=3
+    # with the recovery contract pinned per run, or a deadline-skip
+    # marker; never silently absent
+    recovery = extra["lanes"]["fleet_recovery"]
+    if "skipped" not in recovery:
+        assert recovery["n_runs"] >= 3
+        assert recovery["contract_ok"] is True
+        assert recovery["recovery_ms_median"] > 0
+        assert recovery["rows"]
+        for row in recovery["rows"]:
+            assert row["recovery_ms_median"] > 0
+            assert "recovery_ms_std" in row
+        assert "chip_state_probe" in recovery
+        assert (
+            extra["fleet_recovery_ms_median"]
+            == recovery["recovery_ms_median"]
+        )
+        assert extra["fleet_recovery_contract_ok"] is True
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",

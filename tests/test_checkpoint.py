@@ -76,6 +76,46 @@ def test_neural_checkpoint_lineage_meta_roundtrip(tmp_path):
     }
 
 
+def test_pre_journal_checkpoint_roundtrip_both_ways(tmp_path):
+    """The r9 durability layer (har_tpu.serve.journal) adds NOTHING to
+    the checkpoint format — pinned both ways: a checkpoint saved today
+    carries no journal-era keys (a pre-journal reader loads it
+    unchanged), and a meta stripped to the pre-adapt key set (no
+    lineage, no journal fields, as an old writer produced) loads with
+    defaults through today's reader."""
+    import json
+    import os
+
+    from har_tpu.checkpoint import load_model_meta, version_info
+
+    data, model = _small_fit(tmp_path)
+    path = save_model(
+        str(tmp_path / "ck"), model, "mlp", {"hidden": (32,)}
+    )
+    meta = load_model_meta(path)
+    # forward direction: no journal coupling in the artifact
+    journal_era = {"journal", "lost_in_crash", "recoveries",
+                   "journal_format", "segment"}
+    assert not journal_era & set(meta)
+    # backward direction: rewrite the meta as a pre-adapt writer would
+    # have (lineage and journal-era keys absent entirely)
+    old_meta = {
+        k: v
+        for k, v in meta.items()
+        if k not in ("version", "parent_sha256", "created_unix")
+    }
+    with open(os.path.join(path, "har_meta.json"), "w") as f:
+        json.dump(old_meta, f)
+    restored = load_model(path)
+    np.testing.assert_allclose(
+        model.transform(data).raw, restored.transform(data).raw,
+        rtol=1e-6,
+    )
+    assert version_info(load_model_meta(path)) == {
+        "version": None, "parent_sha256": None, "created_unix": None,
+    }
+
+
 def test_classical_checkpoint_lineage_meta_roundtrip(tmp_path):
     from har_tpu.checkpoint import (
         load_classical_model,

@@ -1,0 +1,616 @@
+"""Crash-safe fleet durability (har_tpu.serve.journal / recover / chaos).
+
+Pins the contracts the durability layer ships on:
+  1. journal mechanics — torn-tail-safe framing, fsync-batched buffering
+     whose kill model loses exactly the un-flushed suffix, atomic
+     snapshot rotation;
+  2. recovery — snapshot + journal-suffix replay rebuilds sessions,
+     smoother/monitor state and the pending queue; acked events are
+     never re-emitted (zero double-scored);
+  3. the kill-point matrix — every enumerated stage boundary recovers
+     with the accounting invariant intact and BIT-IDENTICAL scores vs
+     an uninterrupted run, plus a seed-randomized kill-point property
+     test;
+  4. the extended conservation law — enqueued == scored + dropped +
+     pending + lost_in_crash when a transport declares a gap;
+  5. the ingest guard — NaN/Inf/out-of-range samples are rejected
+     per-session (counted, never raised) identically on both serving
+     paths.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from har_tpu.serve import (
+    ENGINE_KILL_POINTS,
+    KILL_POINTS,
+    FleetConfig,
+    FleetJournal,
+    FleetServer,
+    JournalConfig,
+    run_kill_point,
+    run_random_kill,
+)
+from har_tpu.serve.journal import encode_record, load_journal, read_segment
+from har_tpu.serve.stats import FleetStats, StageHistogram
+from har_tpu.serving import StreamingClassifier, finite_rows
+
+
+class _StubModel:
+    """Row-deterministic numpy stand-in (as in test_fleet_serving)."""
+
+    num_classes = 3
+
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x)
+        m = x.mean(axis=(1, 2))
+        raw = np.stack([-m, m, np.zeros_like(m)], axis=-1)
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return Predictions.from_raw(raw, e / e.sum(axis=-1, keepdims=True))
+
+
+# ------------------------------------------------------------ journal
+
+
+def test_journal_framing_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "seg.log")
+    recs = [
+        ({"t": "push", "sid": 1, "n": 2}, b"\x00" * 24),
+        ({"t": "ack", "sid": "a", "ti": 100}, np.arange(3.0).tobytes()),
+        ({"t": "swap", "ver": "B"}, b""),
+    ]
+    blob = b"".join(encode_record(m, p) for m, p in recs)
+    with open(path, "wb") as f:
+        f.write(blob)
+    got, torn = read_segment(path)
+    assert not torn
+    assert [m for m, _ in got] == [m for m, _ in recs]
+    assert got[1][1] == recs[1][1]
+    # a record half-written at the kill instant is discarded, the
+    # intact prefix survives — never a parse error
+    with open(path, "wb") as f:
+        f.write(blob[:-7])
+    got, torn = read_segment(path)
+    assert torn
+    assert [m["t"] for m, _ in got] == ["push", "ack"]
+    # corrupted bytes mid-record fail the CRC, same contract
+    bad = bytearray(blob)
+    bad[len(blob) - 4] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    got, torn = read_segment(path)
+    assert torn and len(got) == 2
+
+
+def test_journal_kill_loses_exactly_the_unflushed_suffix(tmp_path):
+    j = FleetJournal(str(tmp_path), JournalConfig(flush_every=100))
+    for i in range(5):
+        j.append({"i": i})
+    j.flush()
+    for i in range(5, 9):
+        j.append({"i": i})  # buffered, never flushed
+    j.kill()
+    segs = [f for f in os.listdir(tmp_path) if f.startswith("wal.")]
+    assert len(segs) == 1
+    got, torn = read_segment(str(tmp_path / segs[0]))
+    assert not torn
+    assert [m["i"] for m, _ in got] == [0, 1, 2, 3, 4]
+
+
+def test_journal_snapshot_rotates_and_prunes(tmp_path):
+    j = FleetJournal(str(tmp_path), JournalConfig(flush_every=1))
+    j.append({"i": 0})
+    j.write_snapshot({"x": 1}, {"a": np.zeros(3)})
+    j.append({"i": 1})
+    j.write_snapshot({"x": 2}, {"a": np.ones(3)})
+    j.append({"i": 2})
+    j.close()
+    state, arrays, records = load_journal(str(tmp_path))
+    assert state["x"] == 2
+    assert np.array_equal(arrays["a"], np.ones(3))
+    assert [m["i"] for m, _ in records] == [2]
+    # pre-rotation segments and stale snapshots were pruned
+    names = os.listdir(tmp_path)
+    assert sum(n.startswith("snap.") for n in names) == 1
+    assert sum(n.startswith("wal.") for n in names) == 1
+
+
+# ----------------------------------------------------------- recovery
+
+
+def _journaled_server(tmp_path, model=None, **cfg):
+    server = FleetServer(
+        model or _StubModel(), window=100, hop=50, smoothing="ema",
+        config=FleetConfig(
+            max_sessions=16, target_batch=8, max_delay_ms=0.0,
+            **cfg,
+        ),
+        journal=FleetJournal(
+            str(tmp_path / "j"), JournalConfig(flush_every=4)
+        ),
+    )
+    return server
+
+
+def test_restore_rebuilds_state_and_never_reemits_acked(tmp_path):
+    """The core recovery semantics, hand-driven: acked events stay
+    acked (nothing re-emitted), un-acked windows come back pending, the
+    smoother continues the pre-crash stream bit-identically."""
+    rng = np.random.default_rng(3)
+    recs = [rng.normal(size=(500, 3)).astype(np.float32) for _ in range(4)]
+    server = _journaled_server(tmp_path)
+    for i in range(4):
+        server.add_session(i)
+    # first half: deliver + poll → acked events
+    delivered = []
+    for i in range(4):
+        server.push(i, recs[i][:250])
+    delivered.extend(server.poll(force=True))
+    # second half enqueued but never polled → pending at the kill
+    for i in range(4):
+        server.push(i, recs[i][250:])
+    pending_before = server.stats.accounting()["pending"]
+    assert pending_before > 0
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.stats.recoveries == 1
+    acct = restored.stats.accounting()
+    assert acct["scored"] == len(delivered)
+    assert acct["pending"] == pending_before
+    # draining the restored fleet emits ONLY the never-acked windows...
+    post = restored.flush()
+    seen = {(e.session_id, e.event.t_index) for e in delivered}
+    assert all((e.session_id, e.event.t_index) not in seen for e in post)
+    # ...bit-identically to an uninterrupted run of the same stream
+    ref = {}
+    for i in range(4):
+        sc = StreamingClassifier(
+            _StubModel(), window=100, hop=50, smoothing="ema"
+        )
+        evs = sc.push(recs[i][:250]) + sc.push(recs[i][250:])
+        ref[i] = evs
+    combined = {}
+    for e in list(delivered) + list(post):
+        combined.setdefault(e.session_id, []).append(e.event)
+    for i in range(4):
+        assert len(combined[i]) == len(ref[i])
+        for g, w in zip(combined[i], ref[i]):
+            assert g.t_index == w.t_index
+            assert g.label == w.label
+            assert g.raw_label == w.raw_label
+            np.testing.assert_array_equal(g.probability, w.probability)
+    final = restored.stats.accounting()
+    assert final["balanced"] and final["pending"] == 0
+    assert json.dumps(restored.stats_snapshot())  # stays JSON-clean
+
+
+def test_restore_recovers_monitor_state_and_episodes(tmp_path):
+    """Drift-monitor EWMAs and the live episode survive the crash: a
+    drifting session is still drifting after recovery, with the same
+    episode id (generation, onset)."""
+    from har_tpu.monitoring import DriftMonitor
+
+    server = _journaled_server(tmp_path)
+    server.add_session(
+        "bad", monitor=DriftMonitor(np.zeros(3), np.ones(3), patience=2)
+    )
+    shifted = (np.zeros((400, 3)) + 25.0).astype(np.float32)
+    for start in range(0, 400, 50):
+        server.push("bad", shifted[start : start + 50])
+    server.poll(force=True)
+    rep = server.drift_report("bad")
+    assert rep is not None and rep.drifting
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    mon = restored._sessions["bad"].asm.monitor
+    assert mon is not None
+    assert mon._drifting
+    assert mon._onset == rep.onset
+    assert mon._generation == rep.generation
+    assert mon._n == 400
+    # and the next chunk continues the same episode, not a fresh one
+    restored.push("bad", shifted[:50])
+    rep2 = restored.drift_report("bad")
+    assert rep2.drifting and rep2.onset == rep.onset
+
+
+def test_watermark_and_declare_lost_extend_the_conservation_law(tmp_path):
+    """A transport that cannot replay declares the gap: the skipped
+    windows are counted as enqueued AND lost_in_crash, and the next
+    full fresh window after the gap scores normally."""
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    server.push(0, np.zeros((250, 3), np.float32))  # windows at 100,150,200,250
+    server.flush()
+    assert server.watermark(0) == 250
+    # the stream moved to 500 while the process was dead; no replay
+    lost = server.declare_lost(0, 500)
+    # boundaries 300..550 need pre-500 samples → lost; first clean one
+    # is at 600 (500 + window)
+    assert lost > 0
+    acct = server.stats.accounting()
+    assert acct["lost_in_crash"] == lost
+    assert acct["enqueued"] == (
+        acct["scored"] + acct["dropped"] + acct["pending"] + lost
+    )
+    assert acct["balanced"]
+    # delivery resumes: one full window after the gap emits at 600
+    events = []
+    server.push(0, np.ones((100, 3), np.float32))
+    events.extend(server.flush())
+    assert [e.event.t_index for e in events] == [600]
+    assert server.stats.accounting()["balanced"]
+
+
+def test_second_crash_recovers_from_first_recovery(tmp_path):
+    """Crashes compose: restore() re-attaches the journal with a
+    recovery-point snapshot, so a second kill recovers too."""
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    server.push(0, np.zeros((200, 3), np.float32))
+    ev1 = server.poll(force=True)
+    server.journal.kill()
+    r1 = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    r1.push(0, np.ones((100, 3), np.float32))
+    ev2 = r1.poll(force=True)
+    r1.journal.kill()
+    r2 = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert r2.stats.recoveries == 2
+    acct = r2.stats.accounting()
+    assert acct["scored"] == len(ev1) + len(ev2)
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+# ----------------------------------------------- kill-point chaos matrix
+
+
+@pytest.mark.parametrize("point", KILL_POINTS)
+def test_kill_point_recovers_bit_identical(point):
+    """THE acceptance pin: kill at every enumerated stage boundary
+    under the PR-2 FakeClock+DispatchFaults harness, recover, resume
+    from the watermark — accounting invariant intact, zero events
+    double-scored, and the union of pre-crash and post-recovery events
+    bit-identical to an uninterrupted run."""
+    out = run_kill_point(point, sessions=6, seed=1)
+    assert out["ok"], out
+    assert out["windows_lost"] == 0
+    assert out["accounting"]["balanced"]
+    assert out["accounting"]["pending"] == 0
+    assert out["delivered_post_recovery"] > 0
+
+
+@pytest.mark.parametrize("point", ENGINE_KILL_POINTS)
+def test_engine_kill_point_resolves_half_finished_transition(point):
+    """mid_promote / mid_rollback: the registry pointer moved but the
+    fleet swap never applied — recovery must land the fleet on CURRENT
+    (resuming probation for a promotion) with accounting intact."""
+    out = run_kill_point(point, sessions=6, seed=2)
+    assert out["ok"], out
+    assert out["serving_version"] == out["registry_current"]
+    assert out["accounting"]["balanced"]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_kill_point_property(seed):
+    """Seed-randomized draw over (kill point, occurrence, flush
+    batching, snapshot cadence, fleet size): the recovery contract is a
+    property, not a fixture."""
+    out = run_random_kill(seed)
+    assert out["ok"], out
+    assert out["windows_lost"] == 0
+
+
+# -------------------------------------------------------- ingest guard
+
+
+def test_finite_rows_guard():
+    x = np.zeros((5, 3), np.float32)
+    x[1, 0] = np.nan
+    x[2, 2] = np.inf
+    x[3, 1] = -2e6
+    clean, n_bad = finite_rows(x, 1e6)
+    assert n_bad == 3 and len(clean) == 2
+    clean, n_bad = finite_rows(x, None)  # range check off, NaN/Inf on
+    assert n_bad == 2 and len(clean) == 3
+
+
+def test_fleet_push_rejects_poison_samples_never_raises(tmp_path):
+    """One NaN row must not poison the micro-batch — rejected
+    per-session, counted, and the fleet stays bit-identical to a
+    standalone classifier fed the same poisoned chunks."""
+    server = FleetServer(
+        _StubModel(), window=100, hop=50, smoothing="ema",
+        config=FleetConfig(max_sessions=2),
+    )
+    server.add_session(0)
+    rng = np.random.default_rng(5)
+    rec = rng.normal(size=(400, 3)).astype(np.float32)
+    poisoned = rec.copy()
+    poisoned[7, 1] = np.nan
+    poisoned[200, 0] = np.inf
+    poisoned[301, 2] = 5e8  # wildly out of range
+    server.push(0, poisoned)
+    events = server.flush()
+    assert server.stats.rejected_samples == 3
+    assert all(np.isfinite(e.event.probability).all() for e in events)
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+    sc = StreamingClassifier(_StubModel(), window=100, hop=50,
+                             smoothing="ema")
+    ref = sc.push(poisoned)
+    assert sc.rejected_samples == 3
+    assert len(events) == len(ref)
+    for g, w in zip(events, ref):
+        assert g.event.t_index == w.t_index
+        assert g.event.label == w.label
+        np.testing.assert_array_equal(g.event.probability, w.probability)
+
+
+def test_watermark_speaks_raw_transport_coordinates(tmp_path):
+    """A rejected NaN row must not shift post-crash re-delivery: the
+    watermark counts RAW delivered samples (rejected rows included), so
+    slicing the transport's recording at the watermark resumes exactly
+    where delivery stopped — combined events stay bit-identical to an
+    uninterrupted run of the same poisoned stream."""
+    rng = np.random.default_rng(11)
+    poisoned = rng.normal(size=(400, 3)).astype(np.float32)
+    poisoned[10, 0] = np.nan
+    poisoned[120, 2] = np.inf
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    server.push(0, poisoned[:200])
+    delivered = server.poll(force=True)
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.stats.rejected_samples == 2
+    wm = restored.watermark(0)
+    assert wm == 200  # raw coordinates; post-filter would report 198
+    post = restored.poll(force=True)
+    restored.push(0, poisoned[wm:])
+    post += restored.flush()
+
+    sc = StreamingClassifier(
+        _StubModel(), window=100, hop=50, smoothing="ema"
+    )
+    ref = sc.push(poisoned[:200]) + sc.push(poisoned[200:])
+    combined = [e.event for e in list(delivered) + list(post)]
+    assert len(combined) == len(ref) > 0
+    for g, w in zip(combined, ref):
+        assert g.t_index == w.t_index
+        assert g.label == w.label
+        np.testing.assert_array_equal(g.probability, w.probability)
+
+
+def test_crash_after_failed_rollback_write_still_swaps_back(tmp_path):
+    """The live path swaps back even when registry.rollback raises
+    ("serving correctness over lineage"); a kill between that failed
+    pointer write and the swap-back must not strand the regressing
+    model — resume completes the swap-back to the prior incumbent."""
+    from har_tpu.adapt.registry import ModelRegistry
+    from har_tpu.adapt.shadow import ShadowConfig
+    from har_tpu.adapt.swap import AdaptationConfig, AdaptationEngine
+    from har_tpu.adapt.trigger import TriggerConfig
+    from har_tpu.monitoring import DriftMonitor
+    from har_tpu.serve import (
+        DispatchFaults,
+        FakeClock,
+        KillPlan,
+        SimulatedCrash,
+    )
+    from har_tpu.serve.loadgen import AnalyticDemoModel
+
+    clock = FakeClock()
+    journal = FleetJournal(
+        str(tmp_path / "j"), JournalConfig(flush_every=4)
+    )
+    incumbent = AnalyticDemoModel()
+    candidate = AnalyticDemoModel(tau=5.0)
+    faults = DispatchFaults(fake_clock=clock)
+    server = FleetServer(
+        incumbent, window=100, hop=100, channels=3, smoothing="none",
+        config=FleetConfig(max_sessions=6, max_delay_ms=0.0, retries=0),
+        clock=clock, fault_hook=faults, journal=journal,
+    )
+    rng = np.random.default_rng(21)
+    recs = [
+        rng.normal(size=(1200, 3)).astype(np.float32) for _ in range(6)
+    ]
+    for i in range(6):
+        server.add_session(
+            i,
+            monitor=DriftMonitor(
+                np.zeros(3), np.ones(3), halflife=50.0, patience=2
+            ),
+        )
+    registry = ModelRegistry(str(tmp_path / "reg"), clock=clock)
+    kw = dict(
+        config=AdaptationConfig(
+            probation_dispatches=4, max_shadow_dispatches=8
+        ),
+        trigger_config=TriggerConfig(
+            min_sessions=2, window_s=1e9, cooldown_s=1e9,
+            recovery_patience=1,
+        ),
+        shadow_config=ShadowConfig(sample_every=1, min_windows=4),
+        clock=clock,
+    )
+    engine = AdaptationEngine(server, registry, lambda job: candidate,
+                              **kw)
+    v1 = server.model_version
+    models = {v1: incumbent}
+
+    def loader(ver):
+        return models.get(ver, candidate)
+
+    def broken_rollback():
+        raise OSError("registry dir went read-only")
+
+    registry.rollback = broken_rollback
+    journal.chaos = KillPlan("mid_rollback", 1)
+    crashed = False
+    try:
+        for rnd in range(10):
+            for i in range(6):
+                chunk = recs[i][rnd * 100 : (rnd + 1) * 100]
+                if i < 3 and rnd >= 1:
+                    chunk = chunk + 25.0
+                server.push(i, chunk)
+            server.poll(force=True)
+            if engine.state == "probation":
+                faults.fail_every = 1  # regression: every dispatch dies
+            engine.step()
+            clock.advance(1.0)
+    except SimulatedCrash:
+        crashed = True
+        journal.kill()
+    assert crashed, f"never reached mid_rollback (state={engine.state})"
+
+    clock2 = FakeClock(clock.t)
+    restored = FleetServer.restore(
+        str(tmp_path / "j"), loader, clock=clock2
+    )
+    # the kill hit between the failed pointer write and the swap-back:
+    # the regressing candidate is still the serving version on disk
+    assert restored.model_version != v1
+    registry2 = ModelRegistry(str(tmp_path / "reg"), clock=clock2)
+    engine2 = AdaptationEngine(
+        restored, registry2, lambda job: candidate, **kw,
+        resume=True, loader=loader,
+    )
+    assert restored.model_version == v1  # swap-back completed
+    assert restored.stats.rollbacks == 1
+    assert engine2.state == "serving"
+    # and the pointer retry (healthy registry2) landed back on v1 too
+    assert registry2.current().name == v1
+
+
+def test_malformed_push_raises_before_journaling(tmp_path):
+    """A wrong-shape push raises to its caller BEFORE any journal
+    record or watermark advance — one malformed call must never poison
+    the journal and make the whole fleet unrecoverable."""
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    server.push(0, np.zeros((100, 3), np.float32))
+    with pytest.raises(ValueError, match="expected"):
+        server.push(0, np.zeros((10, 5), np.float32))
+    assert server.watermark(0) == 100  # not advanced by the bad push
+    server.push(0, np.zeros((100, 3), np.float32))
+    server.poll(force=True)  # ack boundary: flush everything durable
+    server.journal.kill()
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.watermark(0) == 200
+    restored.flush()
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_fresh_attach_refuses_existing_journal(tmp_path):
+    """`--journal DIR` without `--resume` onto a crashed fleet's
+    directory must refuse instead of silently rotating away (and thus
+    destroying) the recovery data."""
+    from har_tpu.serve import JournalError
+
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    server.push(0, np.zeros((150, 3), np.float32))
+    server.poll(force=True)  # flush so the crash leaves durable state
+    server.journal.kill()
+    with pytest.raises(JournalError, match="already holds"):
+        _journaled_server(tmp_path)
+    # the recovery data survived the refused attach
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.watermark(0) == 150
+
+
+# -------------------------------------------- back-compat (pre-journal)
+
+
+def test_stats_state_roundtrip_and_pre_journal_defaults():
+    """FleetStats.state()/load_state round-trips, and a pre-journal
+    state dict (no lost_in_crash / recoveries / rejected_samples)
+    loads with zero defaults — both directions pinned."""
+    s = FleetStats()
+    s.enqueued = 10
+    s.note_scored(6, "v1")
+    s.note_scored(1, "v2")
+    s.drop(3, "backpressure")
+    s.rejected_samples = 2
+    s.lost_in_crash = 0
+    s.dispatch.record(1.5)
+    state = s.state()
+    s2 = FleetStats()
+    s2.load_state(json.loads(json.dumps(state)))  # via JSON, like disk
+    assert s2.enqueued == 10 and s2.scored == 7
+    assert s2.scored_by_version == {"v1": 6, "v2": 1}
+    assert s2.dropped == {"backpressure": 3}
+    assert s2.rejected_samples == 2
+    assert s2.dispatch.count == 1
+    assert s2.accounting() == s.accounting()
+    # pre-journal dict: the new fields absent entirely
+    old = json.loads(json.dumps(state))
+    for key in ("lost_in_crash", "recoveries", "rejected_samples"):
+        old["counters"].pop(key, None)
+    s3 = FleetStats()
+    s3.load_state(old)
+    assert s3.lost_in_crash == 0
+    assert s3.recoveries == 0
+    assert s3.rejected_samples == 0
+    assert s3.accounting()["balanced"]
+    h = StageHistogram()
+    h.load_state({})  # empty pre-journal histogram state
+    assert h.count == 0
+
+
+def test_cli_serve_journal_kill_and_resume(tmp_path, capsys):
+    """Acceptance: `har serve --journal DIR --resume` survives a
+    mid-run kill end to end — the resumed run recovers, re-delivers
+    from the watermark, scores every window exactly once, and the
+    accounting (including recoveries) proves it."""
+    import subprocess
+    import sys as _sys
+
+    jdir = str(tmp_path / "wal")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "har_tpu.cli", "serve",
+            "--sessions", "8", "--windows-per-session", "4",
+            "--journal", jdir, "--journal-flush-every", "4",
+            "--kill-after-polls", "3",
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 17, proc.stderr[-500:]
+    assert "kill-after-polls" in proc.stderr
+    assert os.path.isdir(jdir)
+
+    from har_tpu.cli import main
+
+    rc = main(
+        [
+            "serve", "--sessions", "8", "--windows-per-session", "4",
+            "--journal", jdir, "--resume",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["resumed"] is True
+    assert out["recoveries"] == 1
+    assert out["lost_in_crash"] == 0
+    assert out["dropped"] == 0
+    # every window of the full workload scored exactly once across the
+    # two processes: cumulative accounting (restored + resumed) covers
+    # all 8 sessions x 4 windows, with zero double-scoring possible by
+    # the ack-replay construction
+    assert out["enqueued"] == out["scored"] == 32
+    assert out["stats"]["accounting"]["balanced"]
+    assert out["stats"]["accounting"]["pending"] == 0

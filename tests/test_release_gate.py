@@ -80,6 +80,28 @@ def test_gate_log_carries_adapt_smoke_verdict():
     assert adapt["dropped"] == 0
 
 
+def test_gate_log_carries_recovery_smoke_verdict():
+    """The durability counterpart of the fleet/adapt verdicts: the gate
+    log must carry a green crash-recovery check with the {kill_points,
+    recovered, windows_lost, recovery_ms} stamp — killed at
+    representative stage boundaries, recovered with intact accounting
+    and zero lost windows."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    rec = log.get("recovery_smoke")
+    assert rec, (
+        "artifacts/test_gate.json lacks the recovery_smoke verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in ("kill_points", "recovered", "windows_lost", "recovery_ms"):
+        assert key in rec
+    assert rec["ok"] is True
+    assert rec["recovered"] == len(rec["kill_points"]) >= 3
+    assert rec["windows_lost"] == 0
+    assert rec["recovery_ms"] >= 0
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
