@@ -977,6 +977,94 @@ def main() -> None:
 
     _, fleet_stats = deadline_lane("fleet_serving", 40, _fleet_lane)
 
+    # Pipelined-dispatch grid (r10 tentpole, har_tpu.serve.dispatch):
+    # the SAME 1,000-session fleet load run across the dispatch-plane
+    # configurations — synchronous single-device (1x1, the PR-2
+    # baseline), double-buffered single-device (2x1), and double-
+    # buffered + batch-sharded over the mesh (2xN, target_batch scaled
+    # at 256 windows PER DEVICE — weak scaling, the standard serving-
+    # mesh batch policy).  Model: the jitted training-free MLP demo
+    # (JitDemoModel) with an EMULATED tunnel RTT per dispatch — the
+    # stand-in for the documented remote-tunnel serving path (~250 ms
+    # e2e per dispatch vs sub-ms device compute, BENCH_r04): on a
+    # local-CPU host the device finishes in microseconds, so without
+    # the emulation the overlap this lane measures would be invisible
+    # here and enormous in production.  The RTT is stamped into the
+    # lane so every number is reproducible anywhere.  The mesh cell
+    # needs >1 visible device (tests force an 8-device dry-run CPU
+    # mesh; on a bare CPU host run under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8).
+    def _pipeline_grid_lane():
+        from har_tpu.serve.loadgen import (
+            run_pipeline_cell,
+            run_pipeline_cell_subprocess,
+        )
+
+        n_sessions = 128 if smoke else 1000
+        rtt_ms = 30.0
+        mesh_devices = 8
+        # per-device batch, weak-scaled: the mesh cell batches at
+        # tb_base × devices.  Smoke shrinks tb_base so the tiny smoke
+        # fleet still fills a multi-dispatch pipeline (the lane's job
+        # in smoke mode is exercising the assembly, not the numbers)
+        tb_base = 32 if smoke else 256
+        common = dict(
+            n_sessions=n_sessions,
+            tunnel_rtt_ms=rtt_ms,
+            n_runs=lane_runs,
+            seed=3,
+        )
+        grid = {}
+        grid["1x1"] = run_pipeline_cell(1, 1, target_batch=tb_base, **common)
+        grid["2x1"] = run_pipeline_cell(2, 1, target_batch=tb_base, **common)
+        # the mesh cell runs in a SUBPROCESS with a forced dry-run
+        # device count (the shared run_pipeline_cell_subprocess —
+        # forcing 8 host devices in THIS process would reshape every
+        # other lane's mesh; on a host already exposing >= 8 real
+        # devices the flag is inert and the cell shards those).  A dead
+        # or hung cell is a recorded marker, never a lost bench run.
+        mesh_label = f"2x{mesh_devices}"
+        try:
+            grid[mesh_label] = run_pipeline_cell_subprocess(
+                2,
+                mesh_devices,
+                dict(common, target_batch=tb_base * mesh_devices),
+                timeout_s=240,
+            )
+        except Exception as exc:
+            grid[mesh_label] = {
+                "error": f"mesh cell failed: {str(exc)[-300:]}"
+            }
+            print(
+                "warning: fleet_pipeline_grid mesh cell failed: "
+                f"{str(exc)[-300:]}",
+                file=sys.stderr,
+            )
+        mesh_cell = (
+            mesh_label if "error" not in grid[mesh_label] else "2x1"
+        )
+        base = grid["1x1"]["windows_per_sec_median"]
+        speedup = (
+            round(grid[mesh_cell]["windows_per_sec_median"] / base, 2)
+            if base
+            else None
+        )
+        return None, {
+            "model": "jit_demo_mlp_h256",
+            "emulated_tunnel_rtt_ms": rtt_ms,
+            "n_sessions": n_sessions,
+            "windows_per_session": 2,
+            "n_runs": lane_runs,
+            "grid": grid,
+            "mesh_cell": mesh_cell,
+            "speedup_vs_sync_single": speedup,
+            "chip_state_probe": chip_probe,
+        }
+
+    _, pipeline_stats = deadline_lane(
+        "fleet_pipeline_grid", 35, _pipeline_grid_lane
+    )
+
     # Adaptive-serving lane (r8 tentpole, har_tpu.adapt): the fleet
     # workload with a FORCED mid-run hot-swap — every session streams
     # half its recording, the serving model is swapped at a dispatch
@@ -1273,6 +1361,23 @@ def main() -> None:
         "fleet_event_p50_ms": fleet_stats.get("event_p50_ms_median"),
         "fleet_event_p99_ms": fleet_stats.get("event_p99_ms_median"),
         "fleet_dropped_windows": fleet_stats.get("dropped_windows"),
+        # pipelined dispatch grid (har_tpu.serve.dispatch): depth x
+        # devices cells over the same load; the headline is the mesh
+        # cell's windows/s vs the synchronous single-device baseline
+        "fleet_pipeline_speedup": pipeline_stats.get(
+            "speedup_vs_sync_single"
+        ),
+        "fleet_pipeline_mesh_cell": pipeline_stats.get("mesh_cell"),
+        "fleet_pipeline_overlap_pct": (
+            (pipeline_stats.get("grid") or {})
+            .get(pipeline_stats.get("mesh_cell") or "", {})
+            .get("overlap_pct")
+        ),
+        "fleet_pipeline_devices": (
+            (pipeline_stats.get("grid") or {})
+            .get(pipeline_stats.get("mesh_cell") or "", {})
+            .get("devices")
+        ),
         # adaptive serving (har_tpu.adapt): the fleet numbers across a
         # forced mid-run hot-swap — zero drops is the contract
         "adaptive_windows_per_sec_median": adaptive_stats.get(
@@ -1353,6 +1458,7 @@ def main() -> None:
         "transformer": tfm_stats,
         "saturation_transformer": sat_stats,
         "fleet_serving": fleet_stats,
+        "fleet_pipeline_grid": pipeline_stats,
         "adaptive_serving": adaptive_stats,
         "fleet_recovery": recovery_stats,
     }

@@ -216,6 +216,21 @@ def _parser() -> argparse.ArgumentParser:
     sv.add_argument("--target-batch", type=int, default=256,
                     help="micro-batcher dispatch size (power-of-two "
                          "padded; at most log2+1 programs compile)")
+    sv.add_argument("--pipeline-depth", type=int, default=1,
+                    help="dispatch batches in flight on-device before "
+                         "the host blocks on a retire: 1 = synchronous "
+                         "engine, 2 = double-buffered (the host "
+                         "assembles/ingests while the device scores; "
+                         "events, smoothing and journal acks stay in "
+                         "the exact synchronous order)")
+    sv.add_argument("--mesh", type=int, default=0,
+                    help="shard each dispatch batch over this many "
+                         "devices (jax.devices(); batches pad to "
+                         "devices x pow2).  0 = single device.  On a "
+                         "CPU host run under XLA_FLAGS=--xla_force_"
+                         "host_platform_device_count=N for a dry-run "
+                         "mesh.  Needs a jitted model; the analytic "
+                         "demo model falls back to host scoring")
     sv.add_argument("--max-delay-ms", type=float, default=50.0,
                     help="deadline: max time a due window waits for "
                          "batch coalescing")
@@ -632,6 +647,23 @@ def main(argv=None) -> int:
                 stall_every=args.inject_stall_every,
                 stall_ms=args.inject_stall_ms,
             )
+        mesh = None
+        if args.mesh:
+            import jax
+
+            from har_tpu.parallel.mesh import create_mesh
+
+            n_dev = len(jax.devices())
+            if args.mesh > n_dev:
+                raise SystemExit(
+                    f"--mesh {args.mesh} needs {args.mesh} devices but "
+                    f"only {n_dev} are visible; on a CPU host run "
+                    "under XLA_FLAGS=--xla_force_host_platform_device_"
+                    f"count={args.mesh} for a dry-run mesh"
+                )
+            mesh = create_mesh(
+                dp=args.mesh, tp=1, devices=jax.devices()[: args.mesh]
+            )
         journal_cfg = None
         if args.journal:
             from har_tpu.serve import JournalConfig
@@ -659,6 +691,7 @@ def main(argv=None) -> int:
                 lambda ver: model,
                 fault_hook=fault_hook,
                 journal_config=journal_cfg,
+                mesh=mesh,
             )
             recovered_events = server.poll(force=True)
             recordings = [
@@ -677,10 +710,12 @@ def main(argv=None) -> int:
                     max_sessions=args.sessions,
                     target_batch=args.target_batch,
                     max_delay_ms=args.max_delay_ms,
+                    pipeline_depth=args.pipeline_depth,
                 ),
                 fault_hook=fault_hook,
                 journal=args.journal,
                 journal_config=journal_cfg,
+                mesh=mesh,
             )
             from har_tpu.monitoring import DriftMonitor
 
@@ -814,6 +849,9 @@ def main(argv=None) -> int:
                             "p99_ms"
                         ),
                         "degraded_events": snap["degraded_events"],
+                        "pipeline_depth": snap["pipeline_depth"],
+                        "devices": snap["devices"],
+                        "overlap_pct": snap["overlap_pct"],
                         "drift_events": sum(
                             1 for ev in events if ev.event.drift
                         ),

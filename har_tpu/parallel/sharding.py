@@ -4,6 +4,14 @@ The reference's data distribution is Spark partitioning rows across
 executors (implicit under every action, SURVEY §2c.1).  Here distribution
 is declarative: arrays carry a `NamedSharding`, and XLA inserts the
 collectives the layout implies.
+
+Training shards through ``shard_batch`` (pad to the dp size + validity
+mask).  SERVING shards through ``batch_sharding`` directly: the fleet
+engine's ``ShardedScorer`` (har_tpu.serve.dispatch) places each padded
+dispatch batch with ``batch_sharding(mesh, ndim=3)`` — rows split over
+the data axes, no mask needed because the pad policy
+(``serving.pad_shard``: devices × pow2) makes the batch divide the
+shard count exactly and padded rows are sliced off at the fetch.
 """
 
 from __future__ import annotations

@@ -4,12 +4,15 @@
 Public surface:
   FleetServer / FleetConfig / FleetEvent  — the engine (engine.py)
   FleetStats                              — observability (stats.py)
+  DispatchTicket / StagingArena / make_scorer — pipelined dispatch
+                                            plane (dispatch.py)
   DispatchFaults / DeliveryFaults / FakeClock — fault injection
-  AnalyticDemoModel / synthetic_sessions / drive_fleet — load generation
+  AnalyticDemoModel / JitDemoModel / synthetic_sessions / drive_fleet
+                                          — load generation
   FleetJournal / JournalConfig            — durability (journal.py)
   restore_server / recovery_smoke         — crash recovery (recover.py)
   KILL_POINTS / run_kill_point            — kill-point chaos (chaos.py)
-  fleet_slo_smoke                         — the release gate's check
+  fleet_slo_smoke / fleet_pipeline_smoke  — the release gate's checks
 
 See docs/serving.md for the architecture and the equivalence contract,
 docs/recovery.md for the journal format and the recovery invariants.
@@ -22,6 +25,11 @@ from har_tpu.serve.chaos import (
     SimulatedCrash,
     run_kill_point,
     run_random_kill,
+)
+from har_tpu.serve.dispatch import (
+    DispatchTicket,
+    StagingArena,
+    make_scorer,
 )
 from har_tpu.serve.engine import (
     AdmissionError,
@@ -43,6 +51,7 @@ from har_tpu.serve.journal import (
 )
 from har_tpu.serve.loadgen import (
     AnalyticDemoModel,
+    JitDemoModel,
     LoadReport,
     drive_fleet,
     synthetic_sessions,
@@ -52,7 +61,11 @@ from har_tpu.serve.recover import (
     recovery_smoke,
     restore_server,
 )
-from har_tpu.serve.slo import events_equal, fleet_slo_smoke
+from har_tpu.serve.slo import (
+    events_equal,
+    fleet_pipeline_smoke,
+    fleet_slo_smoke,
+)
 from har_tpu.serve.stats import FleetStats, StageHistogram
 
 __all__ = [
@@ -61,6 +74,7 @@ __all__ = [
     "DeliveryFaults",
     "DispatchError",
     "DispatchFaults",
+    "DispatchTicket",
     "ENGINE_KILL_POINTS",
     "FakeClock",
     "FleetConfig",
@@ -69,6 +83,7 @@ __all__ = [
     "FleetServer",
     "FleetStats",
     "InjectedDispatchFailure",
+    "JitDemoModel",
     "JournalConfig",
     "JournalError",
     "KILL_POINTS",
@@ -77,9 +92,12 @@ __all__ = [
     "RecoveryError",
     "SimulatedCrash",
     "StageHistogram",
+    "StagingArena",
     "drive_fleet",
     "events_equal",
+    "fleet_pipeline_smoke",
     "fleet_slo_smoke",
+    "make_scorer",
     "recovery_smoke",
     "restore_server",
     "run_kill_point",

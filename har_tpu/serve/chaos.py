@@ -16,12 +16,23 @@ Kill points (KILL_POINTS), in pipeline order::
 
     post_enqueue        windows queued, push record possibly un-flushed
     pre_dispatch        queue populated, nothing scored
-    mid_dispatch        batch popped from the queue, not yet scored
+    mid_dispatch        batch popped from the queue, not yet launched
+    mid_launch          batch launched on-device (ticket in flight),
+                        nothing fetched, nothing acked
+    pre_retire          ticket about to be retired: device result may
+                        exist, acks not yet written
     post_score_pre_ack  scores computed, acks not yet journaled
     mid_snapshot        snapshot tmp written, rename not yet done
     mid_swap            swap applied in memory, record not yet durable
     mid_promote         registry promoted, fleet swap not yet applied
     mid_rollback        registry rolled back, swap-back not yet applied
+
+The two launch/retire points exist because pipelining moved the ack
+boundary: a ticket in flight at crash time is un-acked BY CONSTRUCTION,
+so both points must recover exactly like pre_dispatch — the popped
+windows re-derive from replayed pushes and are re-scored.  The matrix
+runs at pipeline_depth 1 AND 2 (test-pinned): depth must never change
+what a crash can lose.
 
 The verdict of every point is the same three-part contract
 (test-pinned in tests/test_recovery.py, sampled by the release gate's
@@ -54,6 +65,8 @@ KILL_POINTS = (
     "post_enqueue",
     "pre_dispatch",
     "mid_dispatch",
+    "mid_launch",
+    "pre_retire",
     "post_score_pre_ack",
     "mid_snapshot",
     "mid_swap",
@@ -67,6 +80,8 @@ _DEFAULT_AT = {
     "post_enqueue": 12,
     "pre_dispatch": 3,
     "mid_dispatch": 2,
+    "mid_launch": 2,
+    "pre_retire": 2,
     "post_score_pre_ack": 2,
     "mid_snapshot": 1,
     "mid_swap": 1,
@@ -169,6 +184,7 @@ def run_kill_point(
     snapshot_every: int = 40,
     fsync: bool = True,
     journal_dir: str | None = None,
+    pipeline_depth: int = 1,
 ) -> dict:
     """Kill a journaled fleet at one stage boundary, recover, resume,
     and return the verdict dict (``ok`` + evidence).
@@ -177,10 +193,16 @@ def run_kill_point(
     injected stalls on the fake clock: the fault plumbing is live, the
     scores stay deterministic), with a mid-run hot swap in the schedule
     so swap-adjacent kill points have something to interrupt.
+
+    ``pipeline_depth > 1`` runs the same matrix with tickets genuinely
+    in flight at the kill instant — the conservation law and the
+    bit-identical-continuation contract must hold unchanged, because a
+    ticket in flight is un-acked by construction.
     """
     if point in ENGINE_KILL_POINTS:
         return run_engine_kill_point(
-            point, sessions=sessions, seed=seed, journal_dir=journal_dir
+            point, sessions=sessions, seed=seed, journal_dir=journal_dir,
+            pipeline_depth=pipeline_depth,
         )
     if point not in KILL_POINTS:
         raise ValueError(f"unknown kill point {point!r}")
@@ -190,7 +212,7 @@ def run_kill_point(
     swap_sample = (n_samples // hop // 2) * hop  # mid-recording
     config = FleetConfig(
         max_sessions=sessions, target_batch=32, max_delay_ms=0.0,
-        retries=1,
+        retries=1, pipeline_depth=pipeline_depth,
     )
 
     def build(clock, journal):
@@ -337,8 +359,8 @@ def _verdict(point, ref_events, pre_events, post_events, restored,
 
 def run_random_kill(seed: int) -> dict:
     """Seed-randomized kill-point draw for the property test: point,
-    occurrence, flush batching and snapshot cadence all vary — the
-    recovery contract must hold for every combination."""
+    occurrence, flush batching, snapshot cadence AND pipeline depth all
+    vary — the recovery contract must hold for every combination."""
     rng = np.random.default_rng((seed, 0xDEAD))
     point = KILL_POINTS[int(rng.integers(len(KILL_POINTS)))]
     at = _DEFAULT_AT[point] + int(rng.integers(0, 3))
@@ -349,6 +371,7 @@ def run_random_kill(seed: int) -> dict:
         seed=seed,
         flush_every=int(rng.choice([1, 4, 16, 64])),
         snapshot_every=int(rng.choice([0, 10, 30])),
+        pipeline_depth=int(rng.choice([1, 2])),
     )
     out["seed"] = seed
     if not out["ok"] and "never fired" in (out["why"] or ""):
@@ -362,7 +385,7 @@ def run_random_kill(seed: int) -> dict:
 
 def run_engine_kill_point(
     point: str, *, sessions: int = 8, seed: int = 0,
-    journal_dir: str | None = None,
+    journal_dir: str | None = None, pipeline_depth: int = 1,
 ) -> dict:
     """Kill inside the adaptation controller's registry transitions —
     after ``registry.promote`` but before the fleet swap applies
@@ -400,7 +423,8 @@ def run_engine_kill_point(
         server = FleetServer(
             incumbent, window=100, hop=100, channels=3, smoothing="none",
             config=FleetConfig(
-                max_sessions=sessions, max_delay_ms=0.0, retries=0
+                max_sessions=sessions, max_delay_ms=0.0, retries=0,
+                pipeline_depth=pipeline_depth,
             ),
             clock=clock, fault_hook=faults, journal=journal,
         )

@@ -16,6 +16,13 @@ predict path:
     log2(target_batch)+1 programs ever compile;
   - admission control (bounded sessions), bounded per-session and global
     queues with backpressure (shed-oldest, never block the producer);
+  - a pipelined, mesh-shardable dispatch plane (har_tpu.serve.dispatch):
+    windows stage ONCE into a contiguous arena at enqueue, batches
+    launch asynchronously (device_put + jitted predict, un-fetched)
+    and retire in strict FIFO order, so with pipeline_depth > 1 the
+    host assembles batch N+1 while batch N scores on-device — and with
+    a >1-device mesh attached the batch rows shard across the mesh
+    (pad policy: devices × pow2, the same log2 program budget);
   - per-dispatch retry + SLO tracking with graceful degradation, in
     strict order: shed smoothing first (host-side work, events keep
     flowing with raw labels), then shed scoring by dropping the STALEST
@@ -48,6 +55,12 @@ from typing import Callable, Hashable, Sequence
 
 import numpy as np
 
+from har_tpu.serve.dispatch import (
+    DispatchTicket,
+    HostScorer,
+    StagingArena,
+    make_scorer,
+)
 from har_tpu.serve.journal import (
     FleetJournal,
     JournalConfig,
@@ -60,7 +73,6 @@ from har_tpu.serving import (
     _WindowAssembler,
     finite_rows,
     measure_device_latency,
-    pad_pow2,
 )
 
 
@@ -110,12 +122,22 @@ class FleetConfig:
     # they can poison a micro-batch; None disables the range check but
     # never the NaN/Inf one (serving.finite_rows)
     max_abs_sample: float | None = 1e6
+    # dispatch pipelining: batches in flight on-device before the host
+    # blocks on a retire.  1 = the synchronous engine (launch then
+    # retire back-to-back, operation-identical to PR-2); 2 = classic
+    # double buffering — while batch N scores on-device, the host
+    # assembles and launches N+1.  Retire order stays FIFO, so events,
+    # smoothing and journal acks are emitted in the exact synchronous
+    # order at any depth (test-pinned bit-identical at N=64).
+    pipeline_depth: int = 1
 
     def __post_init__(self):
         if self.max_sessions <= 0 or self.target_batch <= 0:
             raise ValueError("max_sessions and target_batch must be positive")
         if not (0.0 < self.shed_fraction <= 1.0):
             raise ValueError("shed_fraction must be in (0, 1]")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,18 +157,29 @@ class FleetEvent:
 
 
 class _Pending:
-    """One completed, not-yet-scored window in the queues."""
+    """One completed, not-yet-scored window in the queues.
 
-    __slots__ = ("session", "t_index", "window", "drift", "t_enqueue",
-                 "dropped")
+    The window's data lives in the server's staging arena
+    (``har_tpu.serve.dispatch.StagingArena``): ``slot`` indexes the
+    contiguous staging block the assembler wrote it into at enqueue
+    time.  Batch assembly gathers slots; dropping frees them.
+    ``launched`` marks a window riding an in-flight dispatch ticket —
+    push-time sheds skip those (the dispatch already carries them;
+    shedding one would save nothing and corrupt the retire bookkeeping),
+    while a ``remove_session`` still flags them dropped and retire then
+    skips the flagged rows."""
 
-    def __init__(self, session, t_index, window, drift, t_enqueue):
+    __slots__ = ("session", "t_index", "slot", "drift", "t_enqueue",
+                 "dropped", "launched")
+
+    def __init__(self, session, t_index, slot, drift, t_enqueue):
         self.session = session
         self.t_index = t_index
-        self.window = window
+        self.slot = slot
         self.drift = drift
         self.t_enqueue = t_enqueue
         self.dropped = False
+        self.launched = False
 
 
 class _FleetSession:
@@ -205,6 +238,7 @@ class FleetServer:
         model_version: str = "v0",
         journal: FleetJournal | str | None = None,
         journal_config: JournalConfig | None = None,
+        mesh=None,
     ):
         if window <= 0 or hop <= 0:
             raise ValueError("window and hop must be positive")
@@ -233,6 +267,30 @@ class FleetServer:
         self._sessions: dict[Hashable, _FleetSession] = {}
         self._queue: deque[_Pending] = deque()  # global FIFO
         self._n_live = 0
+        # live windows still IN the queue (not yet launched on-device):
+        # what the micro-batcher's due() reasons over.  _n_live keeps
+        # counting launched-but-unretired windows too — those are still
+        # "pending" in the conservation law until their ack.
+        self._n_unlaunched = 0
+        # contiguous staging for queued windows: the assembler writes
+        # each completed window here ONCE at enqueue; batch assembly is
+        # a gather (har_tpu.serve.dispatch.StagingArena)
+        self._arena = StagingArena(
+            self.window, self.channels,
+            capacity=max(2 * self.config.target_batch, 64),
+        )
+        # dispatch backend: built lazily from (model, mesh) — a >1-device
+        # mesh shards the batch, a jitted model launches async, anything
+        # else scores synchronously through model.transform
+        self._mesh = mesh
+        self._scorer = None
+        # launched-but-not-retired dispatch tickets, FIFO.  With
+        # pipeline_depth > 1 up to depth-1 tickets survive BETWEEN
+        # polls, so the device crunches a batch while the host ingests
+        # the next delivery round; snapshots serialize their windows as
+        # pending (they are un-acked by construction), so a crash with
+        # a ticket in flight loses nothing
+        self._inflight: deque[DispatchTicket] = deque()
         # degradation ladder state
         self._smoothing_shed = False
         self._breaches = 0
@@ -258,6 +316,11 @@ class FleetServer:
         # state here), and what recovery read back for them
         self.snapshot_providers: dict[str, Callable[[], dict]] = {}
         self.recovered_extra: dict = {}
+        # arena sizing rides the provider hook for observability; the
+        # staged windows themselves ride the snapshot's existing
+        # ``pending`` array (format unchanged — pre-arena journals
+        # restore cleanly, test-pinned)
+        self.snapshot_providers["staging_arena"] = self._arena.state
         if journal is not None:
             self.attach_journal(journal, journal_config)
 
@@ -351,16 +414,29 @@ class FleetServer:
             )
         sid_index = {sid: i for i, sid in enumerate(sids)}
         pending_meta = []
-        pending_windows = []
-        for p in self._queue:
+        pending_slots = []
+
+        def _note_pending(p):
             if p.dropped:
-                continue
+                return
             pending_meta.append(
                 [sid_index[p.session.sid], p.t_index, bool(p.drift)]
             )
-            pending_windows.append(p.window)
-        if pending_windows:
-            arrays["pending"] = np.stack(pending_windows)
+            pending_slots.append(p.slot)
+
+        # in-flight tickets FIRST (they left the queue before anything
+        # still in it): an un-retired batch is un-acked by construction,
+        # so its windows are snapshot as ordinary pending — a crash with
+        # a ticket in flight recovers them for re-scoring
+        for t in self._inflight:
+            for p in t.batch:
+                _note_pending(p)
+        for p in self._queue:
+            _note_pending(p)
+        if pending_slots:
+            # gathered OUT of the arena at snapshot time: the on-disk
+            # layout is the same stacked array pre-arena snapshots used
+            arrays["pending"] = self._arena.gather(pending_slots)
         state = {
             "geometry": {
                 "window": self.window,
@@ -397,6 +473,29 @@ class FleetServer:
         from har_tpu.serve.recover import restore_server
 
         return restore_server(journal_dir, model, **kwargs)
+
+    def _restore_pending(self, sess, t_index, window, drift, now) -> _Pending:
+        """Recovery path (har_tpu.serve.recover): re-stage one pending
+        window into the arena and re-enqueue it in global FIFO order."""
+        p = _Pending(
+            sess, int(t_index), self._arena.put(window), bool(drift), now
+        )
+        sess.pending.append(p)
+        self._queue.append(p)
+        sess.n_live += 1
+        self._n_live += 1
+        self._n_unlaunched += 1
+        return p
+
+    def _release_pending(self, p: _Pending) -> None:
+        """Recovery path: a replayed ack/drop consumed this recovered
+        window — free its staging slot and take it off the live queue
+        counters (the record's own accounting is the caller's job)."""
+        p.dropped = True
+        self._arena.free(p.slot)
+        p.session.n_live -= 1
+        self._n_live -= 1
+        self._n_unlaunched -= 1
 
     def watermark(self, session_id: Hashable) -> int:
         """Samples durably delivered for this session, in the
@@ -485,14 +584,21 @@ class FleetServer:
         if sess is None:
             raise AdmissionError(f"unknown session {session_id!r}")
         n = 0
+        n_unlaunched = 0
         for p in sess.pending:
             if not p.dropped:
                 p.dropped = True
-                p.window = None
+                self._arena.free(p.slot)
                 n += 1
+                if not p.launched:
+                    # launched windows already left the un-launched
+                    # count at their dispatch; retire skips their
+                    # flagged rows (no event, no ack, no double free)
+                    n_unlaunched += 1
         sess.pending.clear()
         sess.n_dropped += n
         self._n_live -= n
+        self._n_unlaunched -= n_unlaunched
         if n:
             self.stats.drop(n, "session_removed")
         self.stats.sessions = len(self._sessions)
@@ -567,19 +673,29 @@ class FleetServer:
                 },
                 samples.tobytes(),
             )
-        completed = sess.asm.consume(samples)
-        for t_index, win, drift in completed:
-            p = _Pending(sess, t_index, win, drift, now)
+        # the assembler stages every completed window straight into the
+        # arena (one copy, contiguous storage; multi-window bursts stage
+        # in one vectorized block write) — batch assembly later is a
+        # gather, not a stack of scattered per-window arrays
+        completed = sess.asm.consume(samples, sink=self._arena)
+        n_completed = len(completed)
+        for t_index, slot, drift in completed:
+            p = _Pending(sess, t_index, slot, drift, now)
             sess.pending.append(p)
             self._queue.append(p)
             sess.n_live += 1
-            sess.n_enqueued += 1
-            self._n_live += 1
-            self.stats.enqueued += 1
+        if n_completed:
+            sess.n_enqueued += n_completed
+            self._n_live += n_completed
+            self._n_unlaunched += n_completed
+            self.stats.enqueued += n_completed
         # bounded per-session queue: this session sheds ITS OWN oldest
         # windows — one stalled consumer must not push the fleet around
+        # (in-flight windows are not sheddable; the bound re-applies
+        # once their dispatch retires)
         while sess.n_live > self.config.max_pending_per_session:
-            self._drop_oldest_of(sess, "session_queue")
+            if not self._drop_oldest_of(sess, "session_queue"):
+                break
         # global backpressure: shed the stalest queued windows fleet-
         # wide (FIFO head = oldest enqueue = stalest session data)
         overflow = self._n_live - self.config.max_queue_windows
@@ -589,17 +705,22 @@ class FleetServer:
         self._chaos("post_enqueue")
         return len(completed)
 
-    def _drop_oldest_of(self, sess: _FleetSession, reason: str) -> None:
-        while sess.pending:
-            p = sess.pending.popleft()
-            if not p.dropped:
+    def _drop_oldest_of(self, sess: _FleetSession, reason: str) -> bool:
+        # scan, don't pop: entries must keep their position for the
+        # retire-time FIFO unlink; windows already launched on-device
+        # are skipped (shedding them saves nothing — their dispatch is
+        # in flight — so the session's oldest UN-launched window goes)
+        for p in sess.pending:
+            if not p.dropped and not p.launched:
                 p.dropped = True
-                p.window = None
+                self._arena.free(p.slot)
                 sess.n_live -= 1
                 sess.n_dropped += 1
                 self._n_live -= 1
+                self._n_unlaunched -= 1
                 self.stats.drop(1, reason)
-                return
+                return True
+        return False
 
     def _shed_stalest(self, n: int, reason: str, record: bool = False) -> int:
         """Drop up to n live windows from the global FIFO head (the
@@ -624,10 +745,11 @@ class FleetServer:
                         }
                     )
                 p.dropped = True
-                p.window = None
+                self._arena.free(p.slot)
                 p.session.n_live -= 1
                 p.session.n_dropped += 1
                 self._n_live -= 1
+                self._n_unlaunched -= 1
                 shed += 1
         if shed:
             self.stats.drop(shed, reason)
@@ -637,8 +759,10 @@ class FleetServer:
 
     def due(self, now: float | None = None) -> bool:
         """Would poll() dispatch right now?  True when a full batch is
-        queued or the oldest queued window has passed its deadline."""
-        if self._n_live >= self.config.target_batch:
+        queued or the oldest queued window has passed its deadline.
+        Reasoned over the UN-LAUNCHED queue: windows already in flight
+        on-device (pipeline_depth > 1) no longer wait for a batch."""
+        if self._n_unlaunched >= self.config.target_batch:
             return True
         oldest = self._oldest_live()
         if oldest is None:
@@ -657,21 +781,78 @@ class FleetServer:
         ``force=True`` dispatches regardless of deadlines (drain).  A
         dispatch that fails after retries drops its own windows and
         keeps the engine serving — the error is counted, not raised.
+
+        Pipelined dispatch (``FleetConfig.pipeline_depth``): up to
+        ``depth`` launched tickets ride in flight on-device while the
+        host assembles the next batch, and up to ``depth - 1`` of them
+        survive BETWEEN polls — the device scores a batch while the
+        host ingests the next delivery round, the overlap a depth-1
+        engine structurally cannot have.  Retire order is strictly
+        FIFO, so events, smoothing steps and journal acks happen in the
+        exact order the synchronous (depth-1) engine produces them (a
+        carried ticket's events are simply returned by the poll that
+        retires it).  The ack flush below covers every event this call
+        hands to the consumer; a ticket still in flight at a crash is
+        un-acked by construction and its windows recover as pending
+        (see docs/serving.md's ticket lifecycle).
         """
         if (
             self._journal is not None
             and not self._replaying
             and self._journal.snapshot_due()
         ):
-            # snapshot at the START of a poll: a dispatch boundary with
-            # no not-yet-returned acks in the buffer — a kill inside
-            # the snapshot can only lose re-scorable pending windows,
-            # never an acked-but-undelivered event
+            # snapshot at the START of a poll, BEFORE carried tickets
+            # retire: no not-yet-returned acks are in the buffer, and
+            # in-flight windows are serialized as ordinary pending —
+            # a kill inside the snapshot can only lose re-scorable
+            # pending windows, never an acked-but-undelivered event
             self.write_snapshot()
         self._chaos("pre_dispatch")
         events: list[FleetEvent] = []
-        while self._n_live and (force or self.due()):
-            events.extend(self._dispatch_batch())
+        depth = self.config.pipeline_depth
+        inflight = self._inflight
+        # tickets carried from the previous poll crunched on-device
+        # through the delivery phase; their results are due now.  The
+        # inter-poll span is one shared wall-clock interval: credit it
+        # to overlap_pct ONCE (not per ticket), and stamp it on every
+        # carried ticket as deliberate idle so the SLO ladder never
+        # reads the pipeline's own buffering as a slow tunnel.
+        if inflight:
+            now0 = self._clock()
+            credited = False
+            for t in inflight:
+                if t.t_carried0 is not None:
+                    span = (now0 - t.t_carried0) * 1e3
+                    t.idle_ms += span
+                    if not credited:
+                        self.stats.overlap_host_ms += span
+                        credited = True
+        while inflight:
+            events.extend(self._retire_ticket(inflight.popleft()))
+        while self._n_unlaunched and (force or self.due()):
+            if len(inflight) >= depth:
+                events.extend(self._retire_ticket(inflight.popleft()))
+            t_h0 = self._clock()
+            ticket = self._launch_batch()
+            if ticket is None:
+                break
+            if inflight:
+                # host assembly that ran UNDER an in-flight device batch
+                # — the intra-poll half of overlap_pct
+                self.stats.overlap_host_ms += (
+                    self._clock() - t_h0
+                ) * 1e3
+            ticket.t_inflight0 = self._clock()
+            inflight.append(ticket)
+            self.stats.note_inflight_depth(len(inflight))
+        # drain down to the carry allowance: nothing on a forced drain
+        # (flush/shutdown), up to depth-1 tickets otherwise
+        keep = 0 if force else depth - 1
+        while len(inflight) > keep:
+            events.extend(self._retire_ticket(inflight.popleft()))
+        now = self._clock()
+        for t in inflight:
+            t.t_carried0 = now
         if self._staged_swap is not None:
             # a completed dispatch IS a boundary: a swap staged from a
             # dispatch tap applies as soon as its batch has finished
@@ -716,6 +897,10 @@ class FleetServer:
         self.model = model
         self.model_version = version
         self._device_ms.clear()
+        # the scorer wraps the OLD model's jitted predict (in-flight
+        # tickets keep their own reference and complete on it); the new
+        # model gets a fresh scorer at its first launch
+        self._scorer = None
         self.stats.model_swaps += 1
         # journaled swap boundary: the record is appended, the chaos
         # hook may kill here (record buffered, NOT durable — recovery
@@ -739,7 +924,25 @@ class FleetServer:
         """
         self._dispatch_tap = tap
 
-    def _dispatch_batch(self) -> list[FleetEvent]:
+    def _get_scorer(self):
+        if self._scorer is None:
+            self._scorer = make_scorer(
+                self.model, self._mesh,
+                window=self.window, channels=self.channels,
+            )
+        return self._scorer
+
+    @property
+    def scorer(self):
+        """The active dispatch backend (HostScorer / DeviceScorer /
+        ShardedScorer — har_tpu.serve.dispatch); rebuilt on model swap."""
+        return self._get_scorer()
+
+    def _launch_batch(self) -> DispatchTicket | None:
+        """LAUNCH half of a dispatch: pop the next FIFO batch, gather
+        its windows out of the staging arena, and start it on-device
+        (device_put + jitted predict, un-fetched).  Returns the ticket
+        the retire half later blocks on — or None when nothing is live."""
         cfg = self.config
         if self._staged_swap is not None:
             self._apply_swap()  # the dispatch boundary
@@ -747,33 +950,94 @@ class FleetServer:
         while self._queue and len(batch) < cfg.target_batch:
             p = self._queue.popleft()
             if not p.dropped:
+                p.launched = True
                 batch.append(p)
         if not batch:
-            return []
+            return None
+        self._n_unlaunched -= len(batch)
         self._chaos("mid_dispatch")
         t_assembled = self._clock()
         for p in batch:
             self.stats.queue_wait.record(
                 (t_assembled - p.t_enqueue) * 1e3
             )
-        k = len(batch)
-        # the shared power-of-two policy (serving.pad_pow2): at most
-        # log2(target_batch)+1 programs ever compile
-        windows = pad_pow2(np.stack([p.window for p in batch]))
-        pad_k = len(windows)
-        try:
-            probs, dispatch_ms = self._score(windows, k)
-        except DispatchError:
+        scorer = self._get_scorer()
+        # batch assembly is ONE gather out of the contiguous arena, and
+        # the pad policy is the scorer's: pow2 single-device, devices ×
+        # pow2 sharded — either way a log2-bounded program ladder
+        windows = scorer.pad(
+            self._arena.gather([p.slot for p in batch])
+        )
+        ticket = DispatchTicket(
+            batch, windows, scorer, self.model_version, self._clock()
+        )
+        for label in scorer.device_labels:
+            self.stats.note_device_windows(
+                label, ticket.pad_k // scorer.devices
+            )
+        while True:  # launch attempts (fault hook + async dispatch)
+            try:
+                if self._fault_hook is not None:
+                    self._fault_hook(ticket.windows)
+                ticket.handle = scorer.launch(ticket.windows)
+                break
+            except Exception as exc:
+                ticket.last_error = exc
+                ticket.attempts += 1
+                if ticket.attempts > cfg.retries:
+                    ticket.failed = True
+                    break
+                self.stats.dispatch_retries += 1
+        self._chaos("mid_launch")
+        return ticket
+
+    def _retire_ticket(self, ticket: DispatchTicket) -> list[FleetEvent]:
+        """RETIRE half: block on the ticket's device result, then run
+        everything that must happen in FIFO order — SLO ladder, event
+        smoothing, acks, the dispatch tap.  Strict FIFO retire is what
+        keeps pipelined event streams bit-identical to the synchronous
+        engine's, and the ack here is the SAME ack boundary: a ticket
+        that never reaches retire (crash mid-flight) is un-acked by
+        construction and its windows recover as pending."""
+        cfg = self.config
+        batch, k = ticket.batch, ticket.k
+        self._chaos("pre_retire")
+        probs = None
+        if not ticket.failed:
+            try:
+                probs = ticket.scorer.fetch(ticket.handle, k)
+            except Exception as exc:
+                ticket.last_error = exc
+                ticket.attempts += 1
+        # fetch-time failures (async dispatch surfaces errors at the
+        # blocking read) re-run the whole attempt synchronously with
+        # whatever retry budget the launch left unused
+        while probs is None and ticket.attempts <= cfg.retries:
+            self.stats.dispatch_retries += 1
+            try:
+                if self._fault_hook is not None:
+                    self._fault_hook(ticket.windows)
+                probs = ticket.scorer.fetch(
+                    ticket.scorer.launch(ticket.windows), k
+                )
+            except Exception as exc:
+                ticket.last_error = exc
+                ticket.attempts += 1
+        if probs is None:
             # graceful degradation: this batch's windows are shed, the
             # engine keeps serving every other stream.  Journaled per
             # window: unlike push-side sheds, a dispatch failure is not
             # derivable from the replayed record stream.
+            n_failed = 0
             for p in batch:
+                if p.dropped:
+                    continue  # already dropped mid-flight (eviction)
                 p.dropped = True
-                p.window = None
+                self._arena.free(p.slot)
                 p.session.n_live -= 1
                 p.session.n_dropped += 1
                 self._n_live -= 1
+                n_failed += 1
                 self._unlink_scored(p)
                 self._jappend(
                     {
@@ -783,12 +1047,20 @@ class FleetServer:
                         "reason": "dispatch_failed",
                     }
                 )
-            self.stats.drop(k, "dispatch_failed")
+            self.stats.drop(n_failed, "dispatch_failed")
             self.stats.dispatch_failures += 1
             self._note_slo(breached=True)
             return []
+        # deliberate carry idle excluded: a ticket parked across polls
+        # by design must not read as a slow dispatch (it would breach
+        # the SLO and shed smoothing, diverging the pipelined event
+        # stream from the synchronous engine's under real-time pacing)
+        dispatch_ms = max(
+            0.0, (self._clock() - ticket.t0) * 1e3 - ticket.idle_ms
+        )
+        self.stats.inflight_ms += (self._clock() - ticket.t_inflight0) * 1e3
         self.stats.dispatches += 1
-        self.stats.note_batch(pad_k)
+        self.stats.note_batch(ticket.pad_k)
         self.stats.dispatch.record(dispatch_ms)
         # the ladder is driven by PRIOR evidence: the batch that records
         # a breach is still emitted at the pre-breach degradation level
@@ -799,45 +1071,58 @@ class FleetServer:
 
         # calibrated device share for this padded program, amortized
         # per window — the per-event tunnel-vs-chip attribution
-        dev = self._device_ms.get(pad_k)
+        dev = self._device_ms.get(ticket.pad_k)
         dev_share = None if dev is None else round(dev["p50_ms"] / k, 4)
         lat_share = dispatch_ms / k
 
         t_smooth0 = self._clock()
         self._chaos("post_score_pre_ack")
+        # rows whose window was dropped mid-flight (a remove_session
+        # while the ticket was carried) are scored by the device but
+        # never emitted — their drop was already counted and their
+        # arena slot already freed
+        live = [i for i, p in enumerate(batch) if not p.dropped]
+        # decisions, vectorized where the math allows: raw argmax for
+        # the whole batch in one reduction; stateful smoothing batched
+        # per session (update_many — the sequential recurrence, one call
+        # per session instead of one per row)
+        if shed:
+            raw_labels = probs.argmax(axis=1)
+            decided = {
+                i: (int(raw_labels[i]), int(raw_labels[i]), probs[i])
+                for i in live
+            }
+            self.stats.degraded_events += len(live)
+        else:
+            rows_by_sess: dict = {}
+            for i in live:
+                rows_by_sess.setdefault(batch[i].session.sid, []).append(i)
+            decided = {}
+            for rows in rows_by_sess.values():
+                outs = batch[rows[0]].session.smoother.update_many(
+                    probs[rows]
+                )
+                for i, out in zip(rows, outs):
+                    decided[i] = out
+        self.stats.note_scored(len(live), ticket.version)
         events: list[FleetEvent] = []
-        for p, pr in zip(batch, probs):
+        for i in live:
+            p, pr = batch[i], probs[i]
+            label, raw_label, decision = decided[i]
             sess = p.session
-            if shed:
-                # degradation level 1: smoothing shed — raw labels out,
-                # smoothing state left FROZEN (recovery resumes from it)
-                raw_label = int(pr.argmax())
-                ev = StreamEvent(
-                    t_index=p.t_index,
-                    label=raw_label,
-                    raw_label=raw_label,
-                    probability=pr.copy(),
-                    latency_ms=lat_share,
-                    drift=p.drift,
-                    device_ms=dev_share,
-                )
-                self.stats.degraded_events += 1
-            else:
-                label, raw_label, decision = sess.smoother.step(pr)
-                ev = StreamEvent(
-                    t_index=p.t_index,
-                    label=label,
-                    raw_label=raw_label,
-                    probability=decision.copy(),
-                    latency_ms=lat_share,
-                    drift=p.drift,
-                    device_ms=dev_share,
-                )
+            ev = StreamEvent(
+                t_index=p.t_index,
+                label=label,
+                raw_label=raw_label,
+                probability=decision.copy(),
+                latency_ms=lat_share,
+                drift=p.drift,
+                device_ms=dev_share,
+            )
             sess.n_live -= 1
             sess.n_scored += 1
             self._n_live -= 1
-            # per-version attribution: the invariant holds across swaps
-            self.stats.note_scored(1, self.model_version)
+            self._arena.free(p.slot)
             self._unlink_scored(p)
             self.stats.event.record((t_smooth0 - p.t_enqueue) * 1e3)
             # the scored-event ack: carries the probabilities so replay
@@ -850,7 +1135,7 @@ class FleetServer:
                     "t": "ack",
                     "sid": sess.sid,
                     "ti": p.t_index,
-                    "ver": self.model_version,
+                    "ver": ticket.version,
                     "shed": shed,
                 },
                 np.asarray(pr, np.float64).tobytes(),
@@ -867,7 +1152,9 @@ class FleetServer:
             t_tap = self._clock()
             try:
                 scored = self._dispatch_tap(
-                    [p.session.sid for p in batch], windows[:k], probs
+                    [p.session.sid for p in batch],
+                    ticket.windows[:k],
+                    probs,
                 )
             except Exception:
                 self.stats.shadow_errors += 1
@@ -893,33 +1180,6 @@ class FleetServer:
             if not q.dropped:  # pragma: no cover - FIFO order invariant
                 pending.appendleft(q)
                 raise AssertionError("fleet queue order violated")
-
-    def _score(self, windows: np.ndarray, k: int):
-        """One timed model.transform with fault hook + retry.  Both the
-        hook and the transform are inside the timed/retried region —
-        injected stalls and failures exercise the same accounting real
-        ones would.  The clock starts ONCE, before the first attempt:
-        dispatch_ms is what the batch actually waited, failed attempts
-        included — a stall-then-fail absorbed by the retry path must
-        still read as an SLO breach, not as the fast retry's time."""
-        last_err: Exception | None = None
-        t0 = self._clock()
-        for attempt in range(self.config.retries + 1):
-            try:
-                if self._fault_hook is not None:
-                    self._fault_hook(windows)
-                preds = self.model.transform(windows)
-                probs = np.asarray(preds.probability[:k], np.float64)
-            except Exception as exc:
-                last_err = exc
-                if attempt < self.config.retries:
-                    self.stats.dispatch_retries += 1
-                continue
-            return probs, (self._clock() - t0) * 1e3
-        raise DispatchError(
-            f"dispatch failed after {self.config.retries + 1} attempts: "
-            f"{type(last_err).__name__}: {last_err}"
-        ) from last_err
 
     def _note_slo(self, *, breached: bool) -> None:
         """The degradation ladder, in the order the docstring promises:
@@ -964,23 +1224,36 @@ class FleetServer:
         self, batch_sizes: Sequence[int] | None = None, iters: int = 16
     ) -> dict[int, dict]:
         """Measure DEVICE execution p50 for the padded batch programs
-        (shared measure_device_latency: device-resident input,
-        block_until_ready, no fetch).  Defaults to the padded sizes this
-        engine has actually dispatched (plus 1).  After calibration,
-        events carry ``device_ms`` and ``stats_snapshot`` attributes
-        dispatch p99 to tunnel/host vs device.  ValueError for models
-        without a jitted predict propagates — callers that serve host
-        stubs skip calibration."""
+        THIS ENGINE ACTUALLY EMITS: every requested size is rounded up
+        through the active scorer's pad policy (pow2 single-device,
+        ``devices × pow2`` when a mesh is attached) and measured with
+        the scorer's own placement — a sharded dispatch is timed against
+        the sharded program on sharded input, not a single-device
+        stand-in, so ``StreamEvent.device_ms`` attribution stays honest
+        under sharding.  Defaults to the padded sizes this engine has
+        dispatched (plus the smallest emitted shape).  ValueError for
+        models without a jitted predict propagates — callers that serve
+        host stubs skip calibration."""
+        scorer = self._get_scorer()
         if batch_sizes is None:
-            batch_sizes = sorted({1, *self.stats.batch_sizes})
-        for b in batch_sizes:
-            self._device_ms[int(b)] = measure_device_latency(
-                self.model,
-                window=self.window,
-                channels=self.channels,
-                batch=int(b),
-                iters=iters,
+            batch_sizes = sorted(
+                {scorer.pad_size(1), *self.stats.batch_sizes}
             )
+        for b in batch_sizes:
+            b = scorer.pad_size(int(b))
+            if isinstance(scorer, HostScorer):
+                # host fallback: keep the shared single-program
+                # measurement (raises ValueError for models with no
+                # jitted predict at all — trees, numpy stubs)
+                self._device_ms[b] = measure_device_latency(
+                    self.model,
+                    window=self.window,
+                    channels=self.channels,
+                    batch=b,
+                    iters=iters,
+                )
+            else:
+                self._device_ms[b] = scorer.measure(b, iters=iters)
         return dict(self._device_ms)
 
     # ------------------------------------------------------ reporting
@@ -990,6 +1263,16 @@ class FleetServer:
         snap = self.stats.snapshot()
         snap["smoothing_shed"] = self._smoothing_shed
         snap["model_version"] = self.model_version
+        # dispatch-plane shape: reported only once the first dispatch
+        # has built the scorer (building it here could cold-start a jax
+        # backend from a pure stats read)
+        snap["pipeline_depth"] = self.config.pipeline_depth
+        snap["dispatch_backend"] = (
+            None if self._scorer is None else self._scorer.kind
+        )
+        snap["devices"] = (
+            None if self._scorer is None else self._scorer.devices
+        )
         if self._device_ms:
             snap["device_ms"] = {
                 str(b): d["p50_ms"]

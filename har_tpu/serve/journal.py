@@ -35,7 +35,12 @@ har_tpu.serve.chaos):
     zero double-scored, zero double-counted events;
   - a window enqueued but not acked is recovered as pending and scored
     after restart — with a deterministic model, bit-identically to an
-    uninterrupted run;
+    uninterrupted run.  That includes windows riding an in-flight
+    dispatch ticket (the pipelined launch/retire split,
+    har_tpu.serve.dispatch): acks are written at RETIRE, so a ticket in
+    flight at the kill instant is un-acked by construction, and a
+    snapshot taken while it flies serializes its windows as ordinary
+    pending — pipelining never changes what a crash can lose;
   - windows whose push records never reached disk are re-deliverable
     from the recovered per-session watermark (``FleetServer.
     watermark``); a transport that cannot replay declares them lost

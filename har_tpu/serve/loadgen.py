@@ -74,6 +74,219 @@ class AnalyticDemoModel:
         )
 
 
+class JitDemoModel:
+    """A jitted, training-free MLP over raw windows — the DEVICE-path
+    counterpart of ``AnalyticDemoModel``.
+
+    Fixed-seed random dense weights (window·channels → hidden →
+    classes): deterministic, row-independent (per-row matmul + tanh —
+    batch composition can never change a row's logits), and backed by a
+    real jitted program, so it exercises everything the host-side demo
+    model cannot: async launch (un-fetched device arrays), device_put
+    placement, batch sharding over a mesh, per-shape compilation, and
+    device calibration.  The labels mean nothing — fleet benchmarks and
+    pipeline smokes measure the serving engine, and this model gives
+    the engine a genuine device workload to overlap against.
+
+    Exposes the ``params`` + ``_predict`` pair the NeuralModel family
+    exposes, so ``serve.dispatch._split_predict``,
+    ``serving.device_predict_fn`` and device calibration all treat it
+    exactly like a trained checkpoint.
+    """
+
+    def __init__(
+        self,
+        window: int = 200,
+        channels: int = 3,
+        hidden: int = 256,
+        num_classes: int = 6,
+        seed: int = 1729,
+        tunnel_rtt_ms: float = 0.0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng((seed, 0x11D3))
+        d_in = window * channels
+        scale = 1.0 / np.sqrt(d_in)
+        # emulated remote-tunnel dispatch RTT, honored by the async
+        # scorer (serve.dispatch.DeviceScorer): fetch blocks until
+        # launch + RTT.  The dry-run stand-in for the documented
+        # production tunnel (~250 ms e2e per dispatch, BENCH_r04) —
+        # what the pipelined grid's overlap claim is measured against
+        # on hosts where the local device finishes in microseconds.
+        self.tunnel_rtt_ms = float(tunnel_rtt_ms)
+        self.window = int(window)
+        self.channels = int(channels)
+        self.num_classes = int(num_classes)
+        self.class_names = tuple(
+            f"class{i}" for i in range(self.num_classes)
+        )
+        self.params = {
+            "w1": jnp.asarray(
+                rng.normal(0, scale, size=(d_in, hidden)), jnp.float32
+            ),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": jnp.asarray(
+                rng.normal(0, 1.0 / np.sqrt(hidden),
+                           size=(hidden, num_classes)),
+                jnp.float32,
+            ),
+        }
+
+        def forward(p, x):
+            h = jnp.tanh(
+                x.reshape(x.shape[0], -1) @ p["w1"] + p["b1"]
+            )
+            return h @ p["w2"]
+
+        self._jax = jax
+        self._predict = jax.jit(forward)
+
+    def transform(self, x):
+        """The synchronous reference path — same ops, same order, as
+        the async scorer's launch+fetch (dispatch.DeviceScorer), so
+        pipelined and synchronous runs of this model are bit-identical."""
+        import jax
+        import jax.numpy as jnp
+
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x, np.float32)
+        logits = np.asarray(self._predict(self.params, jax.device_put(x)))
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        return Predictions.from_raw(logits, probs)
+
+
+def run_pipeline_cell(
+    pipeline_depth: int = 1,
+    devices: int = 1,
+    *,
+    target_batch: int = 256,
+    n_sessions: int = 1000,
+    windows_per_session: int = 2,
+    tunnel_rtt_ms: float = 30.0,
+    n_runs: int = 3,
+    hidden: int = 256,
+    seed: int = 3,
+) -> dict:
+    """One cell of the pipelined-dispatch grid: drive the standard
+    synthetic fleet load through a FleetServer at the given pipeline
+    depth / device count and report windows/s (median+std over n_runs,
+    after a compile warmup) plus the dispatch-plane stats.
+
+    THE shared measurement behind ``bench.py``'s ``fleet_pipeline_grid``
+    lane — the mesh cell runs in a subprocess with a forced dry-run
+    device count (an in-process force would reshape every OTHER lane's
+    mesh), and sharing this function is what keeps the in-process and
+    subprocess cells comparable.  Raises ValueError when ``devices``
+    exceeds the visible device count.
+    """
+    import jax
+
+    from har_tpu.parallel.mesh import create_mesh
+
+    if devices > len(jax.devices()):
+        raise ValueError(
+            f"cell needs {devices} devices, {len(jax.devices())} visible"
+        )
+    mesh = create_mesh(dp=devices, tp=1) if devices > 1 else None
+    model = JitDemoModel(hidden=hidden, tunnel_rtt_ms=tunnel_rtt_ms)
+    recordings, _ = synthetic_sessions(
+        n_sessions, windows_per_session=windows_per_session, seed=seed
+    )
+
+    def one_run():
+        from har_tpu.serve.engine import FleetConfig, FleetServer
+
+        server = FleetServer(
+            model,
+            window=200,
+            hop=200,
+            smoothing="ema",
+            config=FleetConfig(
+                max_sessions=n_sessions,
+                pipeline_depth=pipeline_depth,
+                target_batch=target_batch,
+            ),
+            mesh=mesh,
+        )
+        for i in range(n_sessions):
+            server.add_session(i)
+        _, report = drive_fleet(server, recordings, seed=seed)
+        return server, report
+
+    one_run()  # warmup: compile the padded programs
+    wps, server = [], None
+    for _ in range(int(n_runs)):
+        server, report = one_run()
+        acct = server.stats.accounting()
+        wps.append(
+            acct["scored"] / report.duration_s if report.duration_s else 0.0
+        )
+    snap = server.stats_snapshot()
+    return {
+        "pipeline_depth": int(pipeline_depth),
+        "devices": int(devices),
+        "target_batch": int(target_batch),
+        "windows_per_sec_median": round(float(np.median(wps)), 1),
+        "windows_per_sec_std": round(float(np.std(wps)), 1),
+        "event_p99_ms_median": snap["stages"]["event_ms"].get("p99_ms"),
+        "overlap_pct": snap["overlap_pct"],
+        "inflight_depth": snap["inflight_depth"],
+        "device_windows": snap["device_windows"],
+        "dispatch_backend": snap["dispatch_backend"],
+        "dispatches": snap["dispatches"],
+        "dropped_windows": snap["accounting"]["dropped"],
+        "accounting_balanced": snap["accounting"]["balanced"],
+    }
+
+
+def run_pipeline_cell_subprocess(
+    pipeline_depth: int,
+    devices: int,
+    kwargs: dict,
+    *,
+    timeout_s: float = 600.0,
+) -> dict:
+    """Run one grid cell in a fresh interpreter with the dry-run device
+    count forced — THE one subprocess wrapper shared by ``bench.py``'s
+    ``fleet_pipeline_grid`` lane and ``scripts/pipeline_grid_bench.py``
+    (an in-process device-count force would reshape the parent's
+    backend for every other lane).  The flag only affects the CPU
+    platform: a host already exposing >= ``devices`` real devices
+    shards those and the force is inert.  Raises on failure or timeout
+    — callers that must survive a dead cell catch and record."""
+    import os
+    import subprocess
+    import sys
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={devices}"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import json; from har_tpu.serve.loadgen import "
+            "run_pipeline_cell; print(json.dumps(run_pipeline_cell("
+            f"{int(pipeline_depth)}, {int(devices)}, **{dict(kwargs)!r})))",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+        env={**os.environ, "XLA_FLAGS": flags},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pipeline grid cell failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    import json
+
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def synthetic_sessions(
     n_sessions: int,
     *,

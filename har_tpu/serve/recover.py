@@ -72,11 +72,9 @@ def _consume_ack(server, sess, ti, ver, shed, probs):
             "double-scored; refusing to recover from this journal"
         )
     sess.pending.popleft()
-    p.dropped = True  # consumed: hide it from the global FIFO
-    p.window = None
-    sess.n_live -= 1
+    # consumed: hide it from the global FIFO and free its arena slot
+    server._release_pending(p)
     sess.n_scored += 1
-    server._n_live -= 1
     server.stats.note_scored(1, ver)
     if shed:
         server.stats.degraded_events += 1
@@ -90,11 +88,8 @@ def _consume_ack(server, sess, ti, ver, shed, probs):
 def _consume_drop(server, sess, ti, reason):
     for p in sess.pending:
         if not p.dropped and p.t_index == ti:
-            p.dropped = True
-            p.window = None
-            sess.n_live -= 1
+            server._release_pending(p)
             sess.n_dropped += 1
-            server._n_live -= 1
             server.stats.drop(1, reason)
             return
     raise RecoveryError(
@@ -111,6 +106,7 @@ def restore_server(
     fault_hook: Callable | None = None,
     journal_config: JournalConfig | None = None,
     reattach: bool = True,
+    mesh=None,
 ):
     """Rebuild a FleetServer from its journal directory.
 
@@ -124,8 +120,16 @@ def restore_server(
     pre-crash pending queue re-enqueued, and (with ``reattach``) a
     fresh journal attached with a recovery-point snapshot — so crashes
     compose: a second kill recovers from the first recovery.
+
+    ``mesh`` — optional device mesh for the recovered server's dispatch
+    plane (runtime resource, never journaled: the process that died may
+    have run on different hardware than the one recovering).
+    ``pipeline_depth`` rides the snapshot's FleetConfig; in-flight
+    tickets are NOT part of any snapshot — a ticket in flight at crash
+    time was un-acked by construction, so its windows recover as
+    pending from the replayed pushes and are simply re-scored.
     """
-    from har_tpu.serve.engine import FleetConfig, FleetServer, _Pending
+    from har_tpu.serve.engine import FleetConfig, FleetServer
 
     state, arrays, records = load_journal(journal_dir)
     geo = state.get("geometry")
@@ -152,6 +156,7 @@ def restore_server(
         fault_hook=fault_hook,
         clock=clock,
         model_version=geo.get("model_version", "v0"),
+        mesh=mesh,
     )
     server._replaying = True
     try:
@@ -186,17 +191,15 @@ def restore_server(
                 (int(v) for v in votes), maxlen=geo["vote_depth"]
             )
         # ---- snapshot: the live queue, original FIFO order -------------
+        # (re-staged into the arena; pre-arena snapshots carry the same
+        # stacked ``pending`` array, so both generations restore here)
         pend_windows = arrays.get("pending")
         for j, (sidx, ti, drift) in enumerate(state.get("pending") or []):
             sess = server._sessions[sess_list[sidx]["sid"]]
-            p = _Pending(
+            server._restore_pending(
                 sess, int(ti),
-                np.array(pend_windows[j], np.float32), bool(drift), now,
+                np.asarray(pend_windows[j], np.float32), bool(drift), now,
             )
-            sess.pending.append(p)
-            server._queue.append(p)
-            sess.n_live += 1
-            server._n_live += 1
         server.recovered_extra = state.get("extra") or {}
         server.recovered_adapt_records = []
 

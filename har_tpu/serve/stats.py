@@ -162,6 +162,15 @@ class FleetStats:
         self.shadow_windows = 0
         self.shadow_errors = 0
         self.scored_by_version: dict[str, int] = {}
+        # pipelined dispatch (har_tpu.serve.dispatch): host-assembly
+        # time that ran UNDER an in-flight device batch, total ticket
+        # in-flight time (launch end → retire fetch done), the in-flight
+        # depth distribution at launch, and windows dispatched per
+        # device (sharded meshes split each padded batch evenly)
+        self.overlap_host_ms = 0.0
+        self.inflight_ms = 0.0
+        self.inflight_depth: dict[int, int] = {}
+        self.device_windows: dict[str, int] = {}
         self.queue_wait = StageHistogram()
         self.dispatch = StageHistogram()
         self.smooth = StageHistogram()
@@ -197,6 +206,23 @@ class FleetStats:
         self.shadow_batches += 1
         self.shadow_windows += n_windows
         self.shadow.record(ms)
+
+    def note_inflight_depth(self, depth: int) -> None:
+        self.inflight_depth[depth] = self.inflight_depth.get(depth, 0) + 1
+
+    def note_device_windows(self, label: str, n: int) -> None:
+        self.device_windows[label] = self.device_windows.get(label, 0) + n
+
+    def overlap_pct(self) -> float | None:
+        """Share of device in-flight time covered by concurrent host
+        assembly — the number the pipeline exists to raise.  None until
+        a pipelined dispatch has flown (depth-1 engines never overlap:
+        the launch that would overlap always finds the pipe empty)."""
+        if self.inflight_ms <= 0.0 or self.overlap_host_ms <= 0.0:
+            return None
+        return round(
+            min(100.0, 100.0 * self.overlap_host_ms / self.inflight_ms), 1
+        )
 
     # ------------------------------------------------------- reporting
 
@@ -257,6 +283,13 @@ class FleetStats:
             "shadow_windows": self.shadow_windows,
             "shadow_errors": self.shadow_errors,
             "scored_by_version": dict(self.scored_by_version),
+            "overlap_pct": self.overlap_pct(),
+            "overlap_host_ms": round(self.overlap_host_ms, 3),
+            "inflight_ms": round(self.inflight_ms, 3),
+            "inflight_depth": {
+                str(k): v for k, v in sorted(self.inflight_depth.items())
+            },
+            "device_windows": dict(self.device_windows),
             "accounting": self.accounting(),
             "stages": {
                 "queue_wait_ms": self.queue_wait.snapshot(),
@@ -289,6 +322,12 @@ class FleetStats:
             "dropped": dict(self.dropped),
             "batch_sizes": {str(k): v for k, v in self.batch_sizes.items()},
             "scored_by_version": dict(self.scored_by_version),
+            "overlap_host_ms": self.overlap_host_ms,
+            "inflight_ms": self.inflight_ms,
+            "inflight_depth": {
+                str(k): v for k, v in self.inflight_depth.items()
+            },
+            "device_windows": dict(self.device_windows),
             "stages": {
                 name: getattr(self, name).state() for name in self._STAGES
             },
@@ -297,11 +336,22 @@ class FleetStats:
     def load_state(self, state: dict) -> None:
         """Restore from ``state()`` output.  Pre-journal state dicts
         missing the newer fields (``lost_in_crash``, ``recoveries``,
-        ``rejected_samples``) load with zero defaults — back-compat is
-        pinned in the test suite."""
+        ``rejected_samples``, and the pre-pipeline overlap/in-flight
+        fields) load with zero defaults — back-compat is pinned in the
+        test suite."""
         for k, v in (state.get("counters") or {}).items():
             if k in self._COUNTERS:
                 setattr(self, k, int(v))
+        self.overlap_host_ms = float(state.get("overlap_host_ms", 0.0))
+        self.inflight_ms = float(state.get("inflight_ms", 0.0))
+        self.inflight_depth = {
+            int(k): int(v)
+            for k, v in (state.get("inflight_depth") or {}).items()
+        }
+        self.device_windows = {
+            str(k): int(v)
+            for k, v in (state.get("device_windows") or {}).items()
+        }
         self.dropped = {
             str(k): int(v) for k, v in (state.get("dropped") or {}).items()
         }

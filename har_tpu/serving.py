@@ -89,15 +89,25 @@ def finite_rows(
     that is 256 sessions' windows dying to one broken sensor.  Rejection
     is per ROW and silent by design (counted, never raised): the
     serving loop must keep serving the finite samples it does get.
+
+    ONE reduction over the pushed block classifies all three failure
+    modes: the per-row abs-max is NaN for any NaN entry, +inf for any
+    ±Inf entry, and > max_abs for an out-of-range one — so a single
+    ``m <= max_abs`` comparison (NaN/Inf both compare False against any
+    finite bound) replaces the separate isfinite + range passes.  The
+    equivalence with the two-pass guard is test-pinned on poisoned
+    streams.
     """
-    bad = ~np.isfinite(samples).all(axis=-1)
-    if max_abs is not None:
-        # NaN compares False everywhere, but isfinite already caught it
-        with np.errstate(invalid="ignore"):
-            bad |= (np.abs(samples) > max_abs).any(axis=-1)
-    n_bad = int(bad.sum())
+    with np.errstate(invalid="ignore"):
+        m = np.abs(samples).max(axis=-1)
+        if max_abs is not None:
+            good = m <= max_abs
+        else:
+            # range check disabled: only NaN/Inf rows are rejected
+            good = np.isfinite(m)
+    n_bad = int(len(good) - good.sum())
     if n_bad:
-        return samples[~bad], n_bad
+        return samples[good], n_bad
     return samples, 0
 
 
@@ -109,6 +119,25 @@ def pad_pow2(windows: np.ndarray) -> np.ndarray:
     silently diverge from the others' compiled-shape budget."""
     k = len(windows)
     pad_k = 1 << (k - 1).bit_length()
+    if pad_k == k:
+        return windows
+    return np.concatenate(
+        [windows, np.repeat(windows[-1:], pad_k - k, axis=0)]
+    )
+
+
+def pad_shard(windows: np.ndarray, shards: int = 1) -> np.ndarray:
+    """Pad a ``(k, ...)`` batch to ``shards × pow2(ceil(k / shards))``
+    rows by repeating the last row — the batch-shape policy of the
+    mesh-sharded dispatch path (har_tpu.serve.dispatch).  The leading
+    dim always divides the shard count (a NamedSharding over the batch
+    axis needs it), and per device count the padded sizes still walk a
+    power-of-two ladder, so at most log2(max_batch)+1 programs compile
+    per device shape — the same compiled-program budget as the
+    single-device ``pad_pow2`` policy (``shards=1`` is exactly it)."""
+    k = len(windows)
+    per = -(-k // shards)  # ceil
+    pad_k = shards * (1 << (per - 1).bit_length())
     if pad_k == k:
         return windows
     return np.concatenate(
@@ -202,20 +231,46 @@ class _WindowAssembler:
         return self._n_seen
 
     def consume(
-        self, samples: np.ndarray
-    ) -> list[tuple[int, np.ndarray, bool]]:
+        self, samples: np.ndarray, sink=None
+    ) -> list[tuple[int, object, bool]]:
         """Absorb ``(n, channels)`` samples; return the ``(t_index,
         window_snapshot, drift)`` tuple for every hop boundary they
-        complete (scoring is the caller's job)."""
+        complete (scoring is the caller's job).
+
+        ``sink`` — optional staging target with ``put(window) -> token``
+        (and optionally ``put_block(windows) -> [token]``): each
+        completed window is written ONCE into the sink's storage and the
+        returned tuples carry the token instead of a fresh array copy.
+        The fleet engine passes its contiguous staging arena here
+        (har_tpu.serve.dispatch.StagingArena), so batch assembly later
+        is a gather out of one preallocated block instead of a stack of
+        per-window allocations.
+
+        When no drift monitor is attached and a chunk completes several
+        windows at once (catch-up bursts, offline replay), the window
+        snapshots are produced VECTORIZED: one strided view over
+        ``ring ++ samples`` and one block copy, instead of a ring roll +
+        copy per hop boundary.  The produced windows are byte-identical
+        to the sequential path's — same stream rows, same dtype — which
+        the equivalence suite pins by construction (chunking never
+        changes events).
+        """
         samples = np.atleast_2d(np.asarray(samples, np.float32))
         if samples.shape[-1] != self.channels:
             raise ValueError(
                 f"expected (n, {self.channels}) samples, got "
                 f"{samples.shape}"
             )
-        pending: list[tuple[int, np.ndarray, bool]] = []
+        pending: list[tuple[int, object, bool]] = []
         pos = 0
         n = len(samples)
+        if self.monitor is None and n:
+            # boundaries this chunk completes: next_emit, next_emit+hop,
+            # ... <= n_seen + n (drift is False for all of them — no
+            # monitor — so per-boundary sequencing has nothing to order)
+            nb = (self._n_seen + n - self._next_emit) // self.hop + 1
+            if nb >= 2:
+                return self._consume_vectorized(samples, nb, sink)
         while pos < n:
             # advance at most to the next emission boundary, so no
             # boundary inside a large chunk is skipped
@@ -241,7 +296,11 @@ class _WindowAssembler:
                 pending.append(
                     (
                         self._n_seen,
-                        self._ring.copy(),
+                        (
+                            self._ring.copy()
+                            if sink is None
+                            else sink.put(self._ring)
+                        ),
                         bool(
                             self.drift_report is not None
                             and self.drift_report.drifting
@@ -249,6 +308,46 @@ class _WindowAssembler:
                     )
                 )
                 self._next_emit += self.hop
+        return pending
+
+    def _consume_vectorized(
+        self, samples: np.ndarray, nb: int, sink
+    ) -> list[tuple[int, object, bool]]:
+        """Multi-boundary fast path (no monitor attached): one strided
+        view over ``ring ++ samples`` yields every completed window, one
+        block copy stages them all.  State updates collapse to closed
+        forms — the final ring is the last ``window`` stream rows either
+        way."""
+        n = len(samples)
+        buf = np.ascontiguousarray(np.concatenate([self._ring, samples]))
+        # buf[i] is stream row (n_seen - window + i); the window ending
+        # at boundary b spans buf[b - n_seen : b - n_seen + window]
+        first = self._next_emit - self._n_seen
+        s0, s1 = buf.strides
+        view = np.lib.stride_tricks.as_strided(
+            buf[first:],
+            shape=(nb, self.window, self.channels),
+            strides=(self.hop * s0, s0, s1),
+            writeable=False,
+        )
+        if sink is None:
+            snaps = list(np.ascontiguousarray(view))
+        elif hasattr(sink, "put_block"):
+            snaps = sink.put_block(view)
+        else:
+            snaps = [sink.put(w) for w in view]
+        t0 = self._next_emit
+        pending = [
+            (t0 + i * self.hop, snap, False)
+            for i, snap in enumerate(snaps)
+        ]
+        self._next_emit = t0 + nb * self.hop
+        self._n_seen += n
+        if n >= self.window:
+            self._ring[:] = samples[-self.window :]
+        else:
+            self._ring[: self.window - n] = self._ring[n:]
+            self._ring[self.window - n :] = samples
         return pending
 
 
@@ -301,6 +400,25 @@ class _Smoother:
             smoothed = probs
             label = raw_label
         return label, raw_label, smoothed
+
+    def update_many(
+        self, probs: np.ndarray
+    ) -> list[tuple[int, int, np.ndarray]]:
+        """Absorb a ``(m, C)`` block of one session's per-window
+        probabilities IN EMISSION ORDER; returns ``step``'s tuple per
+        row.  The fleet engine's retire path calls this once per
+        (session, batch) instead of ``step`` per row: the stateless
+        passthrough mode vectorizes outright (one argmax over the
+        block), while the stateful EMA/vote modes run the SAME
+        sequential recurrence — vectorizing an EMA would re-associate
+        the float chain and break the bit-identity contract with a
+        standalone classifier."""
+        if self.smoothing == "none":
+            raws = probs.argmax(axis=1)
+            return [
+                (int(r), int(r), p) for r, p in zip(raws, probs)
+            ]
+        return [self.step(p) for p in probs]
 
 
 class StreamingClassifier:

@@ -26,7 +26,11 @@ What it does:
      (``har_tpu.serve.slo.fleet_slo_smoke``): N multiplexed sessions
      must emit bit-identical events to N independent classifiers with
      zero dropped windows; a red verdict refuses the snapshot exactly
-     like a red test tier.
+     like a red test tier.  Then the pipelined-dispatch smoke
+     (``fleet_pipeline_smoke``) runs the same load once at depth 1 /
+     one device and once at depth 2 / the forced 8-device dry-run mesh
+     — decision-identical, zero drops, overlap measured — and stamps
+     ``{overlap_pct, devices, p99_ms}`` into the gate log.
   4. Runs the adaptation-loop smoke (``har_tpu.adapt.smoke.adapt_smoke``):
      injected population drift must escalate through the trigger, a
      stub retrain must shadow-pass and hot-swap with ZERO dropped
@@ -102,13 +106,13 @@ def _collect_counts() -> tuple[int, int]:
     return smoke, total
 
 
-def _run_smoke(module: str, func: str) -> dict:
+def _run_smoke(module: str, func: str, extra_env: dict | None = None) -> dict:
     """Run one smoke check (``from {module} import {func}; func()``) in
     a fresh interpreter — the gate's own process must not initialize a
     jax backend — and return its verdict dict.  A crash or unparseable
     output is a red verdict, not a pass.  The one runner for the fleet
-    SLO smoke and the adapt loop smoke, so their plumbing cannot
-    diverge."""
+    SLO smoke, the pipeline smoke and the adapt loop smoke, so their
+    plumbing cannot diverge."""
     proc = subprocess.run(
         [
             sys.executable,
@@ -119,7 +123,11 @@ def _run_smoke(module: str, func: str) -> dict:
         cwd=REPO,
         capture_output=True,
         text=True,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            **(extra_env or {}),
+        },
     )
     if proc.returncode != 0:
         return {
@@ -141,6 +149,25 @@ def _run_smoke(module: str, func: str) -> dict:
 def _fleet_slo() -> dict:
     """Fleet equivalence + SLO smoke verdict."""
     return _run_smoke("har_tpu.serve.slo", "fleet_slo_smoke")
+
+
+def _pipeline_smoke() -> dict:
+    """Pipelined + mesh-sharded dispatch smoke: the same fleet load at
+    depth 1 / one device and depth 2 / the 8-device dry-run mesh must
+    produce identical decision streams with zero drops and measured
+    overlap (har_tpu.serve.slo.fleet_pipeline_smoke).  The dry-run mesh
+    is forced here — the gate must prove the sharded path on every
+    host, not only ones that happen to expose 8 devices."""
+    return _run_smoke(
+        "har_tpu.serve.slo",
+        "fleet_pipeline_smoke",
+        extra_env={
+            "XLA_FLAGS": (
+                __import__("os").environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            )
+        },
+    )
 
 
 def _adapt_smoke() -> dict:
@@ -209,20 +236,23 @@ def main(argv=None) -> int:
 
     suite = None
     fleet = None
+    pipeline = None
     adapt = None
     recovery = None
     if args.counts_only:
-        # carry the previous run's fleet + adapt + recovery verdicts
-        # forward: a counts-only refresh must not blank the serving
-        # evidence the suite's gate-log test pins (only a full gate run
-        # regenerates)
+        # carry the previous run's fleet + pipeline + adapt + recovery
+        # verdicts forward: a counts-only refresh must not blank the
+        # serving evidence the suite's gate-log test pins (only a full
+        # gate run regenerates)
         try:
             prior = json.loads(GATE_LOG.read_text())
             fleet = prior.get("fleet_slo")
+            pipeline = prior.get("fleet_pipeline")
             adapt = prior.get("adapt_smoke")
             recovery = prior.get("recovery_smoke")
         except (OSError, ValueError):
             fleet = None
+            pipeline = None
             adapt = None
             recovery = None
     if not args.counts_only:
@@ -250,6 +280,18 @@ def main(argv=None) -> int:
             print(
                 "\nrelease_gate: RED fleet SLO smoke "
                 f"({json.dumps(fleet)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
+        # pipelined-dispatch gate: depth-2 × dry-run-mesh run must be
+        # decision-identical to the synchronous single-device run, with
+        # zero drops and measured overlap — once at depth 1 and once at
+        # depth 2, stamped {overlap_pct, devices, p99_ms} below
+        pipeline = _pipeline_smoke()
+        if not pipeline.get("ok"):
+            print(
+                "\nrelease_gate: RED fleet pipeline smoke "
+                f"({json.dumps(pipeline)[:300]}) — snapshot refused",
                 file=sys.stderr,
             )
             return 1
@@ -284,6 +326,7 @@ def main(argv=None) -> int:
                 "total_count": total,
                 "suite": suite,
                 "fleet_slo": fleet,
+                "fleet_pipeline": pipeline,
                 "adapt_smoke": adapt,
                 "recovery_smoke": recovery,
                 "git_head": _git_head(),
@@ -301,6 +344,9 @@ def main(argv=None) -> int:
                 "total": total,
                 "suite_rc": None if suite is None else suite["rc"],
                 "fleet_slo_ok": None if fleet is None else fleet["ok"],
+                "fleet_pipeline_ok": (
+                    None if pipeline is None else pipeline["ok"]
+                ),
                 "adapt_smoke_ok": None if adapt is None else adapt["ok"],
                 "recovery_smoke_ok": (
                     None if recovery is None else recovery["ok"]

@@ -64,7 +64,8 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     # every lane must be present (ran or carried a skip/error marker)
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
-        "fleet_serving", "adaptive_serving", "fleet_recovery",
+        "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
+        "fleet_recovery",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -76,6 +77,37 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         assert fleet["dropped_windows"] == 0
         assert "chip_state_probe" in fleet
         assert extra["fleet_event_p99_ms"] == fleet["event_p99_ms_median"]
+    # r10 pipelined-dispatch grid: depth × devices cells over the same
+    # load (1x1 synchronous baseline, 2x1 double-buffered, 2xN mesh-
+    # sharded when >1 device is visible) with the emulated-tunnel RTT
+    # stated, zero drops and balanced accounting per cell; the flat
+    # speedup/overlap keys mirror the mesh cell — or a deadline-skip
+    # marker; never silently absent
+    grid_lane = extra["lanes"]["fleet_pipeline_grid"]
+    if "skipped" not in grid_lane:
+        grid = grid_lane["grid"]
+        assert "1x1" in grid and "2x1" in grid
+        assert grid_lane["emulated_tunnel_rtt_ms"] > 0
+        for cell in grid.values():
+            if "error" in cell:  # mesh subprocess may fail; loudly
+                continue
+            assert cell["dropped_windows"] == 0
+            assert cell["accounting_balanced"] is True
+            assert cell["windows_per_sec_median"] > 0
+        assert grid["1x1"]["pipeline_depth"] == 1
+        mesh_cell = grid[grid_lane["mesh_cell"]]
+        if mesh_cell["devices"] > 1:
+            assert mesh_cell["dispatch_backend"] == "sharded"
+            assert mesh_cell["overlap_pct"] is not None
+            assert (
+                extra["fleet_pipeline_overlap_pct"]
+                == mesh_cell["overlap_pct"]
+            )
+        assert (
+            extra["fleet_pipeline_speedup"]
+            == grid_lane["speedup_vs_sync_single"]
+        )
+        assert "chip_state_probe" in grid_lane
     # r8 adaptive-serving lane: the fleet numbers across a forced
     # mid-run hot-swap — zero drops and the swap contract, or a
     # deadline-skip marker; never silently absent

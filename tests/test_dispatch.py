@@ -1,0 +1,425 @@
+"""Pipelined + mesh-sharded fleet dispatch (har_tpu.serve.dispatch).
+
+Pins the contracts the dispatch-plane overhaul ships on:
+
+  1. bit-identity — a pipelined (depth 2) fleet emits the EXACT event
+     stream a synchronous (depth 1) fleet emits at N=64 under the
+     FakeClock + DispatchFaults harness: same decisions, same
+     probabilities, same per-session order (strict FIFO retire);
+  2. sharded scoring — a >1-device mesh scores the same decisions as a
+     single device (labels/raw labels/drift bit-equal; probabilities to
+     1e-6 — GSPMD re-tiles the matmul, the same reduction-order drift
+     the tp-vs-single training pin documents), under the devices × pow2
+     pad policy with the log2-bounded compiled-program budget;
+  3. the staging arena — windows staged once at enqueue, batch assembly
+     by gather, slots recycled, snapshot format unchanged;
+  4. vectorized host data plane — single-pass ingest guard equivalent
+     to the two-pass reference on poisoned streams, batched smoother
+     equivalent to step-by-step;
+  5. sharding-honest device calibration — calibrate_device measures the
+     padded shapes the sharded path actually emits.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.serve import (
+    DispatchFaults,
+    FakeClock,
+    FleetConfig,
+    FleetServer,
+    JitDemoModel,
+    StagingArena,
+    drive_fleet,
+    make_scorer,
+    synthetic_sessions,
+)
+from har_tpu.serve.dispatch import DeviceScorer, HostScorer, ShardedScorer
+from har_tpu.serving import _Smoother, finite_rows, pad_pow2, pad_shard
+
+
+class _StubModel:
+    """Host-side deterministic stand-in (row-independent numpy)."""
+
+    num_classes = 3
+
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x)
+        m = x.mean(axis=(1, 2))
+        raw = np.stack([-m, m, np.zeros_like(m)], axis=-1)
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return Predictions.from_raw(raw, e / e.sum(axis=-1, keepdims=True))
+
+
+def _recordings(n, n_samples=450, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.normal(size=(n_samples, 3)).astype(np.float32)
+        for _ in range(n)
+    ]
+
+
+def _decisions(events):
+    """Per-session decision-field sequences (latency excluded)."""
+    out = {}
+    for fe in events:
+        ev = fe.event
+        out.setdefault(fe.session_id, []).append(
+            (ev.t_index, ev.label, ev.raw_label, ev.drift,
+             ev.probability.tobytes())
+        )
+    return out
+
+
+def _mesh(n=8):
+    import jax
+
+    from har_tpu.parallel.mesh import create_mesh
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (dry-run mesh)")
+    return create_mesh(dp=n, tp=1)
+
+
+# ------------------------------------------------------- bit-identity
+
+
+@pytest.mark.parametrize("smoothing", ["ema", "vote"])
+def test_pipelined_bit_identical_to_synchronous_n64(smoothing):
+    """THE pipelining pin: depth 2 vs depth 1 at N=64 under FakeClock +
+    DispatchFaults (stalls on the fake clock + transient failures
+    absorbed by the retry path) — event streams identical per session,
+    bitwise, because retire order is strictly FIFO."""
+    n = 64
+    recs = _recordings(n, n_samples=600, seed=11)
+
+    def run(depth):
+        clock = FakeClock()
+        server = FleetServer(
+            _StubModel(), window=100, hop=50, smoothing=smoothing,
+            config=FleetConfig(
+                max_sessions=n, target_batch=32, max_delay_ms=0.0,
+                retries=1, pipeline_depth=depth,
+            ),
+            fault_hook=DispatchFaults(
+                stall_every=3, stall_ms=1.0, fail_every=5,
+                fake_clock=clock,
+            ),
+            clock=clock,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events = []
+        cursors = [0] * n
+        rng = np.random.default_rng(7)
+        while any(c < len(recs[i]) for i, c in enumerate(cursors)):
+            for i in range(n):
+                if cursors[i] >= len(recs[i]):
+                    continue
+                step = int(rng.integers(20, 120))
+                server.push(i, recs[i][cursors[i]: cursors[i] + step])
+                cursors[i] += step
+            events.extend(server.poll(force=True))
+            clock.advance(0.01)
+        events.extend(server.flush())
+        return server, events
+
+    s1, ev1 = run(1)
+    s2, ev2 = run(2)
+    d1, d2 = _decisions(ev1), _decisions(ev2)
+    assert d1.keys() == d2.keys()
+    for sid in d1:
+        assert d1[sid] == d2[sid]
+    # same totals, same accounting, both balanced
+    for s in (s1, s2):
+        acct = s.stats.accounting()
+        assert acct["balanced"] and acct["pending"] == 0
+    assert s1.stats.scored == s2.stats.scored
+    # the depth-2 run genuinely pipelined (tickets stacked ≥2 deep)
+    assert max(s2.stats.inflight_depth) >= 2
+    assert max(s1.stats.inflight_depth) == 1
+
+
+def test_carried_ticket_retires_on_next_poll():
+    """With pipeline_depth 2, an unforced poll leaves the last launched
+    ticket in flight (the device crunches through the next delivery
+    round); its events arrive with the next poll, FIFO-intact, and
+    flush() always drains."""
+    clock = FakeClock()
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(
+            target_batch=4, max_delay_ms=0.0, pipeline_depth=2,
+        ),
+        clock=clock,
+    )
+    server.add_session(0)
+    server.push(0, np.zeros((10 * 8, 3), np.float32))  # 8 windows due
+    ev1 = server.poll()
+    # two batches of 4: the first retires in-poll, the second carries
+    assert len(ev1) == 4
+    acct = server.stats.accounting()
+    assert acct["pending"] == 4  # carried ticket windows: un-acked
+    ev2 = server.poll()  # nothing new due — retires the carried ticket
+    assert len(ev2) == 4
+    assert [e.event.t_index for e in ev1 + ev2] == [
+        10 * (i + 1) for i in range(8)
+    ]
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+    assert server.flush() == []
+
+
+# ---------------------------------------------------- sharded scoring
+
+
+def test_sharded_scoring_matches_single_device_and_program_budget():
+    """Mesh-sharded dispatch: decisions equal the single-device run's
+    (probs to 1e-6 — GSPMD re-tiling drift), batches pad to devices ×
+    pow2, and the compiled-program count stays log2-bounded."""
+    mesh = _mesh(8)
+    n = 48
+    model = JitDemoModel()
+    recordings, _ = synthetic_sessions(n, windows_per_session=2, seed=5)
+
+    def run(m):
+        server = FleetServer(
+            model, window=200, hop=200, smoothing="ema",
+            config=FleetConfig(max_sessions=n, target_batch=64),
+            mesh=m,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, _ = drive_fleet(server, recordings, seed=5)
+        return server, events
+
+    s1, ev1 = run(None)
+    s8, ev8 = run(mesh)
+    assert isinstance(s8.scorer, ShardedScorer)
+    assert s8.scorer.devices == 8
+    d1, d8 = _decisions(ev1), _decisions(ev8)
+    assert d1.keys() == d8.keys()
+    for sid in d1:
+        a, b = d1[sid], d8[sid]
+        assert [x[:4] for x in a] == [y[:4] for y in b]  # labels/drift
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(
+                np.frombuffer(x[4]), np.frombuffer(y[4]), atol=1e-6
+            )
+    # pad policy: every dispatched shape divides the device count and
+    # walks a pow2-per-device ladder; program budget stays log2-bounded
+    target = 64
+    budget = int(np.log2(target)) + 1
+    for shape in s8.scorer.compiled_shapes:
+        assert shape % 8 == 0
+    assert len(s8.scorer.compiled_shapes) <= budget
+    programs = s8.scorer.program_count()
+    if programs is not None:
+        # the jit cache may also hold the single-device warmup program
+        assert programs <= budget + len(s1.scorer.compiled_shapes)
+    # every device saw the same window share, stamped in the stats
+    dw = s8.stats.device_windows
+    assert len(dw) == 8 and len(set(dw.values())) == 1
+
+
+def test_pad_shard_policy():
+    for k, shards, want in (
+        (5, 8, 8), (8, 8, 8), (9, 8, 16), (17, 8, 32), (100, 8, 128),
+        (5, 1, 8), (6, 2, 8),
+    ):
+        got = pad_shard(np.zeros((k, 2), np.float32), shards)
+        assert len(got) == want, (k, shards)
+        assert len(got) % shards == 0
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    np.testing.assert_array_equal(pad_shard(x, 1), pad_pow2(x))
+    # padding repeats the last row
+    np.testing.assert_array_equal(pad_shard(x, 8)[5:], np.tile(x[-1:], (3, 1)))
+
+
+def test_scorer_selection_policy():
+    mesh = _mesh(8)
+    assert isinstance(make_scorer(_StubModel(), None), HostScorer)
+    # host models cannot shard — fall back, never crash
+    assert isinstance(make_scorer(_StubModel(), mesh), HostScorer)
+    jit_model = JitDemoModel()
+    assert isinstance(make_scorer(jit_model, None), DeviceScorer)
+    sharded = make_scorer(jit_model, mesh)
+    assert isinstance(sharded, ShardedScorer)
+
+
+def test_async_device_scorer_matches_transform():
+    """DeviceScorer launch+fetch == model.transform bitwise (same ops,
+    same order) — what makes pipelined serving of a jitted model
+    bit-identical to the synchronous engine."""
+    model = JitDemoModel()
+    scorer = make_scorer(model, None)
+    x = np.random.default_rng(3).normal(
+        size=(16, 200, 3)
+    ).astype(np.float32)
+    got = scorer.fetch(scorer.launch(x), 16)
+    want = np.asarray(model.transform(x).probability[:16], np.float64)
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------- staging arena
+
+
+def test_arena_stage_gather_recycle_grow():
+    arena = StagingArena(4, 2, capacity=8)
+    rng = np.random.default_rng(0)
+    wins = rng.normal(size=(30, 4, 2)).astype(np.float32)
+    slots = [arena.put(w) for w in wins[:8]]
+    assert arena.in_use == 8
+    np.testing.assert_array_equal(arena.gather(slots), wins[:8])
+    # grow on demand, previous contents intact
+    more = arena.put_block(wins[8:20])
+    assert arena.grows >= 1
+    np.testing.assert_array_equal(arena.gather(slots), wins[:8])
+    np.testing.assert_array_equal(arena.gather(more), wins[8:20])
+    # recycle: freed slots are reused, not leaked
+    for s in slots:
+        arena.free(s)
+    cap_before = arena.capacity
+    reused = [arena.put(w) for w in wins[20:28]]
+    assert arena.capacity == cap_before
+    np.testing.assert_array_equal(arena.gather(reused), wins[20:28])
+    st = arena.state()
+    assert st["capacity"] == arena.capacity and st["in_use"] == arena.in_use
+
+
+def test_fleet_snapshot_format_unchanged_by_arena(tmp_path):
+    """The arena is process-local staging: snapshots still carry the
+    stacked ``pending`` array (gathered at snapshot time), so the
+    on-disk format is what PR-4 wrote."""
+    from har_tpu.serve.journal import load_journal
+
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=64, max_delay_ms=1e9),
+        journal=str(tmp_path / "j"),
+    )
+    server.add_session("a")
+    server.push("a", np.ones((10 * 3, 3), np.float32))  # 3 pending
+    server.write_snapshot()
+    state, arrays, _records = load_journal(str(tmp_path / "j"))
+    assert arrays["pending"].shape == (3, 10, 3)
+    np.testing.assert_array_equal(
+        arrays["pending"], np.ones((3, 10, 3), np.float32)
+    )
+    assert [m[1] for m in state["pending"]] == [10, 20, 30]
+    # arena sizing rides the provider hook (observability only)
+    assert "staging_arena" in state["extra"]
+
+
+# ------------------------------------------- vectorized host data plane
+
+
+def test_finite_rows_single_pass_equivalent_on_poisoned_streams():
+    """The one-reduction guard classifies NaN / ±Inf / out-of-range rows
+    exactly like the two-pass reference, for every max_abs mode."""
+    rng = np.random.default_rng(42)
+    for _ in range(30):
+        x = rng.normal(size=(50, 3)).astype(np.float32) * 10
+        for _ in range(8):
+            r, c = rng.integers(0, 50), rng.integers(0, 3)
+            x[r, c] = rng.choice(
+                np.asarray([np.nan, np.inf, -np.inf, 5e6, -7e6, 0.5],
+                           np.float32)
+            )
+        for max_abs in (1e6, 100.0, None):
+            bad = ~np.isfinite(x).all(axis=-1)
+            if max_abs is not None:
+                with np.errstate(invalid="ignore"):
+                    bad |= (np.abs(x) > max_abs).any(axis=-1)
+            got, n_bad = finite_rows(x, max_abs)
+            assert n_bad == int(bad.sum())
+            np.testing.assert_array_equal(got, x[~bad])
+
+
+@pytest.mark.parametrize("mode", ["ema", "vote", "none"])
+def test_smoother_update_many_equals_step(mode):
+    rng = np.random.default_rng(9)
+    probs = rng.random(size=(40, 5))
+    probs /= probs.sum(axis=1, keepdims=True)
+    a = _Smoother(mode, 0.4, 5)
+    b = _Smoother(mode, 0.4, 5)
+    many = a.update_many(probs)
+    one = [b.step(p) for p in probs]
+    for (l1, r1, d1), (l2, r2, d2) in zip(many, one):
+        assert l1 == l2 and r1 == r2
+        np.testing.assert_array_equal(d1, d2)
+
+
+def test_assembler_vectorized_burst_equals_sequential_chunks():
+    """One whole-recording push (vectorized strided path) produces the
+    same windows, ring state and t_indices as sample-dribble pushes."""
+    from har_tpu.serving import _WindowAssembler
+
+    rng = np.random.default_rng(4)
+    stream = rng.normal(size=(977, 3)).astype(np.float32)
+    for window, hop in ((100, 40), (64, 64), (50, 7)):
+        burst = _WindowAssembler(window, hop, 3)
+        drip = _WindowAssembler(window, hop, 3)
+        got = burst.consume(stream)
+        want = []
+        for s in range(0, len(stream), 13):
+            want.extend(drip.consume(stream[s: s + 13]))
+        assert [t for t, _, _ in got] == [t for t, _, _ in want]
+        for (_, wa, da), (_, wb, db) in zip(got, want):
+            assert da == db
+            np.testing.assert_array_equal(wa, wb)
+        np.testing.assert_array_equal(burst._ring, drip._ring)
+
+
+# -------------------------------------- sharding-honest calibration
+
+
+def test_calibrate_device_measures_sharded_emitted_shapes():
+    """Satellite pin: under a mesh, calibrate_device rounds every size
+    through the devices × pow2 policy and measures the SHARDED program,
+    so events' device_ms keys match the dispatched padded shapes."""
+    mesh = _mesh(8)
+    n = 20
+    model = JitDemoModel()
+    server = FleetServer(
+        model, window=200, hop=200, smoothing="none",
+        config=FleetConfig(max_sessions=n, target_batch=64),
+        mesh=mesh,
+    )
+    recordings, _ = synthetic_sessions(n, windows_per_session=1, seed=1)
+    for i in range(n):
+        server.add_session(i)
+    events, _ = drive_fleet(server, recordings, seed=1)
+    # 20 windows pad to 24? no: devices x pow2 → 8 * pow2(ceil(20/8)=3→4) = 32
+    assert set(server.stats.batch_sizes) == {32}
+    cal = server.calibrate_device(iters=2)
+    # keys are the EMITTED ladder: smallest shard shape + what flew
+    assert 32 in cal and 8 in cal
+    assert all(b % 8 == 0 for b in cal)
+    # a post-calibration dispatch stamps device_ms from the 32-row
+    # sharded measurement
+    for i in range(n):
+        server.push(i, recordings[i])
+    events = server.flush()
+    assert events and all(
+        e.event.device_ms is not None for e in events
+    )
+    want_share = round(cal[32]["p50_ms"] / 20, 4)
+    assert events[0].event.device_ms == want_share
+
+
+def test_calibrate_device_host_stub_still_raises():
+    server = FleetServer(_StubModel(), window=10, hop=10)
+    with pytest.raises(ValueError):
+        server.calibrate_device()
+
+
+# ------------------------------------------------- config validation
+
+
+def test_pipeline_depth_validated():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        FleetConfig(pipeline_depth=0)
+    assert FleetConfig(pipeline_depth=2).pipeline_depth == 2

@@ -558,6 +558,41 @@ def test_cli_serve_thousand_sessions(capsys):
     assert out["windows_per_sec"] > 0
 
 
+def test_cli_serve_pipeline_depth_and_mesh(capsys):
+    """`har serve --pipeline-depth 2 --mesh 8`: pipelined, mesh-aware
+    serving from the CLI — zero drops, every window scored once, and
+    the pipeline fields surfaced in the summary.  (The analytic demo
+    model is host-side, so the dispatch backend falls back to host
+    scoring — the flags must still be honored, not crash.)"""
+    import json
+
+    import jax
+
+    from har_tpu.cli import main
+
+    if len(jax.devices()) < 8:
+        import pytest as _pytest
+
+        _pytest.skip("needs the 8-device dry-run mesh")
+    rc = main(
+        ["serve", "--sessions", "64", "--pipeline-depth", "2",
+         "--mesh", "8"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pipeline_depth"] == 2
+    assert out["dropped"] == 0
+    assert out["scored"] == out["enqueued"]
+    assert out["stats"]["accounting"]["balanced"]
+
+
+def test_cli_serve_mesh_exceeding_devices_exits_with_hint():
+    from har_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="xla_force_host_platform"):
+        main(["serve", "--sessions", "2", "--mesh", "4096"])
+
+
 def test_cli_serve_honors_checkpoint_geometry(tmp_path, capsys):
     """serve --checkpoint adopts the checkpoint's recorded input_shape
     (the from_checkpoint guard, fleet edition): a 128-sample-window

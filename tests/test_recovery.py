@@ -297,11 +297,25 @@ def test_engine_kill_point_resolves_half_finished_transition(point):
     assert out["accounting"]["balanced"]
 
 
+@pytest.mark.parametrize("point", KILL_POINTS)
+def test_kill_point_matrix_holds_at_pipeline_depth_2(point):
+    """The pipelining acceptance pin: the FULL matrix re-runs with
+    pipeline_depth=2 — tickets genuinely in flight at the kill instant
+    (mid_launch / pre_retire especially) — and the contract must hold
+    unchanged, because an in-flight ticket is un-acked by construction
+    and its windows recover as pending from the replayed pushes."""
+    out = run_kill_point(point, sessions=6, seed=3, pipeline_depth=2)
+    assert out["ok"], out
+    assert out["windows_lost"] == 0
+    assert out["accounting"]["balanced"]
+    assert out["accounting"]["pending"] == 0
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_randomized_kill_point_property(seed):
     """Seed-randomized draw over (kill point, occurrence, flush
-    batching, snapshot cadence, fleet size): the recovery contract is a
-    property, not a fixture."""
+    batching, snapshot cadence, pipeline depth, fleet size): the
+    recovery contract is a property, not a fixture."""
     out = run_random_kill(seed)
     assert out["ok"], out
     assert out["windows_lost"] == 0
@@ -534,6 +548,61 @@ def test_fresh_attach_refuses_existing_journal(tmp_path):
 # -------------------------------------------- back-compat (pre-journal)
 
 
+def test_pre_pipeline_journal_restores_cleanly(tmp_path):
+    """Back-compat pin (next to the PR-4 pins): a journal written
+    BEFORE the pipelined dispatch plane existed — no ``staging_arena``
+    extra, no ``pipeline_depth`` in the config block, no overlap/
+    in-flight stats fields, pending windows as the plain stacked array
+    — restores through today's code with the arena rebuilt
+    transparently and pipeline_depth defaulting to the synchronous 1."""
+    root = str(tmp_path / "old")
+    j = FleetJournal(root, JournalConfig(flush_every=1, snapshot_every=0))
+    rng = np.random.default_rng(0)
+    pend = rng.normal(size=(2, 100, 3)).astype(np.float32)
+    state = {
+        "geometry": {
+            "window": 100, "hop": 100, "channels": 3,
+            "smoothing": "ema", "ema_alpha": 0.4, "vote_depth": 5,
+            "class_names": None, "model_version": "v0",
+        },
+        # exactly what PR-4's dataclasses.asdict produced: no
+        # pipeline_depth key at all
+        "config": {"max_sessions": 8, "target_batch": 32},
+        "ladder": {
+            "smoothing_shed": False, "breaches": 0, "ok_streak": 0,
+        },
+        "stats": {"counters": {"enqueued": 2}},
+        "sessions": [
+            {
+                "sid": 0, "n_seen": 200, "raw_seen": 200,
+                "next_emit": 300, "n_enqueued": 2, "n_scored": 0,
+                "n_dropped": 0, "votes": [], "monitor": None,
+            }
+        ],
+        "pending": [[0, 100, False], [0, 200, False]],
+        "extra": {},  # no staging_arena record, no in-flight tickets
+    }
+    j.write_snapshot(
+        state,
+        {"ring0": np.zeros((100, 3), np.float32), "pending": pend},
+    )
+    j.close()
+    restored = FleetServer.restore(root, _StubModel(), reattach=False)
+    assert restored.config.pipeline_depth == 1
+    assert restored.stats.overlap_host_ms == 0.0
+    acct = restored.stats.accounting()
+    assert acct["pending"] == 2
+    events = restored.flush()
+    assert [e.event.t_index for e in events] == [100, 200]
+    # the recovered windows scored from the re-staged arena slots are
+    # the snapshot's bytes exactly
+    want = _StubModel().transform(pend).probability
+    got = np.stack([e.event.probability for e in events])
+    np.testing.assert_array_equal(got[0], want[0])
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
 def test_stats_state_roundtrip_and_pre_journal_defaults():
     """FleetStats.state()/load_state round-trips, and a pre-journal
     state dict (no lost_in_crash / recoveries / rejected_samples)
@@ -546,6 +615,10 @@ def test_stats_state_roundtrip_and_pre_journal_defaults():
     s.rejected_samples = 2
     s.lost_in_crash = 0
     s.dispatch.record(1.5)
+    s.overlap_host_ms = 12.5
+    s.inflight_ms = 40.0
+    s.note_inflight_depth(2)
+    s.note_device_windows("0", 16)
     state = s.state()
     s2 = FleetStats()
     s2.load_state(json.loads(json.dumps(state)))  # via JSON, like disk
@@ -554,16 +627,27 @@ def test_stats_state_roundtrip_and_pre_journal_defaults():
     assert s2.dropped == {"backpressure": 3}
     assert s2.rejected_samples == 2
     assert s2.dispatch.count == 1
+    assert s2.overlap_host_ms == 12.5 and s2.inflight_ms == 40.0
+    assert s2.inflight_depth == {2: 1}
+    assert s2.device_windows == {"0": 16}
     assert s2.accounting() == s.accounting()
-    # pre-journal dict: the new fields absent entirely
+    # pre-journal dict: the new fields absent entirely (a PRE-PIPELINE
+    # state also lacks the overlap/in-flight fields — zero defaults)
     old = json.loads(json.dumps(state))
     for key in ("lost_in_crash", "recoveries", "rejected_samples"):
         old["counters"].pop(key, None)
+    for key in (
+        "overlap_host_ms", "inflight_ms", "inflight_depth",
+        "device_windows",
+    ):
+        old.pop(key, None)
     s3 = FleetStats()
     s3.load_state(old)
     assert s3.lost_in_crash == 0
     assert s3.recoveries == 0
     assert s3.rejected_samples == 0
+    assert s3.overlap_host_ms == 0.0 and s3.inflight_ms == 0.0
+    assert s3.inflight_depth == {} and s3.device_windows == {}
     assert s3.accounting()["balanced"]
     h = StageHistogram()
     h.load_state({})  # empty pre-journal histogram state

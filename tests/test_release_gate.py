@@ -60,6 +60,30 @@ def test_gate_log_carries_fleet_slo_verdict():
     assert fleet["dropped"] == 0
 
 
+def test_gate_log_carries_fleet_pipeline_verdict():
+    """The pipelined-dispatch counterpart of the fleet verdict: the
+    gate log must carry a green depth-1-vs-depth-2 pipeline check with
+    the {overlap_pct, devices, p99_ms} keys it stamps — the same load
+    once synchronous, once pipelined over the dry-run mesh, decision
+    streams identical, overlap measured."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    pipe = log.get("fleet_pipeline")
+    assert pipe, (
+        "artifacts/test_gate.json lacks the fleet_pipeline verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in ("overlap_pct", "devices", "p99_ms"):
+        assert key in pipe
+    assert pipe["ok"] is True
+    assert pipe["equivalent"] is True
+    assert pipe["dropped"] == 0
+    assert pipe["overlap_pct"] is not None
+    assert pipe["devices"] >= 1
+    assert pipe["pipeline_depth"] >= 2
+
+
 def test_gate_log_carries_adapt_smoke_verdict():
     """The adaptation counterpart of the fleet verdict: the gate log
     must carry a green drift→retrain→shadow→swap loop check with the
