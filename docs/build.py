@@ -43,6 +43,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <a href="serving.html">serving</a> ·
 <a href="adaptation.html">adaptation</a> ·
 <a href="recovery.html">recovery</a> ·
+<a href="static_analysis.html">harlint</a> ·
 <a href="api.html">api</a></nav>
 {body}
 </body>
@@ -68,7 +69,7 @@ def build() -> list[str]:
         # README.md) have no HTML export and must stay as written
         body = re.sub(
             r'href="(index|architecture|parallelism|serving|adaptation'
-            r'|recovery|api|roofline|bilstm_profile)\.md"',
+            r'|recovery|static_analysis|api|roofline|bilstm_profile)\.md"',
             r'href="\1.html"',
             body,
         )

@@ -18,9 +18,16 @@ computations sharded over the device mesh.
 
 __version__ = "0.1.0"
 
-import jax as _jax
+try:
+    import jax as _jax
+except ImportError:  # pragma: no cover - jax-less environments
+    # the shim below is moot without jax, and the jax-free surfaces
+    # (`har lint` / har_tpu.analyze, the config dataclasses) must stay
+    # importable — anything that actually needs jax fails at its own
+    # import with the real error
+    _jax = None
 
-if not hasattr(_jax, "shard_map"):
+if _jax is not None and not hasattr(_jax, "shard_map"):
     # Older jax (< 0.5): shard_map lives in jax.experimental and the
     # replication-check kwarg is named check_rep, not check_vma.  The
     # codebase targets the new spelling; shim the old runtime up to it

@@ -175,8 +175,14 @@ class ModelRegistry:
                 "metrics": dict(metrics or {}),
                 "note": note,
             }
-            with open(os.path.join(path, _META), "w") as f:
-                json.dump(meta, f, indent=1)
+            # the same fsync discipline as CURRENT/NEXT_ID (harlint
+            # HL005): a bare buffered write could leave a promoted
+            # version with a torn registry.json after power loss —
+            # _load_version would return None and current() would
+            # blind the whole lineage chain
+            _atomic_write(
+                os.path.join(path, _META), json.dumps(meta, indent=1)
+            )
         except BaseException:
             shutil.rmtree(path, ignore_errors=True)  # no half-versions
             raise

@@ -1,0 +1,190 @@
+"""harlint — AST-based invariant checker for the fleet serving stack.
+
+Five bespoke rules over ``har_tpu/serve`` + ``har_tpu/adapt`` (plus the
+shared ``serving.py``/``utils/durable.py`` they ride on), each encoding
+an invariant that has already cost a shipped bug or a hand-fought PR:
+
+  HL001  hot-path host-sync      no ``.item()``/``device_get``/
+                                 ``block_until_ready``/host
+                                 materialization on the dispatch launch
+                                 path or inside ``@jit`` bodies;
+                                 retire-side fetches are the one
+                                 allowed sink (``# harlint: fetch-ok``)
+  HL002  state completeness      every public field a snapshotted class
+                                 assigns in ``__init__`` round-trips
+                                 ``state()``/``load_state()``
+  HL003  journal exhaustiveness  record types ↔ replay handlers ↔
+                                 chaos kill points stay in bijection
+  HL004  determinism             no wall clocks, global RNGs, or
+                                 set-order iteration where bit-identity
+                                 pins live
+  HL005  durability              registry/journal writes never bypass
+                                 the utils/durable fsync discipline
+
+Run it as ``har lint`` (text or ``--json``), or from code via
+``run_harlint``.  The committed ``harlint_baseline.json`` suppresses
+reviewed pre-existing debt; the release gate fails on any non-baselined
+finding.  See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+from har_tpu.analyze.baseline import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from har_tpu.analyze.core import (
+    DEFAULT_FILESET,
+    FileContext,
+    Finding,
+    Rule,
+    load_contexts,
+    run_rules,
+)
+from har_tpu.analyze.determinism import DeterminismRule
+from har_tpu.analyze.durability import DurabilityRule
+from har_tpu.analyze.hotpath import HotPathRule
+from har_tpu.analyze.journalcheck import JournalExhaustivenessRule
+from har_tpu.analyze.statecheck import StateCompletenessRule
+
+
+def default_rules() -> list[Rule]:
+    return [
+        HotPathRule(),
+        StateCompletenessRule(),
+        JournalExhaustivenessRule(),
+        DeterminismRule(),
+        DurabilityRule(),
+    ]
+
+
+def repo_root() -> Path:
+    """The checkout root: the directory holding the ``har_tpu``
+    package (where the baseline file and the fileset paths resolve)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+@dataclasses.dataclass
+class LintReport:
+    """One harlint run: fresh findings, suppression accounting, and the
+    JSON shape the release gate stamps into artifacts/test_gate.json."""
+
+    findings: list[Finding]  # non-baselined — what fails the gate
+    baselined: int
+    annotation_suppressed: int
+    rules_run: list[str]
+    files: int
+    baseline_path: str
+    baseline_size: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    @property
+    def suppressed(self) -> int:
+        return self.baselined + self.annotation_suppressed
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "rules_run": self.rules_run,
+            "files": self.files,
+            "findings": len(self.findings),
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "annotation_suppressed": self.annotation_suppressed,
+            "baseline": self.baseline_path,
+            "baseline_size": self.baseline_size,
+            "findings_list": [
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "symbol": f.symbol,
+                    "message": f.message,
+                }
+                for f in self.findings
+            ],
+        }
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"harlint: {len(self.rules_run)} rules over {self.files} "
+            f"files — {len(self.findings)} finding(s), "
+            f"{self.suppressed} suppressed "
+            f"({self.baselined} baseline, "
+            f"{self.annotation_suppressed} annotations)"
+        )
+        return "\n".join(lines)
+
+
+def lint_sources(
+    sources: dict[str, str], rules: list[Rule] | None = None
+) -> list[Finding]:
+    """Run the rules over in-memory ``{repo-relative-path: source}``
+    pairs — the fixture-test entry point (each rule's positive and
+    negative snippets are pinned through this)."""
+    ctxs = [FileContext(rel, src) for rel, src in sorted(sources.items())]
+    findings, _ = run_rules(ctxs, rules or default_rules())
+    return findings
+
+
+def run_harlint(
+    root: Path | str | None = None,
+    paths=None,
+    baseline: Path | str | None = None,
+    update_baseline: bool = False,
+    rules: list[Rule] | None = None,
+) -> LintReport:
+    """Lint the checkout: load the fileset, run the rules, apply the
+    committed baseline.  ``update_baseline=True`` rewrites the baseline
+    to the current findings (they then report as baselined)."""
+    root = Path(root) if root is not None else repo_root()
+    baseline_path = (
+        Path(baseline) if baseline is not None else root / DEFAULT_BASELINE
+    )
+    rules = rules or default_rules()
+    ctxs = load_contexts(root, paths)
+    findings, stats = run_rules(ctxs, rules)
+    if update_baseline:
+        # scope the rewrite to the files this run actually examined:
+        # a subset run must not retire other files' reviewed entries
+        write_baseline(
+            baseline_path, findings, linted_files={c.rel for c in ctxs}
+        )
+    known = load_baseline(baseline_path)
+    fresh, baselined = apply_baseline(findings, known)
+    try:
+        # repo-relative in reports: the gate log is a committed
+        # artifact and must not carry machine-specific paths
+        baseline_label = str(baseline_path.relative_to(root))
+    except ValueError:
+        baseline_label = str(baseline_path)
+    return LintReport(
+        findings=fresh,
+        baselined=baselined,
+        annotation_suppressed=stats.annotation_suppressed,
+        rules_run=stats.rules_run,
+        files=stats.files,
+        baseline_path=baseline_label,
+        baseline_size=len(known),
+    )
+
+
+__all__ = [
+    "DEFAULT_FILESET",
+    "Finding",
+    "LintReport",
+    "Rule",
+    "default_rules",
+    "lint_sources",
+    "repo_root",
+    "run_harlint",
+]

@@ -1,0 +1,78 @@
+"""harlint baseline: committed suppression file for pre-existing debt.
+
+The gate fails on any NON-baselined finding, so new violations can
+never land — while debt that predates a rule is recorded (reviewed,
+visible, diffable in the PR that admits it) instead of blocking the
+gate forever.  Entries are line-number independent (``Finding.key``):
+``rule|path|symbol|normalized-snippet`` — moving code around does not
+churn the file; changing or fixing the flagged line retires the entry.
+
+The committed file is expected to stay near-empty: every rule ships
+with its real findings fixed at introduction time, and
+``har lint --update-baseline`` exists for the rare reviewed exception,
+not as a pressure valve.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from har_tpu.analyze.core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "harlint_baseline.json"
+
+
+def load_baseline(path: Path) -> set[str]:
+    """The committed suppression keys (empty set when the file does not
+    exist — a missing baseline suppresses nothing)."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return set()
+    return set(data.get("entries") or [])
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: set[str]
+) -> tuple[list[Finding], int]:
+    """Split findings into (fresh, n_baselined)."""
+    fresh = [f for f in findings if f.key() not in baseline]
+    return fresh, len(findings) - len(fresh)
+
+
+def entry_path(entry: str) -> str:
+    """The repo-relative file a baseline entry refers to (field 2 of
+    ``rule|path|symbol|snippet``)."""
+    parts = entry.split("|", 2)
+    return parts[1] if len(parts) > 1 else ""
+
+
+def write_baseline(
+    path: Path,
+    findings: list[Finding],
+    linted_files: set[str] | None = None,
+) -> int:
+    """Rewrite the baseline to the given findings' keys (sorted,
+    deduplicated).  ``linted_files`` scopes the rewrite: existing
+    entries for files OUTSIDE that set are preserved — an
+    ``--update-baseline`` run over a path subset must never silently
+    retire reviewed suppressions it did not re-examine (None = a
+    full-fileset run, which owns every entry).  Returns the entry
+    count."""
+    entries = {f.key() for f in findings}
+    if linted_files is not None:
+        entries |= {
+            e
+            for e in load_baseline(path)
+            if entry_path(e) not in linted_files
+        }
+    entries = sorted(entries)
+    Path(path).write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "entries": entries}, indent=1
+        )
+        + "\n"
+    )
+    return len(entries)

@@ -1,0 +1,284 @@
+"""harlint core: files, findings, suppressions, and the rule runner.
+
+The fleet stack (har_tpu.serve + har_tpu.adapt) is held together by
+invariants that used to live only in test pins and reviewer memory —
+the conservation law, the state()/load_state round-trip rule, the
+journal-record/replay-handler bijection, the no-host-sync-on-the-
+launch-path rule.  Each has already produced a shipped bug (the PR-4
+registry fsync fix, the PR-2 cache nondeterminism hunt).  harlint turns
+them into machine-checked gate failures: a rule is an AST visitor over
+a fixed fileset, a finding is a (rule, file, line, symbol, message)
+record, and the release gate refuses a snapshot with any non-baselined
+finding.
+
+Design choices, stated so the rules stay honest:
+
+  - **Pure stdlib.**  ``ast`` + ``json`` only — the linter must run in
+    the release gate's subprocess without initializing a jax backend
+    (and must never be the reason the gate is slow).
+  - **Line-anchored suppressions** (``# harlint: <token>``) are
+    reviewed contracts, not escape hatches: ``fetch-ok`` marks the one
+    allowed host-sync sink (a retire-side fetch), ``host-ok`` marks a
+    reviewed host-origin conversion on the launch path, ``ephemeral``
+    marks a stats field that intentionally restarts after recovery,
+    ``disable=HL00X`` is the generic last resort.  A token counts on
+    the flagged line, anywhere in a multi-line call's span, or on the
+    line directly above (so the annotation can carry prose).
+  - **Stable baseline keys.**  A finding's baseline key is
+    ``rule|path|symbol|normalized-snippet`` — line-number independent,
+    so unrelated edits never churn the committed baseline file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+# the fleet stack: the fileset every rule reasons over by default.
+# serving.py rides along because the fleet engine's window assembly,
+# smoothing, ingest guard and pad policies live there.
+DEFAULT_FILESET = (
+    "har_tpu/serve",
+    "har_tpu/adapt",
+    "har_tpu/serving.py",
+    "har_tpu/utils/durable.py",
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*harlint:\s*(.+)$")
+_KNOWN_TOKENS = {"fetch-ok", "host-ok", "ephemeral"}
+
+
+def _parse_tokens(comment: str) -> set[str]:
+    """Extract harlint tokens from the text after ``# harlint:`` —
+    prose is allowed around them (``# harlint: host-ok (slot list)``)."""
+    tokens: set[str] = set()
+    for word in re.split(r"[\s,()]+", comment.strip()):
+        if word in _KNOWN_TOKENS:
+            tokens.add(word)
+        elif word.startswith("disable="):
+            for rule in word[len("disable="):].split(","):
+                if rule:
+                    tokens.add(f"disable={rule}")
+    return tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file/line/symbol."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    symbol: str = ""  # enclosing function/class qualname
+    snippet: str = ""  # normalized source line (baseline key material)
+
+    def key(self) -> str:
+        """Line-number-independent identity for the baseline file."""
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.snippet}"
+
+    def render(self) -> str:
+        sym = self.symbol or "<module>"
+        return f"{self.path}:{self.line}: {self.rule} [{sym}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.rel)
+        # would-be findings a token (fetch-ok / host-ok / ephemeral)
+        # suppressed — rules bump this so the report can account for
+        # every reviewed escape, not only `disable=` lines
+        self.suppression_hits = 0
+        # lineno (1-based) -> set of suppression tokens on that line
+        self.suppressions: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                tokens = _parse_tokens(m.group(1))
+                if tokens:
+                    self.suppressions[i] = tokens
+
+    # ------------------------------------------------------ suppression
+
+    def _node_lines(self, node: ast.AST):
+        start = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", start) or start
+        lines = list(range(start, end + 1))
+        # the line directly above joins the annotation surface ONLY
+        # when it is a comment-only line (a prose justification block);
+        # a trailing token on the previous CODE line must not bleed
+        # into this statement
+        prev = start - 1
+        if (
+            prev >= 1
+            and prev <= len(self.lines)
+            and self.lines[prev - 1].lstrip().startswith("#")
+        ):
+            lines.insert(0, prev)
+        return lines
+
+    def suppressed(self, node: ast.AST, token: str) -> bool:
+        return any(
+            token in self.suppressions.get(ln, ())
+            for ln in self._node_lines(node)
+        )
+
+    def rule_disabled(self, node: ast.AST, rule_id: str) -> bool:
+        return self.suppressed(node, f"disable={rule_id}")
+
+    # --------------------------------------------------------- helpers
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return " ".join(self.lines[lineno - 1].split())
+        return ""
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str, symbol: str = ""
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=line,
+            message=message,
+            symbol=symbol,
+            snippet=self.snippet(line),
+        )
+
+
+def walk_functions(tree: ast.Module):
+    """Yield ``(qualname, class_name, node)`` for every function/method
+    definition, qualnames dotted through nesting (``Cls.method``)."""
+    out = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                cls = stack[-1] if stack else None
+                out.append((qual, cls, child))
+                visit(child, stack + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+def call_name(node: ast.Call) -> str | None:
+    """The terminal name a call targets: ``foo()`` -> foo,
+    ``a.b.foo()`` -> foo."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def receiver_name(node: ast.Call) -> str | None:
+    """For ``recv.attr(...)``: the receiver's name when it is a bare
+    Name (``np.asarray`` -> "np"); None otherwise."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return None
+
+
+class Rule:
+    """Base class: per-file ``check`` plus an optional cross-file
+    ``finalize`` (HL003 needs the whole fileset to compare record
+    writers against replay handlers)."""
+
+    rule_id = "HL000"
+    title = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
+        return []
+
+
+@dataclasses.dataclass
+class LintStats:
+    rules_run: list[str]
+    files: int
+    annotation_suppressed: int = 0
+
+
+def run_rules(
+    ctxs: list[FileContext], rules: list[Rule]
+) -> tuple[list[Finding], LintStats]:
+    """Run every rule over the fileset; generic ``disable=`` line
+    suppressions are applied here so individual rules never need to."""
+    by_rel = {c.rel: c for c in ctxs}
+    raw: list[Finding] = []
+    for rule in rules:
+        for ctx in ctxs:
+            if rule.applies(ctx.rel):
+                raw.extend(rule.check(ctx))
+        raw.extend(rule.finalize([c for c in ctxs if rule.applies(c.rel)]))
+    findings: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        ctx = by_rel.get(f.path)
+        check_lines = [f.line]
+        if ctx is not None:
+            prev = f.line - 1
+            # same adjacency rule as token suppression: the preceding
+            # line joins the surface only when it is comment-only
+            if (
+                1 <= prev <= len(ctx.lines)
+                and ctx.lines[prev - 1].lstrip().startswith("#")
+            ):
+                check_lines.append(prev)
+        if ctx is not None and any(
+            f"disable={f.rule}" in ctx.suppressions.get(ln, ())
+            for ln in check_lines
+        ):
+            suppressed += 1
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stats = LintStats(
+        rules_run=[r.rule_id for r in rules],
+        files=len(ctxs),
+        annotation_suppressed=suppressed
+        + sum(c.suppression_hits for c in ctxs),
+    )
+    return findings, stats
+
+
+def discover_files(root: Path, paths=None) -> list[Path]:
+    """Resolve the fileset: explicit ``paths`` (files or directories)
+    or the default fleet-stack set, as sorted .py files."""
+    targets = [root / p for p in (paths or DEFAULT_FILESET)]
+    files: list[Path] = []
+    for t in targets:
+        if t.is_dir():
+            files.extend(sorted(t.rglob("*.py")))
+        elif t.suffix == ".py" and t.exists():
+            files.append(t)
+    return files
+
+
+def load_contexts(root: Path, paths=None) -> list[FileContext]:
+    ctxs = []
+    for f in discover_files(root, paths):
+        rel = f.relative_to(root).as_posix()
+        ctxs.append(FileContext(rel, f.read_text()))
+    return ctxs

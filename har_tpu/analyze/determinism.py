@@ -1,0 +1,139 @@
+"""HL004 — determinism: the fleet stack's bit-identity pins must not
+be one wall-clock read or one unseeded RNG away from flaking.
+
+Three of the stack's strongest guarantees are *bit-identity* pins:
+fleet events equal N standalone classifiers (PR 2), pipelined equals
+synchronous (PR 5), and pre-crash ∪ post-recovery equals uninterrupted
+(PR 4).  All three hold only because every clock is injectable
+(``FakeClock``) and every random draw is seeded.  The PR-2 cache
+nondeterminism hunt is what one violation costs.
+
+Flagged inside ``har_tpu/serve/`` and ``har_tpu/adapt/``:
+
+  - ``time.time()`` CALLS — wall-clock reads the fake-clock harness
+    cannot intercept.  (``clock or time.time`` default *references* are
+    the injectable-clock plumbing and are not calls — allowed; so are
+    ``time.monotonic()``/``perf_counter()`` duration measurements,
+    which feed reporting, not decisions.)
+  - stdlib ``random.*`` calls — the process-global RNG, unseedable per
+    run without cross-test contamination;
+  - legacy global numpy RNG (``np.random.rand`` / ``np.random.seed`` /
+    any ``np.random.<fn>`` other than ``default_rng``) and
+    ``np.random.default_rng()`` with NO seed — both draw from
+    process-global or OS entropy;
+  - iteration directly over a ``set`` expression (literal, set
+    comprehension, or ``set(...)`` call) — set order is hash-dependent
+    across processes, the dict-order trap for session-id collections
+    (plain dicts are insertion-ordered and fine; a session-id SET is
+    not).  Wrap in ``sorted(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from har_tpu.analyze.core import FileContext, Finding, Rule
+
+_SCOPES = ("har_tpu/serve/", "har_tpu/adapt/")
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("set", "frozenset")
+    )
+
+
+class DeterminismRule(Rule):
+    rule_id = "HL004"
+    title = "determinism"
+
+    def applies(self, rel: str) -> bool:
+        return any(rel.startswith(s) for s in _SCOPES)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        # enclosing-symbol map for readable findings
+        symbols: dict[int, str] = {}
+
+        def label(node, qual):
+            for sub in ast.walk(node):
+                ln = getattr(sub, "lineno", None)
+                if ln is not None and ln not in symbols:
+                    symbols[ln] = qual
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                label(node, node.name)
+
+        def flag(node, msg):
+            findings.append(
+                ctx.finding(
+                    self.rule_id, node, msg,
+                    symbols.get(getattr(node, "lineno", 0), ""),
+                )
+            )
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                f = node.func
+                if isinstance(f.value, ast.Name):
+                    if f.value.id == "time" and f.attr == "time":
+                        flag(
+                            node,
+                            "`time.time()` call — a wall-clock read the "
+                            "FakeClock harness cannot intercept; take "
+                            "the injectable clock (`self._clock()`) "
+                            "instead",
+                        )
+                    elif f.value.id == "random":
+                        flag(
+                            node,
+                            f"stdlib `random.{f.attr}(...)` — the "
+                            "process-global RNG breaks the bit-identity "
+                            "pins; draw from a seeded "
+                            "`np.random.default_rng(seed)` instead",
+                        )
+                elif (
+                    isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id in ("np", "numpy")
+                    and f.value.attr == "random"
+                ):
+                    if f.attr != "default_rng":
+                        flag(
+                            node,
+                            f"legacy global `np.random.{f.attr}(...)` — "
+                            "unseeded process-global state; use a "
+                            "seeded `np.random.default_rng(seed)`",
+                        )
+                    elif not node.args and not node.keywords:
+                        flag(
+                            node,
+                            "`np.random.default_rng()` without a seed "
+                            "draws from OS entropy — pass an explicit "
+                            "seed so runs are reproducible",
+                        )
+            elif isinstance(node, ast.For) and _is_set_expr(node.iter):
+                flag(
+                    node.iter,
+                    "iterating a set — order is hash-dependent across "
+                    "processes (the nondeterministic cousin of the "
+                    "session-dict-order trap); wrap in `sorted(...)`",
+                )
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter):
+                        flag(
+                            gen.iter,
+                            "comprehension over a set — order is "
+                            "hash-dependent across processes; wrap in "
+                            "`sorted(...)`",
+                        )
+        return findings

@@ -1,0 +1,223 @@
+"""HL001 — hot-path host-sync: nothing on the dispatch launch path may
+force a device→host synchronization.
+
+The Spark-ML perf study (arXiv 1612.01437, PAPERS.md) found that
+serialization + scheduling — not compute — dominates distributed-ML
+latency; our analog is a host fetch on the launch path, which stalls
+the pipelined dispatch plane (``har_tpu.serve.dispatch``) and erases
+the overlap ``FleetConfig.pipeline_depth`` exists to buy.  PR 5 fought
+exactly this by hand (the un-fetched launch/retire ticket split); this
+rule keeps it won.
+
+What is scanned:
+
+  - the LAUNCH SURFACE: every function/method named ``launch``,
+    ``_launch_batch``, ``pad``, ``pad_size``, ``gather`` or ``_place``
+    in the fileset, closed over same-class ``self.`` method calls and
+    direct module-function calls (``pad_pow2`` reached from
+    ``HostScorer.pad``);
+  - every ``@jax.jit``-decorated (or ``jax.jit(fn)``-wrapped) function
+    body — a host materialization inside a traced body is either a
+    tracer error waiting to happen or a silent constant-fold;
+  - every function named ``fetch`` — the ONE allowed sink.  A fetch is
+    where the host is SUPPOSED to block, but each host-sync line there
+    must carry the reviewed ``# harlint: fetch-ok`` annotation, so a
+    new sync cannot hide in a fetch body unexamined.
+
+What is flagged: ``.item()``, ``jax.device_get``,
+``.block_until_ready()``, ``np.asarray``/``np.array`` (host
+materialization of a possibly-device value), and ``float()``/``int()``
+over a non-trivial expression (calls/subscripts/attributes — a device
+scalar coerced on host; bare-name coercions of scalar locals are not
+flagged).  On the launch surface, ``# harlint: host-ok`` marks a
+reviewed conversion of host-origin data (e.g. casting the host-side
+scaler output before ``device_put``); it never excuses ``.item()`` /
+``device_get`` / ``block_until_ready`` — those are real syncs wherever
+they appear.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from har_tpu.analyze.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    receiver_name,
+    walk_functions,
+)
+
+LAUNCH_SURFACE = {
+    "launch", "_launch_batch", "pad", "pad_size", "gather", "_place",
+}
+FETCH_SURFACE = {"fetch"}
+
+_HARD_SYNCS = {"item", "device_get", "block_until_ready"}
+_NP_NAMES = {"np", "numpy"}
+
+
+def _is_jit_marked(node: ast.FunctionDef) -> bool:
+    """Decorated with jax.jit / jit / functools.partial(jax.jit, ...)."""
+    for dec in node.decorator_list:
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Attribute) and sub.attr == "jit":
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "jit":
+                return True
+    return False
+
+
+def _jit_wrapped_names(tree: ast.Module) -> set[str]:
+    """Local defs wrapped via ``jax.jit(forward)`` somewhere in the
+    file (the loadgen pattern: define, then jit by name)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and call_name(node) == "jit"
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            names.add(node.args[0].id)
+    return names
+
+
+class HotPathRule(Rule):
+    rule_id = "HL001"
+    title = "hot-path host-sync"
+
+    def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
+        # function tables across the fileset, for the launch closure
+        funcs: dict[str, list[tuple[FileContext, str, str | None, ast.FunctionDef]]] = {}
+        module_funcs: dict[str, list[tuple[FileContext, str, ast.FunctionDef]]] = {}
+        per_ctx: dict[str, list] = {}
+        for ctx in ctxs:
+            entries = walk_functions(ctx.tree)
+            per_ctx[ctx.rel] = entries
+            for qual, cls, node in entries:
+                funcs.setdefault(node.name, []).append((ctx, qual, cls, node))
+                if cls is None and "." not in qual:
+                    module_funcs.setdefault(node.name, []).append(
+                        (ctx, qual, node)
+                    )
+
+        # seed the scan set: launch surface, fetch sinks, jit bodies
+        work: list[tuple[FileContext, str, str | None, ast.FunctionDef, str]] = []
+        for ctx in ctxs:
+            jit_names = _jit_wrapped_names(ctx.tree)
+            for qual, cls, node in per_ctx[ctx.rel]:
+                if node.name in LAUNCH_SURFACE:
+                    work.append((ctx, qual, cls, node, "launch"))
+                elif node.name in FETCH_SURFACE:
+                    work.append((ctx, qual, cls, node, "fetch"))
+                elif _is_jit_marked(node) or (
+                    cls is None and node.name in jit_names
+                ):
+                    work.append((ctx, qual, cls, node, "jit"))
+
+        findings: list[Finding] = []
+        seen: set[tuple[str, str]] = set()
+        while work:
+            ctx, qual, cls, node, mode = work.pop()
+            if (ctx.rel, qual) in seen:
+                continue
+            seen.add((ctx.rel, qual))
+            findings.extend(self._scan(ctx, qual, node, mode))
+            if mode != "launch":
+                continue
+            # close the launch surface: self-method calls within the
+            # same class, and direct Name calls to module functions
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and cls is not None
+                ):
+                    for tctx, tqual, tcls, tnode in funcs.get(f.attr, ()):
+                        if tcls == cls:
+                            work.append((tctx, tqual, tcls, tnode, "launch"))
+                elif isinstance(f, ast.Name):
+                    for tctx, tqual, tnode in module_funcs.get(f.id, ()):
+                        work.append((tctx, tqual, None, tnode, "launch"))
+        return findings
+
+    # ------------------------------------------------------------ scan
+
+    def _scan(
+        self, ctx: FileContext, qual: str, node: ast.FunctionDef, mode: str
+    ) -> list[Finding]:
+        where = {
+            "launch": "on the dispatch launch path",
+            "jit": "inside a @jit body",
+            "fetch": "in a retire-side fetch",
+        }[mode]
+        out: list[Finding] = []
+
+        def flag(sub: ast.AST, what: str, soft: bool) -> None:
+            # fetch sinks: any sync is legal WITH the reviewed
+            # annotation; launch surface: host-ok covers soft
+            # (conversion) flags only; jit bodies: no annotation out
+            if mode == "fetch":
+                if ctx.suppressed(sub, "fetch-ok"):
+                    ctx.suppression_hits += 1
+                    return
+                msg = (
+                    f"{what} {where} without the `# harlint: fetch-ok` "
+                    "annotation — a fetch is the one allowed host-sync "
+                    "sink, and every sync line in it must be reviewed"
+                )
+            else:
+                if (
+                    soft
+                    and mode == "launch"
+                    and ctx.suppressed(sub, "host-ok")
+                ):
+                    ctx.suppression_hits += 1
+                    return
+                msg = (
+                    f"{what} {where} forces a host sync — the device "
+                    "idles while the host blocks; move it behind the "
+                    "retire boundary (or annotate a reviewed "
+                    "host-origin conversion with `# harlint: host-ok`)"
+                )
+            out.append(self.finding_at(ctx, sub, msg, qual))
+
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = call_name(sub)
+            recv = receiver_name(sub)
+            # hard syncs match BOTH spellings: `jax.device_get(h)` /
+            # `h.block_until_ready()` attributes AND the bare-name
+            # `from jax import device_get` form.  Bare `item(...)` is
+            # excluded — as a free function it is always user code, not
+            # the ndarray method.
+            if name in _HARD_SYNCS and (
+                isinstance(sub.func, ast.Attribute)
+                or name in ("device_get", "block_until_ready")
+            ):
+                flag(sub, f"`.{name}()`" if name != "device_get"
+                     else "`jax.device_get`", soft=False)
+            elif name in ("asarray", "array") and recv in _NP_NAMES:
+                flag(sub, f"`np.{name}(...)`", soft=True)
+            elif (
+                isinstance(sub.func, ast.Name)
+                and sub.func.id in ("float", "int")
+                and len(sub.args) == 1
+                and isinstance(
+                    sub.args[0], (ast.Call, ast.Subscript, ast.Attribute)
+                )
+            ):
+                flag(sub, f"`{sub.func.id}(...)` on a computed value",
+                     soft=True)
+        return out
+
+    @staticmethod
+    def finding_at(ctx, node, msg, qual) -> Finding:
+        return ctx.finding("HL001", node, msg, qual)

@@ -1,0 +1,243 @@
+"""HL003 — journal/replay exhaustiveness: record types, replay
+handlers and chaos kill points must stay in bijection.
+
+Three closed sets keep the durability layer honest, and each has a
+writer side and a consumer side that live in DIFFERENT files — exactly
+the shape that drifts:
+
+  1. every journal record type written anywhere in the fleet stack
+     (``_jappend({"t": "push", ...})`` in the engine,
+     ``journal.append({"t": "adapt", ...})`` in the adaptation
+     controller) must have a replay handler in
+     ``serve/recover.py`` (``t == "push"`` dispatch) — a recordless
+     handler is dead code, a handlerless record is data a crash writes
+     and recovery silently drops (the replay loop tolerates unknown
+     types BY DESIGN for forward compat, which is precisely why the
+     same-version check must be static);
+  2. every replay handler must correspond to a written record type;
+  3. the kill-point names the chaos matrix enumerates
+     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` in ``serve/chaos.py``)
+     must biject with the ``chaos_point("...")`` / ``_chaos("...")``
+     call sites across the stack, and every matrix point needs a
+     ``_DEFAULT_AT`` occurrence calibration — a stage boundary without
+     a matrix entry is a crash window no chaos run ever exercises.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from har_tpu.analyze.core import FileContext, Finding, Rule, call_name
+
+_CHAOS_NAMES = {"chaos_point", "_chaos"}
+
+
+def _is_journal_write(node: ast.Call) -> bool:
+    """True for the two real journaling spellings: the engine's
+    ``self._jappend(...)`` wrapper, and ``<journal>.append(...)`` where
+    the receiver's terminal name names a journal (``journal.append``,
+    ``self._journal.append``).  A bare ``something.append`` is the
+    universal LIST method — an ordinary list of dicts that happen to
+    carry a "t" key must never read as a phantom record type."""
+    name = call_name(node)
+    if name == "_jappend":
+        return True
+    if name != "append" or not isinstance(node.func, ast.Attribute):
+        return False
+    recv = node.func.value
+    terminal = (
+        recv.id if isinstance(recv, ast.Name)
+        else recv.attr if isinstance(recv, ast.Attribute)
+        else ""
+    )
+    return "journal" in terminal.lower()
+
+
+def _record_writes(ctx: FileContext) -> list[tuple[str, ast.AST]]:
+    """``("push", node)`` for every journaled dict literal with a
+    constant "t" key passed to an append-style call."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _is_journal_write(node)
+            and node.args
+            and isinstance(node.args[0], ast.Dict)
+        ):
+            continue
+        d = node.args[0]
+        for k, v in zip(d.keys, d.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == "t"
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)
+            ):
+                out.append((v.value, node))
+    return out
+
+
+def _replay_handlers(ctx: FileContext) -> list[tuple[str, ast.AST]]:
+    """``t == "push"``-style comparisons in the replay dispatch."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Compare)
+            and isinstance(node.left, ast.Name)
+            and node.left.id == "t"
+            and len(node.ops) == 1
+            and isinstance(node.ops[0], ast.Eq)
+            and isinstance(node.comparators[0], ast.Constant)
+            and isinstance(node.comparators[0].value, str)
+        ):
+            continue
+        out.append((node.comparators[0].value, node))
+    return out
+
+
+def _string_tuple(tree: ast.Module, name: str) -> tuple[set[str], ast.AST | None]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return (
+                {
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                },
+                node,
+            )
+    return set(), None
+
+
+def _dict_keys(tree: ast.Module, name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                k.value
+                for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return set()
+
+
+class JournalExhaustivenessRule(Rule):
+    rule_id = "HL003"
+    title = "journal/replay exhaustiveness"
+
+    def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
+        findings: list[Finding] = []
+        written: dict[str, tuple[FileContext, ast.AST]] = {}
+        handled: dict[str, tuple[FileContext, ast.AST]] = {}
+        chaos_calls: dict[str, tuple[FileContext, ast.AST]] = {}
+        chaos_ctx = None
+        declared: set[str] = set()
+        declared_node = None
+        default_at: set[str] = set()
+        matrix_points: set[str] = set()
+
+        for ctx in ctxs:
+            base = ctx.rel.rsplit("/", 1)[-1]
+            for t, node in _record_writes(ctx):
+                written.setdefault(t, (ctx, node))
+            if base == "recover.py":
+                for t, node in _replay_handlers(ctx):
+                    handled.setdefault(t, (ctx, node))
+            if base == "chaos.py":
+                chaos_ctx = ctx
+                kp, kp_node = _string_tuple(ctx.tree, "KILL_POINTS")
+                ekp, _ = _string_tuple(ctx.tree, "ENGINE_KILL_POINTS")
+                declared = kp | ekp
+                matrix_points = kp
+                declared_node = kp_node
+                default_at = _dict_keys(ctx.tree, "_DEFAULT_AT")
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and call_name(node) in _CHAOS_NAMES
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    chaos_calls.setdefault(node.args[0].value, (ctx, node))
+
+        # record types <-> replay handlers, both directions
+        recover_seen = bool(handled) or any(
+            c.rel.endswith("recover.py") for c in ctxs
+        )
+        if recover_seen:
+            for t in sorted(set(written) - set(handled)):
+                ctx, node = written[t]
+                findings.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"journal record type {t!r} is written here but "
+                        "has no replay handler in serve/recover.py — a "
+                        "crash would silently drop it (the replay loop "
+                        "skips unknown types for forward compat; "
+                        "same-version exhaustiveness is this check)",
+                    )
+                )
+            for t in sorted(set(handled) - set(written)):
+                ctx, node = handled[t]
+                findings.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"replay handler for record type {t!r} matches "
+                        "no journaled write in the fleet stack — dead "
+                        "recovery code, or the writer was removed "
+                        "without its handler",
+                    )
+                )
+
+        # kill points <-> chaos_point call sites, plus _DEFAULT_AT
+        if chaos_ctx is not None and declared:
+            for p in sorted(set(chaos_calls) - declared):
+                ctx, node = chaos_calls[p]
+                findings.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"chaos point {p!r} is instrumented here but "
+                        "absent from the chaos matrix (KILL_POINTS / "
+                        "ENGINE_KILL_POINTS in serve/chaos.py) — a "
+                        "crash window no chaos run exercises",
+                    )
+                )
+            for p in sorted(declared - set(chaos_calls)):
+                findings.append(
+                    chaos_ctx.finding(
+                        self.rule_id,
+                        declared_node or chaos_ctx.tree,
+                        f"kill point {p!r} is declared in the chaos "
+                        "matrix but no `chaos_point(...)`/`_chaos(...)` "
+                        "call site exists — the matrix would report it "
+                        "as 'never fired'",
+                    )
+                )
+            for p in sorted(matrix_points - default_at):
+                findings.append(
+                    chaos_ctx.finding(
+                        self.rule_id,
+                        declared_node or chaos_ctx.tree,
+                        f"matrix kill point {p!r} has no _DEFAULT_AT "
+                        "occurrence calibration",
+                    )
+                )
+        return findings

@@ -344,6 +344,31 @@ def _parser() -> argparse.ArgumentParser:
                          "(per-output-channel scales; weights ship int8 "
                          "in the artifact)")
 
+    ln = sub.add_parser(
+        "lint",
+        help="harlint: AST-based invariant checker for the fleet stack "
+             "(HL001 hot-path host-sync, HL002 state completeness, "
+             "HL003 journal/replay exhaustiveness, HL004 determinism, "
+             "HL005 durability); rc 1 on any non-baselined finding",
+    )
+    ln.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (repo-relative); "
+                         "default is the fleet-stack fileset "
+                         "(har_tpu/serve, har_tpu/adapt, serving.py, "
+                         "utils/durable.py)")
+    ln.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON report line (the release gate's "
+                         "consumption format) instead of text findings")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline suppression file (default: "
+                         "harlint_baseline.json at the checkout root)")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(reviewed-debt admission; keep it near-empty)")
+    ln.add_argument("--check", action="store_true",
+                    help="summary only (no per-finding lines); rc is "
+                         "the verdict — the release-gate invocation")
+
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
     pa = sub.add_parser(
@@ -373,6 +398,27 @@ def _parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = _parser().parse_args(argv)
+
+    if args.command == "lint":
+        # pure-stdlib path by design: `har lint` must run in the
+        # release gate without initializing a jax backend
+        from har_tpu.analyze import run_harlint
+
+        report = run_harlint(
+            paths=args.paths or None,
+            baseline=args.baseline,
+            update_baseline=args.update_baseline,
+        )
+        if args.as_json:
+            print(json.dumps(report.to_json()))
+        elif args.check:
+            print(
+                f"harlint: {len(report.findings)} finding(s), "
+                f"{report.suppressed} suppressed"
+            )
+        else:
+            print(report.render())
+        return 0 if report.ok else 1
 
     if args.command == "bench":
         import importlib.util

@@ -142,8 +142,10 @@ class FleetStats:
         self.smoothing_shed_transitions = 0
         self.slo_breaches = 0
         self.admission_rejections = 0
-        self.sessions = 0
-        self.queue_depth = 0
+        # live gauges, recomputed during restore (add_session /
+        # note_queue_depth replay) — deliberately not snapshot state
+        self.sessions = 0  # harlint: ephemeral
+        self.queue_depth = 0  # harlint: ephemeral
         self.queue_depth_max = 0
         self.batch_sizes: dict[int, int] = {}  # padded size -> count
         # ingest guard: non-finite / wildly out-of-range samples refused
@@ -171,6 +173,11 @@ class FleetStats:
         self.inflight_ms = 0.0
         self.inflight_depth: dict[int, int] = {}
         self.device_windows: dict[str, int] = {}
+        # forward-compat guard (the runtime half of harlint HL002):
+        # state keys a NEWER writer persisted that this version does
+        # not know — counted and warned in load_state, never silently
+        # dropped
+        self.unknown_state_keys = 0
         self.queue_wait = StageHistogram()
         self.dispatch = StageHistogram()
         self.smooth = StageHistogram()
@@ -282,6 +289,7 @@ class FleetStats:
             "shadow_batches": self.shadow_batches,
             "shadow_windows": self.shadow_windows,
             "shadow_errors": self.shadow_errors,
+            "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
             "overlap_pct": self.overlap_pct(),
             "overlap_host_ms": round(self.overlap_host_ms, 3),
@@ -309,8 +317,17 @@ class FleetStats:
         "admission_rejections", "queue_depth_max", "rejected_samples",
         "recoveries", "lost_in_crash", "model_swaps", "rollbacks",
         "shadow_batches", "shadow_windows", "shadow_errors",
+        "unknown_state_keys",
     )
     _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")
+    # the state() envelope: every top-level key a state dict may carry.
+    # load_state counts anything outside this set (or outside
+    # _COUNTERS/_STAGES within it) as an unknown key and warns.
+    _STATE_KEYS = (
+        "counters", "dropped", "batch_sizes", "scored_by_version",
+        "overlap_host_ms", "inflight_ms", "inflight_depth",
+        "device_windows", "stages",
+    )
 
     def state(self) -> dict:
         """JSON-serializable full counter state for a recovery snapshot
@@ -338,10 +355,32 @@ class FleetStats:
         missing the newer fields (``lost_in_crash``, ``recoveries``,
         ``rejected_samples``, and the pre-pipeline overlap/in-flight
         fields) load with zero defaults — back-compat is pinned in the
-        test suite."""
+        test suite.  Keys this version does NOT know (a newer writer's
+        state) are never silently dropped: they are counted in
+        ``unknown_state_keys`` and warned about, so a forward-compat
+        downgrade degrades loudly (pinned in tests/test_recovery.py)."""
+        unknown = [
+            k for k in (state.get("counters") or {})
+            if k not in self._COUNTERS
+        ]
+        unknown += [k for k in state if k not in self._STATE_KEYS]
+        unknown += [
+            k for k in (state.get("stages") or {}) if k not in self._STAGES
+        ]
         for k, v in (state.get("counters") or {}).items():
             if k in self._COUNTERS:
                 setattr(self, k, int(v))
+        if unknown:
+            import warnings
+
+            self.unknown_state_keys += len(unknown)
+            warnings.warn(
+                "FleetStats.load_state: ignoring unknown state keys "
+                f"{sorted(unknown)} — written by a newer version? "
+                "(counted in unknown_state_keys)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         self.overlap_host_ms = float(state.get("overlap_host_ms", 0.0))
         self.inflight_ms = float(state.get("inflight_ms", 0.0))
         self.inflight_depth = {

@@ -17,6 +17,12 @@ that impossible to repeat by construction:
                                           #   without running the suite
 
 What it does:
+  0. ``har lint --check`` (harlint, har_tpu.analyze): the five fleet
+     invariant rules — hot-path host-sync, state completeness,
+     journal/replay exhaustiveness, determinism, durability — must
+     report zero non-baselined findings; any finding refuses the
+     snapshot before the suite runs.  ``{rules_run, findings,
+     suppressed}`` is stamped into the gate log.
   1. ``pytest tests/ -m "not slow" -q``; any failure => exit 1, no edits.
   2. ``pytest --collect-only`` for both tiers; rewrites the two count
      lines in README.md (anchored on the ``# smoke tier:`` / ``# full
@@ -183,6 +189,41 @@ def _recovery_smoke() -> dict:
     return _run_smoke("har_tpu.serve.recover", "recovery_smoke")
 
 
+def _harlint() -> dict:
+    """harlint verdict (`har lint --check --json`): the five fleet
+    invariant rules (hot-path purity HL001, state completeness HL002,
+    journal/replay exhaustiveness HL003, determinism HL004, durability
+    HL005) must report zero non-baselined findings.  Runs in its own
+    interpreter like every other smoke, but the rules are pure-stdlib
+    ast walking: no jax backend is ever initialized (the subprocess
+    pays only the package's module import — har_tpu/__init__ tolerates
+    a missing jax outright) and the whole stage costs a couple of
+    seconds, so it runs FIRST: a structural violation fails the gate
+    before the suite burns minutes proving it differently."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "har_tpu.cli", "lint",
+            "--check", "--json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    try:
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {
+            "ok": False,
+            "error": (
+                f"unparseable har lint output (rc={proc.returncode}): "
+                f"{(proc.stdout + proc.stderr)[-500:]}"
+            ),
+        }
+    out.pop("findings_list", None)  # gate log carries counts, not bodies
+    out["ok"] = bool(out.get("ok")) and proc.returncode == 0
+    return out
+
+
 def _git_head() -> str:
     try:
         return subprocess.run(
@@ -239,23 +280,38 @@ def main(argv=None) -> int:
     pipeline = None
     adapt = None
     recovery = None
+    harlint = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
-        # verdicts forward: a counts-only refresh must not blank the
-        # serving evidence the suite's gate-log test pins (only a full
-        # gate run regenerates)
+        # + harlint verdicts forward: a counts-only refresh must not
+        # blank the serving evidence the suite's gate-log test pins
+        # (only a full gate run regenerates)
         try:
             prior = json.loads(GATE_LOG.read_text())
             fleet = prior.get("fleet_slo")
             pipeline = prior.get("fleet_pipeline")
             adapt = prior.get("adapt_smoke")
             recovery = prior.get("recovery_smoke")
+            harlint = prior.get("harlint")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
             adapt = None
             recovery = None
+            harlint = None
     if not args.counts_only:
+        # static-analysis gate first: harlint is sub-second (pure ast,
+        # no jax backend) and a broken fleet invariant must refuse the
+        # snapshot before the suite burns minutes proving it differently
+        harlint = _harlint()
+        if not harlint.get("ok"):
+            print(
+                "\nrelease_gate: RED harlint "
+                f"({json.dumps(harlint)[:300]}) — snapshot refused; "
+                "run `har lint` for the findings",
+                file=sys.stderr,
+            )
+            return 1
         t0 = time.perf_counter()
         proc = subprocess.run(
             [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -325,6 +381,7 @@ def main(argv=None) -> int:
                 "smoke_count": smoke,
                 "total_count": total,
                 "suite": suite,
+                "harlint": harlint,
                 "fleet_slo": fleet,
                 "fleet_pipeline": pipeline,
                 "adapt_smoke": adapt,
@@ -343,6 +400,7 @@ def main(argv=None) -> int:
                 "smoke": smoke,
                 "total": total,
                 "suite_rc": None if suite is None else suite["rc"],
+                "harlint_ok": None if harlint is None else harlint["ok"],
                 "fleet_slo_ok": None if fleet is None else fleet["ok"],
                 "fleet_pipeline_ok": (
                     None if pipeline is None else pipeline["ok"]

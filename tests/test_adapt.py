@@ -235,6 +235,54 @@ def test_registry_atomic_writes_fsync_and_leave_no_tmp(tmp_path):
     ]
 
 
+def test_registry_version_metadata_write_is_durable(tmp_path):
+    """Regression for the finding harlint HL005 surfaced at its
+    introduction: a version's registry.json was the one registry write
+    still on a bare buffered open/json.dump — a crash after promote
+    could leave CURRENT pointing at a version whose metadata is torn
+    (``_load_version`` -> None, ``current()`` -> None, lineage blind).
+    Every byte of version metadata must ride the shared atomic-write
+    discipline (tmp + fsync + rename + dir fsync), and the artifact
+    hash must be computed BEFORE the tmp file could pollute it."""
+    import os
+
+    import har_tpu.adapt.registry as regmod
+
+    meta_writes = []
+    real = regmod._atomic_write
+
+    def spy(path, data):
+        meta_writes.append(os.path.basename(path))
+        return real(path, data)
+
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    orig = regmod._atomic_write
+    regmod._atomic_write = spy
+    try:
+        mv = reg.register(
+            lambda p: open(os.path.join(p, "weights.bin"), "wb").write(
+                b"\x01\x02"
+            ),
+            note="durable-meta",
+            promote=True,
+        )
+    finally:
+        regmod._atomic_write = orig
+    assert "registry.json" in meta_writes
+    # the metadata is complete and readable through a fresh handle,
+    # with no tmp residue in the version dir
+    reg2 = ModelRegistry(str(tmp_path / "reg"))
+    got = reg2.get(mv.version)
+    assert got.note == "durable-meta"
+    assert got.sha256 == mv.sha256
+    assert not any(
+        f.endswith(".tmp") for f in os.listdir(mv.path)
+    )
+    # the artifact hash ignores the (now atomic) metadata write: it
+    # still matches a recomputation over the artifact bytes alone
+    assert got.sha256 == regmod._dir_sha256(mv.path)
+
+
 def test_pre_fsync_registry_loads_with_defaults(tmp_path):
     """A registry directory written by the pre-r9 code (plain writes,
     no fsync discipline; possibly no NEXT_ID at all) loads unchanged —

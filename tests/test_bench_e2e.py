@@ -33,6 +33,25 @@ def test_real_data_lanes_stay_armed(monkeypatch, tmp_path):
     assert "WISDM_ar_v1.1_raw.txt" in w["skipped"]
     assert w["target_accuracy"] == 0.97
 
+    # harlint must never quiet these lanes: the parity/bench modules
+    # are outside its fileset (so no rule can touch the skip-note
+    # code) and the committed baseline carries no entry referencing
+    # them — the loud-skip contract cannot be suppressed away
+    import json
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    from har_tpu.analyze import DEFAULT_FILESET
+
+    assert not any(
+        "parity" in p or "bench" in p for p in DEFAULT_FILESET
+    )
+    baseline = json.loads((repo / "harlint_baseline.json").read_text())
+    assert not any(
+        "parity" in e or "bench" in e
+        for e in baseline.get("entries", [])
+    )
+
 
 @pytest.mark.slow
 def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):

@@ -1,0 +1,669 @@
+"""harlint (har_tpu.analyze): every rule pinned against minimal
+positive AND negative fixture snippets, plus the two acceptance
+mutations — deleting a FleetStats field from state() and deleting a
+replay handler from recover.py must each produce a finding (which the
+release gate turns into a non-zero exit).
+
+The fixtures run through ``lint_sources`` (in-memory path→source
+pairs), so each rule's trigger surface is pinned without touching the
+working tree; the repo-clean test then runs the real fileset with the
+committed baseline and demands zero fresh findings — the merge-time
+contract.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from har_tpu.analyze import (
+    default_rules,
+    lint_sources,
+    repo_root,
+    run_harlint,
+)
+from har_tpu.analyze.baseline import (
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from har_tpu.analyze.core import FileContext
+from har_tpu.analyze.determinism import DeterminismRule
+from har_tpu.analyze.durability import DurabilityRule
+from har_tpu.analyze.hotpath import HotPathRule
+from har_tpu.analyze.journalcheck import JournalExhaustivenessRule
+from har_tpu.analyze.statecheck import StateCompletenessRule
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------- HL001
+
+
+def test_hl001_flags_host_sync_on_launch_path():
+    src = """
+import numpy as np
+
+class Scorer:
+    def launch(self, windows):
+        x = np.asarray(windows)          # host materialization
+        y = self.helper(x)
+        return float(y.sum())            # device scalar coerced
+
+    def helper(self, x):
+        return x.block_until_ready()
+"""
+    findings = lint_sources(
+        {"har_tpu/serve/dispatch.py": src}, [HotPathRule()]
+    )
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3
+    assert any("np.asarray" in m for m in msgs)
+    assert any("float" in m for m in msgs)
+    # the closure followed self.helper into the sync
+    assert any("block_until_ready" in m for m in msgs)
+
+
+def test_hl001_negative_clean_launch_and_annotations():
+    src = """
+import numpy as np
+
+class Scorer:
+    def launch(self, windows):
+        # reviewed host-origin cast
+        # harlint: host-ok
+        x = np.asarray(windows, np.float32)
+        return self._place(x)
+
+    def fetch(self, handle, k):
+        return np.asarray(handle[:k])  # harlint: fetch-ok
+
+    def other(self, x):
+        return np.asarray(x)  # not on any scanned surface
+"""
+    findings = lint_sources(
+        {"har_tpu/serve/dispatch.py": src}, [HotPathRule()]
+    )
+    assert findings == []
+
+
+def test_hl001_flags_bare_name_hard_syncs():
+    """`from jax import device_get` must not dodge the rule: the
+    bare-name call forms of the hard syncs are flagged too."""
+    src = """
+from jax import block_until_ready, device_get
+
+class Scorer:
+    def launch(self, x):
+        device_get(x)
+        return block_until_ready(x)
+"""
+    findings = lint_sources(
+        {"har_tpu/serve/dispatch.py": src}, [HotPathRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "device_get" in msgs and "block_until_ready" in msgs
+
+
+def test_hl001_fetch_without_annotation_is_flagged():
+    src = """
+import numpy as np
+
+class Scorer:
+    def fetch(self, handle, k):
+        return np.asarray(handle[:k])
+"""
+    (f,) = lint_sources({"har_tpu/serve/dispatch.py": src}, [HotPathRule()])
+    assert f.rule == "HL001" and "fetch-ok" in f.message
+
+
+def test_hl001_flags_jit_bodies_and_hard_syncs_resist_host_ok():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return np.asarray(x) + 1
+
+class S:
+    def launch(self, x):
+        # harlint: host-ok
+        return x.item()
+"""
+    findings = lint_sources(
+        {"har_tpu/serve/loadgen.py": src}, [HotPathRule()]
+    )
+    assert len(findings) == 2
+    assert any("@jit body" in f.message for f in findings)
+    # .item() is a real sync wherever it appears: host-ok never covers it
+    assert any(".item()" in f.message for f in findings)
+
+
+# --------------------------------------------------------------- HL002
+
+
+_STATS_FIXTURE = """
+class Stats:
+    _COUNTERS = ("a", "b")
+
+    def __init__(self):
+        self.a = 0
+        self.b = 0
+        self.c = 0
+        self._private = []
+
+    def state(self):
+        return {{"counters": {{k: getattr(self, k) for k in self._COUNTERS}},
+                {c_state}}}
+
+    def load_state(self, state):
+        for k, v in state.get("counters", {{}}).items():
+            if k in self._COUNTERS:
+                setattr(self, k, v)
+        {c_load}
+"""
+
+
+def test_hl002_complete_class_is_clean():
+    src = _STATS_FIXTURE.format(
+        c_state='"c": self.c', c_load='self.c = state.get("c", 0)'
+    )
+    assert lint_sources(
+        {"har_tpu/serve/stats.py": src}, [StateCompletenessRule()]
+    ) == []
+
+
+def test_hl002_missing_from_state_and_load_state():
+    src = _STATS_FIXTURE.format(c_state='"x": 1', c_load="pass")
+    findings = lint_sources(
+        {"har_tpu/serve/stats.py": src}, [StateCompletenessRule()]
+    )
+    assert {f.symbol for f in findings} == {"Stats.c"}
+    assert any("absent from state()" in f.message for f in findings)
+    assert any(
+        "absent from load_state()" in f.message for f in findings
+    )
+
+
+def test_hl002_ephemeral_annotation_and_table_deletion():
+    # annotated gauge: skipped
+    src = _STATS_FIXTURE.format(c_state='"x": 1', c_load="pass").replace(
+        "self.c = 0", "self.c = 0  # harlint: ephemeral"
+    )
+    assert lint_sources(
+        {"har_tpu/serve/stats.py": src}, [StateCompletenessRule()]
+    ) == []
+    # deleting a name from the _COUNTERS table un-mentions the field
+    src2 = _STATS_FIXTURE.format(
+        c_state='"c": self.c', c_load='self.c = state.get("c", 0)'
+    ).replace('_COUNTERS = ("a", "b")', '_COUNTERS = ("a",)')
+    findings = lint_sources(
+        {"har_tpu/serve/stats.py": src2}, [StateCompletenessRule()]
+    )
+    assert {f.symbol for f in findings} == {"Stats.b"}
+
+
+def test_hl002_acceptance_real_fleetstats_minus_one_field():
+    """THE acceptance mutation: deleting one FleetStats field from the
+    state()/load_state() surface of the REAL stats.py must produce
+    HL002 findings (the release gate then exits non-zero)."""
+    real = (REPO / "har_tpu" / "serve" / "stats.py").read_text()
+    mutated = real.replace('"model_swaps", "rollbacks",', '"model_swaps",')
+    assert mutated != real, "stats.py _COUNTERS anchor changed"
+    findings = lint_sources(
+        {"har_tpu/serve/stats.py": mutated}, [StateCompletenessRule()]
+    )
+    assert {f.symbol for f in findings} == {"FleetStats.rollbacks"}
+    assert len(findings) == 2  # absent from state() AND load_state()
+
+
+# --------------------------------------------------------------- HL003
+
+
+_ENGINE_FIXTURE = """
+class Engine:
+    def push(self):
+        self._jappend({"t": "push", "sid": 1}, b"")
+
+    def ack(self):
+        self._jappend({"t": "ack", "sid": 1})
+"""
+
+_RECOVER_FIXTURE = """
+def restore(records):
+    for meta, payload in records:
+        t = meta.get("t")
+        if t == "push":
+            pass
+        elif t == "ack":
+            pass
+"""
+
+_CHAOS_FIXTURE = """
+KILL_POINTS = ("pre_dispatch",)
+ENGINE_KILL_POINTS = ()
+_DEFAULT_AT = {"pre_dispatch": 1}
+"""
+
+_CHAOS_CALL = """
+class Engine2:
+    def poll(self):
+        self._chaos("pre_dispatch")
+"""
+
+
+def _hl003(engine=_ENGINE_FIXTURE, recover=_RECOVER_FIXTURE,
+           chaos=_CHAOS_FIXTURE, calls=_CHAOS_CALL):
+    return lint_sources(
+        {
+            "har_tpu/serve/engine.py": engine + calls,
+            "har_tpu/serve/recover.py": recover,
+            "har_tpu/serve/chaos.py": chaos,
+        },
+        [JournalExhaustivenessRule()],
+    )
+
+
+def test_hl003_bijection_is_clean():
+    assert _hl003() == []
+
+
+def test_hl003_written_without_handler():
+    findings = _hl003(
+        recover=_RECOVER_FIXTURE.replace('elif t == "ack":\n            pass', "pass")
+    )
+    assert len(findings) == 1
+    assert "'ack'" in findings[0].message
+    assert "no replay handler" in findings[0].message
+
+
+def test_hl003_handler_without_writer_and_kill_point_drift():
+    findings = _hl003(
+        engine=_ENGINE_FIXTURE.replace(
+            'self._jappend({"t": "ack", "sid": 1})', "pass"
+        ),
+        chaos=_CHAOS_FIXTURE.replace(
+            '("pre_dispatch",)', '("pre_dispatch", "mid_never")'
+        ),
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "matches no journaled write" in msgs       # dead 'ack' handler
+    assert "no `chaos_point" in msgs                  # declared, no site
+    assert "_DEFAULT_AT" in msgs                      # uncalibrated point
+
+
+def test_hl003_instrumented_point_missing_from_matrix():
+    findings = _hl003(
+        calls=_CHAOS_CALL.replace('"pre_dispatch"', '"post_new_stage"')
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "absent from the chaos matrix" in msgs
+    assert "'post_new_stage'" in msgs
+
+
+def test_hl003_acceptance_real_recover_minus_lost_handler():
+    """THE acceptance mutation: deleting the `lost` replay handler from
+    the REAL recover.py leaves the engine's `lost` record orphaned —
+    HL003 must flag it."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    baseline_findings = lint_sources(sources, [JournalExhaustivenessRule()])
+    assert baseline_findings == []  # the real tree is in bijection
+    mutated = sources["har_tpu/serve/recover.py"].replace(
+        'elif t == "lost":', 'elif t == "__deleted__":'
+    )
+    assert mutated != sources["har_tpu/serve/recover.py"]
+    sources["har_tpu/serve/recover.py"] = mutated
+    findings = lint_sources(sources, [JournalExhaustivenessRule()])
+    msgs = " | ".join(f.message for f in findings)
+    assert "'lost'" in msgs and "no replay handler" in msgs
+    assert "'__deleted__'" in msgs  # the dead handler is flagged too
+
+
+# --------------------------------------------------------------- HL004
+
+
+def test_hl003_plain_list_append_of_t_dicts_is_not_a_record():
+    """`events.append({"t": ...})` is the universal LIST method, not a
+    journal write — it must never prime a phantom record type (and a
+    gate failure) just because the dict carries a "t" key."""
+    engine = """
+class Engine:
+    def push(self):
+        self._jappend({"t": "push", "sid": 1}, b"")
+
+    def trace(self, events):
+        events.append({"t": "window", "sid": 1})
+        self.log.append({"t": "poll"})
+"""
+    recover = """
+def restore(records):
+    for meta, payload in records:
+        t = meta.get("t")
+        if t == "push":
+            pass
+"""
+    findings = lint_sources(
+        {
+            "har_tpu/serve/engine.py": engine,
+            "har_tpu/serve/recover.py": recover,
+        },
+        [JournalExhaustivenessRule()],
+    )
+    assert findings == []
+    # but a journal-named receiver IS a write: its type needs a handler
+    engine2 = engine.replace(
+        "self.log.append", "self._journal.append"
+    )
+    findings2 = lint_sources(
+        {
+            "har_tpu/serve/engine.py": engine2,
+            "har_tpu/serve/recover.py": recover,
+        },
+        [JournalExhaustivenessRule()],
+    )
+    assert len(findings2) == 1 and "'poll'" in findings2[0].message
+
+
+def test_hl004_flags_wall_clock_and_global_rng():
+    src = """
+import random
+import time
+import numpy as np
+
+def step(sessions):
+    now = time.time()
+    jitter = random.random()
+    rng = np.random.default_rng()
+    noise = np.random.rand(3)
+    for sid in {s for s in sessions}:
+        pass
+    return [x for x in set(sessions)]
+"""
+    findings = lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 6
+    assert "time.time()" in msgs
+    assert "random.random" in msgs
+    assert "without a seed" in msgs
+    assert "np.random.rand" in msgs
+    assert "iterating a set" in msgs
+    assert "comprehension over a set" in msgs
+
+
+def test_hl004_negative_seeded_and_injected_plumbing():
+    src = """
+import time
+import numpy as np
+
+class Engine:
+    def __init__(self, clock=None):
+        self._clock = clock or time.monotonic  # injectable default
+
+    def step(self, seed, sessions):
+        now = self._clock()
+        rng = np.random.default_rng(seed)
+        dur = time.perf_counter()  # duration reporting, not decisions
+        for sid in sorted(set(sessions)):
+            pass
+        return now, rng, dur
+"""
+    assert lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    ) == []
+
+
+def test_hl004_scope_is_serve_and_adapt_only():
+    src = "import time\nnow = time.time()\n"
+    assert lint_sources(
+        {"har_tpu/serving.py": src}, [DeterminismRule()]
+    ) == []
+    assert len(lint_sources(
+        {"har_tpu/adapt/trigger.py": src}, [DeterminismRule()]
+    )) == 1
+
+
+# --------------------------------------------------------------- HL005
+
+
+def test_hl005_flags_unsynced_write_and_bare_replace():
+    src = """
+import json
+import os
+
+def save(path, meta):
+    with open(path, "w") as f:
+        json.dump(meta, f)
+
+def swap(tmp, dst):
+    os.replace(tmp, dst)
+"""
+    findings = lint_sources(
+        {"har_tpu/adapt/registry.py": src}, [DurabilityRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "without an fsync" in msgs
+    assert "parent-directory fsync" in msgs
+
+
+def test_hl005_negative_durable_discipline_passes():
+    src = """
+import json
+import os
+from har_tpu.utils.durable import atomic_write, fsync_dir
+
+def save(path, meta):
+    atomic_write(path, json.dumps(meta))
+
+def explicit(path, data):
+    with open(path, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path, path + ".final")
+    fsync_dir(os.path.dirname(path))
+
+def reader(path):
+    with open(path) as f:
+        return f.read()
+
+def stash_handle(path):
+    # open for append, nothing written here: the fsync lives in flush()
+    return open(path, "ab")
+"""
+    assert lint_sources(
+        {"har_tpu/serve/journal.py": src}, [DurabilityRule()]
+    ) == []
+
+
+def test_hl005_scope_is_durability_modules_only():
+    src = 'def f(p, d):\n    with open(p, "w") as fh:\n        fh.write(d)\n'
+    assert lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DurabilityRule()]
+    ) == []
+    assert len(lint_sources(
+        {"har_tpu/serve/journal.py": src}, [DurabilityRule()]
+    )) == 1
+
+
+def test_hl005_real_registry_is_durable_regression():
+    """Regression for the finding harlint surfaced at introduction: a
+    version's registry.json was written with a bare buffered
+    open/json.dump (no fsync) — a crash could leave CURRENT pointing at
+    a version whose metadata is torn.  The real registry.py must lint
+    clean, and un-fixing the write must re-flag."""
+    real = (REPO / "har_tpu" / "adapt" / "registry.py").read_text()
+    assert lint_sources(
+        {"har_tpu/adapt/registry.py": real}, [DurabilityRule()]
+    ) == []
+    unfixed = real.replace(
+        "_atomic_write(\n                os.path.join(path, _META), "
+        "json.dumps(meta, indent=1)\n            )",
+        'with open(os.path.join(path, _META), "w") as f:\n'
+        "                json.dump(meta, f, indent=1)",
+    )
+    assert unfixed != real, "registry.py meta-write anchor changed"
+    findings = lint_sources(
+        {"har_tpu/adapt/registry.py": unfixed}, [DurabilityRule()]
+    )
+    assert _rules_of(findings) == {"HL005"}
+
+
+# ----------------------------------------------------- baseline + repo
+
+
+def test_baseline_round_trip_and_suppression(tmp_path):
+    src = "import time\nnow = time.time()\n"
+    findings = lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    )
+    assert len(findings) == 1
+    path = tmp_path / "base.json"
+    assert write_baseline(path, findings) == 1
+    fresh, n = apply_baseline(findings, load_baseline(path))
+    assert fresh == [] and n == 1
+    # keys are line-number independent: shifting the file by a comment
+    # line still matches the committed entry
+    shifted = lint_sources(
+        {"har_tpu/serve/engine.py": "# moved\n" + src}, [DeterminismRule()]
+    )
+    fresh2, n2 = apply_baseline(shifted, load_baseline(path))
+    assert fresh2 == [] and n2 == 1
+
+
+def test_update_baseline_on_path_subset_preserves_other_entries(tmp_path):
+    """`--update-baseline` over a path subset must not silently retire
+    reviewed entries for files the run never examined — only a run
+    that re-lints a file owns that file's entries."""
+    serve_src = "import time\na = time.time()\n"
+    adapt_src = "import time\nb = time.time()\n"
+    pkg = tmp_path / "har_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "adapt").mkdir()
+    (pkg / "serve" / "engine.py").write_text(serve_src)
+    (pkg / "adapt" / "trigger.py").write_text(adapt_src)
+    base = tmp_path / "base.json"
+    # full run baselines both findings
+    r = run_harlint(root=tmp_path, baseline=base, update_baseline=True)
+    assert r.ok and r.baselined == 2
+    # subset re-run with --update-baseline: serve/ is now clean, so its
+    # entry retires — but adapt/'s reviewed entry must survive
+    (pkg / "serve" / "engine.py").write_text("a = 1\n")
+    r2 = run_harlint(
+        root=tmp_path, paths=["har_tpu/serve"], baseline=base,
+        update_baseline=True,
+    )
+    assert r2.ok
+    entries = load_baseline(base)
+    assert len(entries) == 1
+    assert any("har_tpu/adapt/trigger.py" in e for e in entries)
+    # and the preserved entry still suppresses on the next full run
+    r3 = run_harlint(root=tmp_path, baseline=base)
+    assert r3.ok and r3.baselined == 1
+
+
+def test_analyze_package_is_stdlib_only():
+    """The release gate runs `har lint` before anything jax-shaped: no
+    module in har_tpu/analyze may import jax or numpy (and
+    har_tpu/__init__ tolerates a missing jax outright, so the
+    `lint = []` dependency group really is sufficient)."""
+    import ast as _ast
+
+    analyze_dir = REPO / "har_tpu" / "analyze"
+    for path in sorted(analyze_dir.glob("*.py")):
+        tree = _ast.parse(path.read_text())
+        for node in _ast.walk(tree):
+            names = []
+            if isinstance(node, _ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, _ast.ImportFrom):
+                names = [node.module or ""]
+            for n in names:
+                root_mod = n.split(".")[0]
+                assert root_mod not in ("jax", "numpy", "np"), (
+                    f"{path.name} imports {n} — har_tpu.analyze must "
+                    "stay pure-stdlib"
+                )
+    init_src = (REPO / "har_tpu" / "__init__.py").read_text()
+    assert "except ImportError" in init_src  # the jax-less guard
+
+
+def test_disable_suppression_counts():
+    src = "import time\nnow = time.time()  # harlint: disable=HL004\n"
+    assert lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    ) == []
+
+
+def test_suppression_does_not_bleed_to_next_line():
+    src = (
+        "import time\n"
+        "a = time.time()  # harlint: disable=HL004\n"
+        "b = time.time()\n"
+    )
+    findings = lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    )
+    assert [f.line for f in findings] == [3]
+
+
+def test_repo_lints_clean_with_committed_baseline():
+    """The merge-time contract: `har lint` on the real fileset reports
+    zero non-baselined findings, all five rules run, and the committed
+    baseline stays near-empty (reviewed escapes live as in-code
+    annotations, not baseline entries)."""
+    report = run_harlint()
+    assert report.ok, "\n" + report.render()
+    assert report.rules_run == [
+        "HL001", "HL002", "HL003", "HL004", "HL005",
+    ]
+    assert report.files >= 15  # serve + adapt + serving + durable
+    assert report.baseline_size <= 5  # near-empty by policy
+    # the reviewed in-code escapes are accounted, not invisible
+    assert report.annotation_suppressed >= 8
+
+
+def test_cli_lint_json_and_rc(capsys):
+    from har_tpu.cli import main
+
+    assert main(["lint", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["findings"] == 0
+    assert set(out["rules_run"]) == {
+        "HL001", "HL002", "HL003", "HL004", "HL005",
+    }
+    for key in ("suppressed", "baselined", "baseline_size"):
+        assert key in out
+
+
+def test_cli_lint_nonzero_on_finding(tmp_path, capsys):
+    """A tree with a violation exits 1 — what makes the release-gate
+    stage (and the acceptance mutations) actually refuse a snapshot."""
+    pkg = tmp_path / "har_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "engine.py").write_text("import time\nnow = time.time()\n")
+    report = run_harlint(root=tmp_path, baseline=tmp_path / "b.json")
+    assert not report.ok and len(report.findings) == 1
+
+    from har_tpu.cli import main
+
+    # the real repo, restricted to one clean file, still exits 0
+    assert main(["lint", "har_tpu/utils/durable.py", "--check"]) == 0
+    capsys.readouterr()

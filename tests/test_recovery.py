@@ -654,6 +654,40 @@ def test_stats_state_roundtrip_and_pre_journal_defaults():
     assert h.count == 0
 
 
+def test_stats_load_state_warns_and_counts_unknown_keys():
+    """Forward-compat (the runtime half of harlint HL002): a state dict
+    written by a NEWER FleetStats — extra counters, extra top-level
+    blocks, extra stage histograms — loads everything this version
+    knows, but the unknown keys are counted (``unknown_state_keys``)
+    and warned about, never silently dropped."""
+    s = FleetStats()
+    s.enqueued = 4
+    s.note_scored(4, "v1")
+    future = json.loads(json.dumps(s.state()))
+    future["counters"]["frobnications"] = 9  # a newer writer's counter
+    future["future_block"] = {"x": 1}  # a newer top-level section
+    future["stages"]["teleport"] = {"count": 1}  # a newer stage
+    s2 = FleetStats()
+    with pytest.warns(RuntimeWarning, match="unknown state keys"):
+        s2.load_state(future)
+    assert s2.unknown_state_keys == 3
+    # the known fields still loaded in full
+    assert s2.enqueued == 4 and s2.scored == 4
+    assert s2.accounting()["balanced"]
+    # the counter is itself durable state: it survives a round-trip
+    # (and accumulates if the downgrade happens again)
+    s3 = FleetStats()
+    s3.load_state(json.loads(json.dumps(s2.state())))
+    assert s3.unknown_state_keys == 3
+    assert "unknown_state_keys" in s2.snapshot()
+    # a same-version state round-trips silently (no false alarms)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        FleetStats().load_state(json.loads(json.dumps(s.state())))
+
+
 def test_cli_serve_journal_kill_and_resume(tmp_path, capsys):
     """Acceptance: `har serve --journal DIR --resume` survives a
     mid-run kill end to end — the resumed run recovers, re-delivers

@@ -126,6 +126,28 @@ def test_gate_log_carries_recovery_smoke_verdict():
     assert rec["recovery_ms"] >= 0
 
 
+def test_gate_log_carries_harlint_verdict():
+    """The static-analysis counterpart of the smoke verdicts: the gate
+    log must carry a green harlint run with the {rules_run, findings,
+    suppressed} stamp — all five fleet invariant rules executed, zero
+    non-baselined findings at the published snapshot."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    h = log.get("harlint")
+    assert h, (
+        "artifacts/test_gate.json lacks the harlint verdict — run "
+        "scripts/release_gate.py"
+    )
+    for key in ("rules_run", "findings", "suppressed"):
+        assert key in h
+    assert h["ok"] is True
+    assert h["findings"] == 0
+    assert set(h["rules_run"]) == {
+        "HL001", "HL002", "HL003", "HL004", "HL005",
+    }
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
