@@ -41,6 +41,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <a href="architecture.html">architecture</a> ·
 <a href="parallelism.html">parallelism</a> ·
 <a href="serving.html">serving</a> ·
+<a href="multihost.html">multihost</a> ·
 <a href="adaptation.html">adaptation</a> ·
 <a href="recovery.html">recovery</a> ·
 <a href="static_analysis.html">harlint</a> ·
@@ -68,8 +69,9 @@ def build() -> list[str]:
         # other .md files (SURVEY.md, BASELINE.md, the reference's
         # README.md) have no HTML export and must stay as written
         body = re.sub(
-            r'href="(index|architecture|parallelism|serving|adaptation'
-            r'|recovery|static_analysis|api|roofline|bilstm_profile)\.md"',
+            r'href="(index|architecture|parallelism|serving|multihost'
+            r'|adaptation|recovery|static_analysis|api|roofline'
+            r'|bilstm_profile)\.md"',
             r'href="\1.html"',
             body,
         )

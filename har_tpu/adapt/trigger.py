@@ -316,6 +316,19 @@ class RetrainTrigger:
         for sid in server.sessions:
             self.observe(sid, server.drift_report(sid))
 
+    def observe_workers(self, servers) -> None:
+        """Fleet-GLOBAL escalation across worker partitions (the
+        cluster control plane, har_tpu.serve.cluster): pull every
+        partition's latest reports into the ONE aggregator, so K
+        sessions drifting on a common channel fire the trigger no
+        matter how the router spread them across workers — the same
+        population event that would be invisible to K per-worker
+        triggers each seeing fewer than ``min_sessions`` of it.
+        Session ids must be cluster-unique (the router guarantees it:
+        a session lives on exactly one worker)."""
+        for server in servers:
+            self.observe_server(server)
+
     def hold(self) -> None:
         """Restart the cooldown without firing — called after a swap or
         rollback so the population event that just resolved cannot

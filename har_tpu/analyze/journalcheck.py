@@ -161,8 +161,15 @@ class JournalExhaustivenessRule(Rule):
                 chaos_ctx = ctx
                 kp, kp_node = _string_tuple(ctx.tree, "KILL_POINTS")
                 ekp, _ = _string_tuple(ctx.tree, "ENGINE_KILL_POINTS")
-                declared = kp | ekp
-                matrix_points = kp
+                # the cluster control plane's migration points
+                # (mid_migration / mid_handoff) join both bijections:
+                # they need chaos_point/_chaos call sites AND a
+                # _DEFAULT_AT occurrence calibration like any matrix
+                # point — a hand-off stage boundary without a matrix
+                # entry is a crash window no chaos run exercises
+                ckp, _ = _string_tuple(ctx.tree, "CLUSTER_KILL_POINTS")
+                declared = kp | ekp | ckp
+                matrix_points = kp | ckp
                 declared_node = kp_node
                 default_at = _dict_keys(ctx.tree, "_DEFAULT_AT")
             for node in ast.walk(ctx.tree):

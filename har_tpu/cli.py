@@ -231,6 +231,22 @@ def _parser() -> argparse.ArgumentParser:
                          "host_platform_device_count=N for a dry-run "
                          "mesh.  Needs a jitted model; the analytic "
                          "demo model falls back to host scoring")
+    sv.add_argument("--workers", type=int, default=0,
+                    help="run a multi-worker fleet cluster "
+                         "(har_tpu.serve.cluster): sessions partition "
+                         "across N journaled FleetServer workers behind "
+                         "a consistent-hash router with heartbeat "
+                         "failover and journal hand-off migration.  "
+                         "0/1 = the single-process engine.  Pairs with "
+                         "--kill-worker to demo a mid-run failover; "
+                         "--journal names the cluster root (default: a "
+                         "temp dir)")
+    sv.add_argument("--kill-worker", default=None,
+                    help="with --workers: SIGKILL this worker id (e.g. "
+                         "w0) partway through the drive — its sessions "
+                         "fail over to the survivors via journal "
+                         "hand-off and the summary reports the global "
+                         "conservation verdict")
     sv.add_argument("--max-delay-ms", type=float, default=50.0,
                     help="deadline: max time a due window waits for "
                          "batch coalescing")
@@ -718,6 +734,156 @@ def main(argv=None) -> int:
                 flush_every=args.journal_flush_every,
                 snapshot_every=args.journal_snapshot_every,
             )
+        if args.workers and args.workers > 1:
+            # multi-worker control plane (har_tpu.serve.cluster):
+            # sessions partition across N journaled FleetServers behind
+            # the consistent-hash router; --kill-worker demos a live
+            # failover (journal hand-off migration, global conservation)
+            if args.resume or args.adapt or args.mesh or args.checkpoint:
+                raise SystemExit(
+                    "--workers drives the analytic demo fleet; it does "
+                    "not combine with --resume/--adapt/--mesh/"
+                    "--checkpoint (each worker is an unmodified "
+                    "FleetServer — run those modes single-process)"
+                )
+            import shutil
+            import tempfile
+            import time as _time
+
+            from har_tpu.serve import FakeClock, FleetConfig
+            from har_tpu.serve.chaos import _drive_cluster
+            from har_tpu.serve.cluster import ClusterConfig, FleetCluster
+
+            cluster_tmp = None
+            root = args.journal
+            if root is None:
+                cluster_tmp = root = tempfile.mkdtemp(
+                    prefix="har_cluster_"
+                )
+            clock = FakeClock()
+            # the single-server --inject-stall-* flags apply per
+            # worker here (each worker gets its own fault hook on the
+            # shared fake clock) — requested fault injection must
+            # never be silently dropped
+            cluster_fault_hook_for = None
+            if args.inject_stall_every:
+                cluster_fault_hook_for = lambda wid: DispatchFaults(
+                    stall_every=args.inject_stall_every,
+                    stall_ms=args.inject_stall_ms,
+                    fake_clock=clock,
+                )
+            cluster = FleetCluster(
+                model,
+                root,
+                workers=args.workers,
+                fault_hook_for=cluster_fault_hook_for,
+                window=window,
+                hop=args.hop,
+                channels=channels,
+                smoothing=args.smoothing,
+                class_names=class_names,
+                fleet_config=FleetConfig(
+                    max_sessions=args.sessions,
+                    target_batch=args.target_batch,
+                    max_delay_ms=args.max_delay_ms,
+                    pipeline_depth=args.pipeline_depth,
+                ),
+                config=ClusterConfig(
+                    lease_s=0.5, probe_base_ms=20.0, probe_cap_ms=200.0
+                ),
+                journal_config=journal_cfg,
+                clock=clock,
+            )
+            try:
+                from har_tpu.monitoring import DriftMonitor
+
+                for i in range(args.sessions):
+                    cluster.add_session(
+                        i,
+                        monitor=(
+                            DriftMonitor(*monitor_ref)
+                            if monitor_ref is not None
+                            else None
+                        ),
+                    )
+                if args.kill_worker is not None and (
+                    args.kill_worker not in cluster.workers
+                ):
+                    raise SystemExit(
+                        f"--kill-worker {args.kill_worker!r}: cluster "
+                        f"workers are {list(cluster.workers)}"
+                    )
+                events = []
+                cursors = [0] * args.sessions
+                killed = {"done": False}
+
+                def on_round(c):
+                    # SIGKILL the named worker once windows are flowing
+                    # — the failure detector + journal hand-off then
+                    # migrate its partition live
+                    if (
+                        args.kill_worker is not None
+                        and not killed["done"]
+                        and c.accounting()["scored"] > 0
+                        and args.kill_worker in c._workers
+                    ):
+                        c._workers[args.kill_worker].kill()
+                        killed["done"] = True
+
+                t0 = _time.perf_counter()
+                _drive_cluster(
+                    cluster,
+                    recordings,
+                    cursors,
+                    max(map(len, recordings)),
+                    args.hop,
+                    clock,
+                    events,
+                    on_round,
+                )
+                duration = _time.perf_counter() - t0
+                stats = cluster.cluster_stats()
+                acct = stats["accounting"]
+                print(
+                    json.dumps(
+                        {
+                            "sessions": args.sessions,
+                            "workers": stats["workers"],
+                            "n_events": len(events),
+                            "enqueued": acct["enqueued"],
+                            "scored": acct["scored"],
+                            "dropped": acct["dropped"],
+                            "pending": acct["pending"],
+                            "balanced": acct["balanced"],
+                            "windows_per_sec": (
+                                round(acct["scored"] / duration, 1)
+                                if duration
+                                else None
+                            ),
+                            "failovers": stats["failovers"],
+                            "migrated_sessions": stats[
+                                "migrated_sessions"
+                            ],
+                            "migration_ms": stats["migration_ms"],
+                            "per_worker_sessions": stats[
+                                "per_worker_sessions"
+                            ],
+                            "retired": stats["retired"],
+                            "killed_worker": (
+                                args.kill_worker
+                                if killed["done"]
+                                else None
+                            ),
+                            "cluster_root": root,
+                        }
+                    )
+                )
+                cluster.close()
+            finally:
+                if cluster_tmp is not None:
+                    shutil.rmtree(cluster_tmp, ignore_errors=True)
+            return 0
+
         recovered_events = []
         if args.resume:
             if not args.journal:

@@ -12,17 +12,24 @@ Public surface:
   FleetJournal / JournalConfig            — durability (journal.py)
   restore_server / recovery_smoke         — crash recovery (recover.py)
   KILL_POINTS / run_kill_point            — kill-point chaos (chaos.py)
+  CLUSTER_KILL_POINTS / run_cluster_kill_point — worker-axis chaos
   fleet_slo_smoke / fleet_pipeline_smoke  — the release gate's checks
+  har_tpu.serve.cluster                   — multi-worker control plane
+                                            (FleetCluster: router,
+                                            heartbeat failover, journal
+                                            hand-off migration)
 
 See docs/serving.md for the architecture and the equivalence contract,
 docs/recovery.md for the journal format and the recovery invariants.
 """
 
 from har_tpu.serve.chaos import (
+    CLUSTER_KILL_POINTS,
     ENGINE_KILL_POINTS,
     KILL_POINTS,
     KillPlan,
     SimulatedCrash,
+    run_cluster_kill_point,
     run_kill_point,
     run_random_kill,
 )
@@ -71,6 +78,8 @@ from har_tpu.serve.stats import FleetStats, StageHistogram
 __all__ = [
     "AdmissionError",
     "AnalyticDemoModel",
+    "CLUSTER_KILL_POINTS",
+    "run_cluster_kill_point",
     "DeliveryFaults",
     "DispatchError",
     "DispatchFaults",

@@ -1,0 +1,54 @@
+"""Multi-worker fleet control plane: consistent-hash routing, heartbeat
+failover, journal hand-off session migration, cross-worker conservation.
+
+Public surface:
+  FleetCluster / ClusterConfig / ClusterError — the controller
+  ConsistentHashRouter / stable_hash         — session partitioning
+  Membership / LeaseConfig / WorkerUnavailable — failure detection
+  ClusterWorker                              — one FleetServer worker
+  broadcast / map_fn / reduce_sum / reduce_mean — DrJAX-style
+                                               aggregation primitives
+  cluster_failover_smoke                     — the release gate's check
+
+See docs/multihost.md for the lease protocol, the hand-off sequence and
+the cross-worker conservation law.
+"""
+
+from har_tpu.serve.cluster.controller import (
+    RETIRED_MARKER,
+    ClusterConfig,
+    ClusterError,
+    FleetCluster,
+)
+from har_tpu.serve.cluster.membership import (
+    LeaseConfig,
+    Membership,
+    WorkerUnavailable,
+)
+from har_tpu.serve.cluster.primitives import (
+    broadcast,
+    map_fn,
+    reduce_mean,
+    reduce_sum,
+)
+from har_tpu.serve.cluster.router import ConsistentHashRouter, stable_hash
+from har_tpu.serve.cluster.smoke import cluster_failover_smoke
+from har_tpu.serve.cluster.worker import ClusterWorker
+
+__all__ = [
+    "RETIRED_MARKER",
+    "ClusterConfig",
+    "ClusterError",
+    "ClusterWorker",
+    "ConsistentHashRouter",
+    "FleetCluster",
+    "LeaseConfig",
+    "Membership",
+    "WorkerUnavailable",
+    "broadcast",
+    "cluster_failover_smoke",
+    "map_fn",
+    "reduce_mean",
+    "reduce_sum",
+    "stable_hash",
+]

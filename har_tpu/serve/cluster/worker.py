@@ -1,0 +1,92 @@
+"""One cluster worker: an unmodified FleetServer + its PR-4 journal,
+behind a process-boundary shim.
+
+The worker wrapper is deliberately thin — the whole point of the
+cluster design is that a worker is the SAME crash-safe engine the
+single-process fleet runs (``FleetServer`` + ``FleetJournal``), so
+every per-worker guarantee (conservation law, ack boundary, chaos
+matrix) carries over verbatim.  What the wrapper adds is the failure
+surface a real process boundary has: once a worker is killed, every
+call raises ``WorkerUnavailable`` instead of touching dead state —
+which is exactly the evidence the membership layer's failure detector
+consumes.
+
+``kill()`` is the in-process SIGKILL model, same stance as the chaos
+harness: process memory is gone (the wrapper refuses all further
+calls) and the journal drops its un-flushed buffer
+(``FleetJournal.kill``) — what is on disk afterwards is exactly what a
+real kill would have left.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable
+
+from har_tpu.serve.cluster.membership import WorkerUnavailable
+
+
+class ClusterWorker:
+    """A FleetServer bound to a worker id and a journal directory."""
+
+    def __init__(self, worker_id, server, journal_dir: str):
+        self.worker_id = worker_id
+        self.server = server
+        self.journal_dir = journal_dir
+        self.alive = True
+
+    def _guard(self) -> None:
+        if not self.alive:
+            raise WorkerUnavailable(
+                f"worker {self.worker_id!r} is not responding"
+            )
+
+    # ----------------------------------------------------- the "RPCs"
+
+    def heartbeat(self) -> bool:
+        """The membership probe: cheap, no fleet state touched."""
+        self._guard()
+        return True
+
+    def push(self, session_id: Hashable, samples) -> int:
+        self._guard()
+        return self.server.push(session_id, samples)
+
+    def poll(self, *, force: bool = False) -> list:
+        self._guard()
+        return self.server.poll(force=force)
+
+    def add_session(self, session_id: Hashable, *, monitor=None) -> None:
+        self._guard()
+        self.server.add_session(session_id, monitor=monitor)
+
+    def adopt(self, export: dict) -> None:
+        """Adopt a migrated session and make the adopt record durable
+        before returning — the target-side half of the hand-off
+        protocol's adopt-first ordering.  Idempotent: a retry after a
+        failed flush skips the admit and completes the durability."""
+        self._guard()
+        if export["sid"] not in self.server._sessions:
+            self.server.adopt_session(export)
+        if self.server.journal is not None:
+            self.server.journal.flush()
+
+    def owns(self, session_id: Hashable) -> bool:
+        return self.alive and session_id in self.server._sessions
+
+    def watermark(self, session_id: Hashable) -> int:
+        self._guard()
+        return self.server.watermark(session_id)
+
+    # ----------------------------------------------------- lifecycle
+
+    def kill(self) -> None:
+        """SIGKILL model: refuse all further calls, drop the journal's
+        un-flushed buffer.  Idempotent."""
+        self.alive = False
+        if self.server.journal is not None:
+            self.server.journal.kill()
+
+    def close(self) -> None:
+        if self.alive and self.server.journal is not None:
+            self.server.journal.close()
+        self.alive = False

@@ -64,9 +64,11 @@ from har_tpu.serve.dispatch import (
 from har_tpu.serve.journal import (
     FleetJournal,
     JournalConfig,
+    monitor_from_state,
     monitor_state,
 )
 from har_tpu.serve.stats import FleetStats
+from har_tpu.utils.backoff import Backoff, retry_call
 from har_tpu.serving import (
     StreamEvent,
     _Smoother,
@@ -186,7 +188,8 @@ class _FleetSession:
     """Per-session state: ring buffer + smoother + bounded queue."""
 
     __slots__ = ("sid", "asm", "smoother", "pending", "n_live",
-                 "n_enqueued", "n_scored", "n_dropped", "raw_seen")
+                 "n_enqueued", "n_scored", "n_dropped", "raw_seen",
+                 "handoffs")
 
     def __init__(self, sid, asm, smoother):
         self.sid = sid
@@ -204,6 +207,12 @@ class _FleetSession:
         # stream coordinates, or one rejected NaN row would shift every
         # post-crash re-delivery by one sample
         self.raw_seen = 0
+        # cluster hand-off generation: bumped every time this session is
+        # ADOPTED onto a worker (har_tpu.serve.cluster).  A crash mid-
+        # hand-off can leave the session on both the source and the
+        # target journal; the copy with the higher generation is the
+        # adopted one and wins the dual-ownership resolution.
+        self.handoffs = 0
 
 
 class FleetServer:
@@ -305,6 +314,12 @@ class FleetServer:
         # dispatch tap (shadow evaluation): called AFTER a batch's
         # events are finalized, off the per-event latency path
         self._dispatch_tap: Callable | None = None
+        # retry pacing (har_tpu.utils.backoff): the ONE policy the
+        # dispatch retry loop and the cluster control plane share.  The
+        # hot path never sleeps on it (retry_call gets sleep=None) but
+        # consuming/resetting the schedule here keeps the two retry
+        # surfaces on the same accounting
+        self._retry_backoff = Backoff(seed=0)
         # durability (har_tpu.serve.journal): an attached journal makes
         # every mutation below crash-recoverable; _replaying suppresses
         # re-journaling while recovery replays the suffix through these
@@ -408,6 +423,7 @@ class FleetServer:
                     "n_enqueued": sess.n_enqueued,
                     "n_scored": sess.n_scored,
                     "n_dropped": sess.n_dropped,
+                    "handoffs": sess.handoffs,
                     "votes": list(sm._votes),
                     "monitor": monitor_state(asm.monitor),
                 }
@@ -606,6 +622,148 @@ class FleetServer:
         # replay re-derives the dropped windows from the same queue
         # state, so the record carries only the eviction itself
         self._jappend({"t": "remove", "sid": session_id})
+
+    # ------------------------------------------- cluster hand-off
+    # (har_tpu.serve.cluster: live session migration between workers.
+    # The protocol is adopt-first: the target journals the session's
+    # full exported state durably BEFORE the source evicts it, so a
+    # crash anywhere in between leaves the session on at least one
+    # journal — dual ownership resolves by the higher `handoffs`
+    # generation, never by losing the stream.)
+
+    def export_session(self, session_id: Hashable) -> dict:
+        """Serialize one session's complete live state for a hand-off:
+        ring buffer, watermark, smoother, drift monitor, per-session
+        counters and the hand-off generation.  Refuses while the
+        session has live (queued or in-flight) windows — the cluster
+        drains first (``flush``); moving an un-scored window between
+        journals would fork its ack trail across two recovery logs."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise AdmissionError(f"unknown session {session_id!r}")
+        if sess.n_live:
+            raise AdmissionError(
+                f"session {session_id!r} has {sess.n_live} live "
+                "window(s); drain (flush) before hand-off"
+            )
+        sm = sess.smoother
+        return {
+            "sid": session_id,
+            "ring": sess.asm._ring.copy(),
+            "n_seen": sess.asm._n_seen,
+            "raw_seen": sess.raw_seen,
+            "next_emit": sess.asm._next_emit,
+            "n_enqueued": sess.n_enqueued,
+            "n_scored": sess.n_scored,
+            "n_dropped": sess.n_dropped,
+            "handoffs": sess.handoffs,
+            "votes": list(sm._votes),
+            "ema": (
+                None if sm._ema is None
+                else np.asarray(sm._ema, np.float64)
+            ),
+            "monitor": monitor_state(sess.asm.monitor),
+        }
+
+    def adopt_session(self, export: dict) -> None:
+        """Admit a migrated session WITH its exported live state — the
+        receiving half of a cluster hand-off.  The stream continues
+        exactly where the source froze it: same ring, same smoother,
+        same drift episode, same watermark — so the transport resumes
+        delivery at ``watermark(sid)`` and the event stream is
+        bit-identical to one that never moved (test-pinned).  Journaled
+        as an ``adopt`` record carrying the full state, so THIS
+        worker's own crash recovery rebuilds the migrated session.
+        Bumps the session's ``handoffs`` generation (dual-ownership
+        tie-break) and ``stats.migrations``."""
+        sid = export["sid"]
+        if sid in self._sessions:
+            raise AdmissionError(f"session {sid!r} already admitted")
+        if len(self._sessions) >= self.config.max_sessions:
+            self.stats.admission_rejections += 1
+            raise AdmissionError(
+                f"fleet full ({self.config.max_sessions} sessions); "
+                "cannot adopt — raise FleetConfig.max_sessions"
+            )
+        monitor = monitor_from_state(export.get("monitor"))
+        sess = _FleetSession(
+            sid,
+            _WindowAssembler(
+                self.window, self.hop, self.channels, monitor=monitor
+            ),
+            _Smoother(self.smoothing, self.ema_alpha, self.vote_depth),
+        )
+        ring = np.asarray(export["ring"], np.float32)
+        if ring.shape != sess.asm._ring.shape:
+            raise ValueError(
+                f"exported ring shape {ring.shape} does not match this "
+                f"fleet's geometry {sess.asm._ring.shape} — sessions "
+                "migrate only between geometry-identical workers"
+            )
+        sess.asm._ring[:] = ring
+        sess.asm._n_seen = int(export["n_seen"])
+        sess.asm._next_emit = int(export["next_emit"])
+        sess.raw_seen = int(export["raw_seen"])
+        sess.n_enqueued = int(export.get("n_enqueued", 0))
+        sess.n_scored = int(export.get("n_scored", 0))
+        sess.n_dropped = int(export.get("n_dropped", 0))
+        sess.handoffs = int(export.get("handoffs", 0)) + 1
+        ema = export.get("ema")
+        if ema is not None:
+            sess.smoother._ema = np.asarray(ema, np.float64)
+        sess.smoother._votes = deque(
+            (int(v) for v in export.get("votes") or []),
+            maxlen=self.vote_depth,
+        )
+        self._sessions[sid] = sess
+        self.stats.sessions = len(self._sessions)
+        self.stats.migrations += 1
+        payload = ring.tobytes()
+        if ema is not None:
+            payload += np.asarray(ema, np.float64).tobytes()
+        self._jappend(
+            {
+                "t": "adopt",
+                "sid": sid,
+                "n_seen": sess.asm._n_seen,
+                "raw_seen": sess.raw_seen,
+                "next_emit": sess.asm._next_emit,
+                "n_enqueued": sess.n_enqueued,
+                "n_scored": sess.n_scored,
+                "n_dropped": sess.n_dropped,
+                "handoffs": sess.handoffs,
+                "votes": [int(v) for v in sess.smoother._votes],
+                "ema": ema is not None,
+                "mon": monitor_state(monitor),
+            },
+            payload,
+        )
+
+    def handoff_session(self, session_id: Hashable) -> dict:
+        """The source half of a hand-off: export the session's state
+        and evict it WITHOUT dropping anything (``export_session``'s
+        drain guarantee means there is nothing live to drop — unlike
+        ``remove_session`` this is a move, not a tear-down).  Journaled
+        as a ``handoff`` record so the source's own recovery re-applies
+        the eviction; returns the export for the adopter."""
+        export = self.export_session(session_id)
+        self._apply_handoff(session_id)
+        self._jappend({"t": "handoff", "sid": session_id})
+        return export
+
+    def _apply_handoff(self, session_id: Hashable) -> None:
+        """Shared by the live hand-off and its journal replay: pop the
+        session off the fleet, checking the drain guarantee held."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise AdmissionError(f"unknown session {session_id!r}")
+        if sess.n_live:  # pragma: no cover - export_session guards this
+            raise AdmissionError(
+                f"hand-off of {session_id!r} with {sess.n_live} live "
+                "window(s)"
+            )
+        del self._sessions[session_id]
+        self.stats.sessions = len(self._sessions)
 
     @property
     def sessions(self) -> tuple:
@@ -975,19 +1133,31 @@ class FleetServer:
             self.stats.note_device_windows(
                 label, ticket.pad_k // scorer.devices
             )
-        while True:  # launch attempts (fault hook + async dispatch)
-            try:
-                if self._fault_hook is not None:
-                    self._fault_hook(ticket.windows)
-                ticket.handle = scorer.launch(ticket.windows)
-                break
-            except Exception as exc:
-                ticket.last_error = exc
-                ticket.attempts += 1
-                if ticket.attempts > cfg.retries:
-                    ticket.failed = True
-                    break
-                self.stats.dispatch_retries += 1
+        # launch attempts (fault hook + async dispatch), paced by the
+        # shared retry loop (har_tpu.utils.backoff.retry_call) with
+        # sleep=None: the dispatch hot path NEVER blocks on a backoff
+        # delay — the schedule advances for accounting only
+        def _attempt_launch():
+            if self._fault_hook is not None:
+                self._fault_hook(ticket.windows)
+            return scorer.launch(ticket.windows)
+
+        def _note_retry(attempt, exc):
+            ticket.last_error = exc
+            ticket.attempts += 1
+            self.stats.dispatch_retries += 1
+
+        try:
+            ticket.handle = retry_call(
+                _attempt_launch,
+                retries=cfg.retries,
+                backoff=self._retry_backoff,
+                on_retry=_note_retry,
+            )
+        except Exception as exc:
+            ticket.last_error = exc
+            ticket.attempts += 1
+            ticket.failed = True
         self._chaos("mid_launch")
         return ticket
 
@@ -1011,14 +1181,28 @@ class FleetServer:
                 ticket.attempts += 1
         # fetch-time failures (async dispatch surfaces errors at the
         # blocking read) re-run the whole attempt synchronously with
-        # whatever retry budget the launch left unused
-        while probs is None and ticket.attempts <= cfg.retries:
-            self.stats.dispatch_retries += 1
-            try:
+        # whatever retry budget the launch left unused — the same
+        # shared retry loop as the launch side, sleep=None (hot path)
+        if probs is None and ticket.attempts <= cfg.retries:
+
+            def _attempt_sync():
+                self.stats.dispatch_retries += 1
                 if self._fault_hook is not None:
                     self._fault_hook(ticket.windows)
-                probs = ticket.scorer.fetch(
+                return ticket.scorer.fetch(
                     ticket.scorer.launch(ticket.windows), k
+                )
+
+            def _note_retry(attempt, exc):
+                ticket.last_error = exc
+                ticket.attempts += 1
+
+            try:
+                probs = retry_call(
+                    _attempt_sync,
+                    retries=cfg.retries - ticket.attempts,
+                    backoff=self._retry_backoff,
+                    on_retry=_note_retry,
                 )
             except Exception as exc:
                 ticket.last_error = exc

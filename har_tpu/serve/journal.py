@@ -264,16 +264,40 @@ class FleetJournal:
         self._segment = nxt
         self._fh = open(self._segment_path(nxt), "ab")
         self._since_snapshot = 0
-        for kind, idx in _list_indexed(self.root, _SEG_PREFIX):
-            if idx < nxt:
+        self.prune()
+        return snap
+
+    def prune(self) -> None:
+        """Delete journal state the newest rotation supersedes: segments
+        and snapshots below the current rotation point, and any torn
+        ``*.tmp`` snapshot directory a mid-snapshot kill left behind.
+        A torn tmp is invisible to recovery by construction
+        (``_list_indexed`` skips ``.tmp`` names, so ``load_journal``
+        never reads it) but each one holds a full state copy — a fleet
+        that crashes inside snapshots for a week must not fill the disk
+        with them.  Pinned in tests/test_recovery.py."""
+        for path, idx in _list_indexed(self.root, _SEG_PREFIX):
+            if idx < self._segment:
                 try:
-                    os.remove(kind)
+                    os.remove(path)
                 except OSError:
                     pass
-        for kind, idx in _list_indexed(self.root, _SNAP_PREFIX):
-            if idx < nxt:
-                shutil.rmtree(kind, ignore_errors=True)
-        return snap
+        for path, idx in _list_indexed(self.root, _SNAP_PREFIX):
+            if idx < self._segment:
+                shutil.rmtree(path, ignore_errors=True)
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.root, name)
+            # never the in-progress tmp: prune runs only from
+            # write_snapshot AFTER its rename, or between snapshots
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     # ------------------------------------------------------ lifecycle
 

@@ -183,6 +183,8 @@ def restore_server(
             sess.n_enqueued = int(s.get("n_enqueued", 0))
             sess.n_scored = int(s.get("n_scored", 0))
             sess.n_dropped = int(s.get("n_dropped", 0))
+            # pre-cluster snapshots have no hand-off generation
+            sess.handoffs = int(s.get("handoffs", 0))
             ema = arrays.get(f"ema{i}")
             if ema is not None:
                 sess.smoother._ema = np.asarray(ema, np.float64)
@@ -255,6 +257,47 @@ def restore_server(
                 if on and not server._smoothing_shed:
                     server.stats.smoothing_shed_transitions += 1
                 server._smoothing_shed = on
+            elif t == "adopt":
+                # cluster hand-off, receiving half: rebuild the migrated
+                # session from the record's full state payload (ring
+                # float32, then the EMA float64 when meta["ema"]) —
+                # the same adopt_session path the live migration ran.
+                # The stored `handoffs` already counts this adoption;
+                # adopt_session re-bumps, so hand it the predecessor's.
+                window = geo["window"]
+                ring_bytes = window * channels * 4
+                ema = None
+                if meta.get("ema"):
+                    ema = np.frombuffer(payload[ring_bytes:], np.float64)
+                server.adopt_session(
+                    {
+                        "sid": meta["sid"],
+                        "ring": np.frombuffer(
+                            payload[:ring_bytes], np.float32
+                        ).reshape(window, channels),
+                        "n_seen": meta["n_seen"],
+                        "raw_seen": meta["raw_seen"],
+                        "next_emit": meta["next_emit"],
+                        "n_enqueued": meta.get("n_enqueued", 0),
+                        "n_scored": meta.get("n_scored", 0),
+                        "n_dropped": meta.get("n_dropped", 0),
+                        "handoffs": int(meta.get("handoffs", 1)) - 1,
+                        "votes": meta.get("votes") or [],
+                        "ema": ema,
+                        "monitor": meta.get("mon"),
+                    }
+                )
+            elif t == "handoff":
+                # cluster hand-off, source half: the session moved to
+                # another worker — evict without dropping (the drain
+                # guarantee re-derives: replay reaches this record with
+                # the session's queue empty, or the journal is corrupt)
+                if meta["sid"] not in server._sessions:
+                    raise RecoveryError(
+                        f"handoff record for unknown session "
+                        f"{meta['sid']!r}"
+                    )
+                server._apply_handoff(meta["sid"])
             elif t == "lost":
                 server.declare_lost(meta["sid"], int(meta["pos"]))
             elif t == "adapt":

@@ -173,6 +173,14 @@ class FleetStats:
         self.inflight_ms = 0.0
         self.inflight_depth: dict[int, int] = {}
         self.device_windows: dict[str, int] = {}
+        # cluster control plane (har_tpu.serve.cluster): dead-worker
+        # failovers this worker absorbed sessions from, sessions adopted
+        # onto this worker via journal hand-off, and the total wall time
+        # those hand-offs took (receiver-side; a duration accumulator
+        # like overlap_host_ms, not an event count)
+        self.worker_failovers = 0
+        self.migrations = 0
+        self.migration_ms = 0.0
         # forward-compat guard (the runtime half of harlint HL002):
         # state keys a NEWER writer persisted that this version does
         # not know — counted and warned in load_state, never silently
@@ -289,6 +297,9 @@ class FleetStats:
             "shadow_batches": self.shadow_batches,
             "shadow_windows": self.shadow_windows,
             "shadow_errors": self.shadow_errors,
+            "worker_failovers": self.worker_failovers,
+            "migrations": self.migrations,
+            "migration_ms": round(self.migration_ms, 3),
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
             "overlap_pct": self.overlap_pct(),
@@ -317,6 +328,7 @@ class FleetStats:
         "admission_rejections", "queue_depth_max", "rejected_samples",
         "recoveries", "lost_in_crash", "model_swaps", "rollbacks",
         "shadow_batches", "shadow_windows", "shadow_errors",
+        "worker_failovers", "migrations",
         "unknown_state_keys",
     )
     _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")
@@ -326,7 +338,7 @@ class FleetStats:
     _STATE_KEYS = (
         "counters", "dropped", "batch_sizes", "scored_by_version",
         "overlap_host_ms", "inflight_ms", "inflight_depth",
-        "device_windows", "stages",
+        "device_windows", "migration_ms", "stages",
     )
 
     def state(self) -> dict:
@@ -341,6 +353,7 @@ class FleetStats:
             "scored_by_version": dict(self.scored_by_version),
             "overlap_host_ms": self.overlap_host_ms,
             "inflight_ms": self.inflight_ms,
+            "migration_ms": self.migration_ms,
             "inflight_depth": {
                 str(k): v for k, v in self.inflight_depth.items()
             },
@@ -383,6 +396,8 @@ class FleetStats:
             )
         self.overlap_host_ms = float(state.get("overlap_host_ms", 0.0))
         self.inflight_ms = float(state.get("inflight_ms", 0.0))
+        # pre-cluster state dicts lack migration_ms: default 0.0
+        self.migration_ms = float(state.get("migration_ms", 0.0))
         self.inflight_depth = {
             int(k): int(v)
             for k, v in (state.get("inflight_depth") or {}).items()

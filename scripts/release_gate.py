@@ -46,11 +46,18 @@ What it does:
      stage boundaries and recovered — accounting intact, zero windows
      lost, acked scores bit-identical to an uninterrupted run; red
      refuses the snapshot.
-  6. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+  6. Runs the cluster-failover smoke (``har_tpu.serve.cluster.smoke.
+     cluster_failover_smoke``): 3 workers, one SIGKILLed mid-dispatch
+     — its sessions must migrate to the survivors via journal hand-off
+     with global conservation, zero double-scored events and
+     bit-identical migrated streams; red refuses the snapshot.
+  7. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
      the fleet ``{sessions, p99_ms, dropped}`` verdict, the adapt
      ``{swaps, rollbacks, shadow_agreement}`` verdict, the recovery
      ``{kill_points, recovered, windows_lost, recovery_ms}`` stamp,
-     git HEAD — the run log the README numbers trace back to.
+     the cluster ``{workers, failovers, migrated_sessions,
+     windows_lost, migration_ms}`` stamp, git HEAD — the run log the
+     README numbers trace back to.
 
 The end-of-round snapshot workflow is: run this, commit only on rc 0.
 """
@@ -189,6 +196,17 @@ def _recovery_smoke() -> dict:
     return _run_smoke("har_tpu.serve.recover", "recovery_smoke")
 
 
+def _cluster_smoke() -> dict:
+    """Cluster-failover smoke verdict: 3 workers, one SIGKILLed
+    mid-dispatch — heartbeat death detection, journal hand-off
+    migration to the survivors, global conservation + zero
+    double-scored + migrated streams bit-identical to the un-killed
+    run (har_tpu.serve.cluster.smoke.cluster_failover_smoke)."""
+    return _run_smoke(
+        "har_tpu.serve.cluster.smoke", "cluster_failover_smoke"
+    )
+
+
 def _harlint() -> dict:
     """harlint verdict (`har lint --check --json`): the five fleet
     invariant rules (hot-path purity HL001, state completeness HL002,
@@ -280,24 +298,27 @@ def main(argv=None) -> int:
     pipeline = None
     adapt = None
     recovery = None
+    cluster = None
     harlint = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
-        # + harlint verdicts forward: a counts-only refresh must not
-        # blank the serving evidence the suite's gate-log test pins
-        # (only a full gate run regenerates)
+        # + cluster + harlint verdicts forward: a counts-only refresh
+        # must not blank the serving evidence the suite's gate-log test
+        # pins (only a full gate run regenerates)
         try:
             prior = json.loads(GATE_LOG.read_text())
             fleet = prior.get("fleet_slo")
             pipeline = prior.get("fleet_pipeline")
             adapt = prior.get("adapt_smoke")
             recovery = prior.get("recovery_smoke")
+            cluster = prior.get("cluster_failover")
             harlint = prior.get("harlint")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
             adapt = None
             recovery = None
+            cluster = None
             harlint = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
@@ -372,6 +393,19 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # cluster gate: one worker of three SIGKILLed mid-dispatch —
+        # failover must migrate its partition with global conservation,
+        # zero double-scored and bit-identical migrated streams,
+        # stamping {workers, failovers, migrated_sessions,
+        # windows_lost, migration_ms} below
+        cluster = _cluster_smoke()
+        if not cluster.get("ok"):
+            print(
+                "\nrelease_gate: RED cluster failover smoke "
+                f"({json.dumps(cluster)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -386,6 +420,7 @@ def main(argv=None) -> int:
                 "fleet_pipeline": pipeline,
                 "adapt_smoke": adapt,
                 "recovery_smoke": recovery,
+                "cluster_failover": cluster,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -408,6 +443,9 @@ def main(argv=None) -> int:
                 "adapt_smoke_ok": None if adapt is None else adapt["ok"],
                 "recovery_smoke_ok": (
                     None if recovery is None else recovery["ok"]
+                ),
+                "cluster_failover_ok": (
+                    None if cluster is None else cluster["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

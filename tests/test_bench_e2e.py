@@ -84,7 +84,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
-        "fleet_recovery",
+        "fleet_recovery", "cluster_failover",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -160,6 +160,25 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == recovery["recovery_ms_median"]
         )
         assert extra["fleet_recovery_contract_ok"] is True
+    # r12 cluster-failover lane: failover latency vs fleet size for the
+    # multi-worker control plane, with the cross-worker conservation
+    # law pinned per measured run, or a deadline-skip marker; never
+    # silently absent
+    failover = extra["lanes"]["cluster_failover"]
+    if "skipped" not in failover:
+        assert failover["n_runs"] >= 3
+        assert failover["contract_ok"] is True
+        assert failover["failover_ms_median"] > 0
+        for row in failover["rows"]:
+            assert row["workers"] == 3
+            assert row["migrated_sessions"] > 0
+            assert row["failover_ms_median"] > 0
+        assert "chip_state_probe" in failover
+        assert (
+            extra["cluster_failover_ms_median"]
+            == failover["failover_ms_median"]
+        )
+        assert extra["cluster_failover_contract_ok"] is True
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",

@@ -317,6 +317,7 @@ def test_hl003_acceptance_real_recover_minus_lost_handler():
         "har_tpu/serve/recover.py",
         "har_tpu/serve/chaos.py",
         "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -331,6 +332,73 @@ def test_hl003_acceptance_real_recover_minus_lost_handler():
     msgs = " | ".join(f.message for f in findings)
     assert "'lost'" in msgs and "no replay handler" in msgs
     assert "'__deleted__'" in msgs  # the dead handler is flagged too
+
+
+def test_hl003_acceptance_cluster_handoff_handler_and_kill_points():
+    """The cluster extension of the acceptance mutation: HL003's
+    bijection sets now cover the hand-off record types and the
+    CLUSTER_KILL_POINTS — deleting the `handoff` replay handler from
+    the REAL recover.py, or dropping `mid_handoff` from the declared
+    cluster matrix, must each fail the gate."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JournalExhaustivenessRule()]) == []
+    # (1) deleting the hand-off replay handler orphans the record the
+    # source worker writes at every migration — a crash after a
+    # rebalance would resurrect the moved session on BOTH workers
+    mutated = dict(sources)
+    mutated["har_tpu/serve/recover.py"] = sources[
+        "har_tpu/serve/recover.py"
+    ].replace('elif t == "handoff":', 'elif t == "__deleted__":')
+    assert (
+        mutated["har_tpu/serve/recover.py"]
+        != sources["har_tpu/serve/recover.py"]
+    )
+    msgs = " | ".join(
+        f.message
+        for f in lint_sources(mutated, [JournalExhaustivenessRule()])
+    )
+    assert "'handoff'" in msgs and "no replay handler" in msgs
+    assert "'__deleted__'" in msgs
+    # (2) the adopt record's handler is load-bearing the same way
+    mutated2 = dict(sources)
+    mutated2["har_tpu/serve/recover.py"] = sources[
+        "har_tpu/serve/recover.py"
+    ].replace('elif t == "adopt":', 'elif t == "__gone__":')
+    msgs2 = " | ".join(
+        f.message
+        for f in lint_sources(mutated2, [JournalExhaustivenessRule()])
+    )
+    assert "'adopt'" in msgs2 and "no replay handler" in msgs2
+    # (3) dropping mid_handoff from the declared cluster matrix leaves
+    # the controller's chaos call site un-exercised — flagged, plus
+    # its stale _DEFAULT_AT calibration is NOT flagged (only matrix
+    # points need one)
+    mutated3 = dict(sources)
+    mutated3["har_tpu/serve/chaos.py"] = sources[
+        "har_tpu/serve/chaos.py"
+    ].replace(
+        'CLUSTER_KILL_POINTS = ("mid_handoff", "mid_migration")',
+        'CLUSTER_KILL_POINTS = ("mid_migration",)',
+    )
+    assert (
+        mutated3["har_tpu/serve/chaos.py"]
+        != sources["har_tpu/serve/chaos.py"]
+    )
+    msgs3 = " | ".join(
+        f.message
+        for f in lint_sources(mutated3, [JournalExhaustivenessRule()])
+    )
+    assert "'mid_handoff'" in msgs3
+    assert "absent from the chaos matrix" in msgs3
 
 
 # --------------------------------------------------------------- HL004

@@ -732,3 +732,81 @@ def test_cli_serve_journal_kill_and_resume(tmp_path, capsys):
     assert out["enqueued"] == out["scored"] == 32
     assert out["stats"]["accounting"]["balanced"]
     assert out["stats"]["accounting"]["pending"] == 0
+
+
+def test_restore_ignores_torn_tmp_snapshot_and_prune_removes_it(tmp_path):
+    """A kill inside write_snapshot (before the atomic rename) leaves a
+    ``snap.<k>.tmp`` directory.  Regression pin: restore must ignore it
+    (the newest COMPLETE snapshot wins) and ``FleetJournal.prune()``
+    must remove it — a fleet that crashes inside snapshots must not
+    accumulate full state copies on disk."""
+    server = _journaled_server(tmp_path)
+    for i in range(2):
+        server.add_session(i)
+        server.push(i, np.random.default_rng(i).normal(
+            size=(150, 3)).astype(np.float32))
+    server.flush()
+    server.write_snapshot()
+    root = tmp_path / "j"
+    # a torn tmp left by a mid-snapshot kill: partial state, no rename
+    torn = root / "snap.99.tmp"
+    torn.mkdir()
+    (torn / "state.json").write_text('{"torn": tru')  # half-written
+    (torn / "arrays.npz").write_bytes(b"\x00garbage")
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(root), _StubModel())
+    # the torn tmp was invisible to recovery...
+    assert restored.stats.recoveries == 1
+    acct = restored.stats.accounting()
+    assert acct["balanced"]
+    assert len(restored.sessions) == 2
+    # ...and the restore's own recovery snapshot pruned it from disk
+    assert not torn.exists()
+    # prune() also clears a torn tmp dropped AFTER the last snapshot
+    torn2 = root / "snap.100.tmp"
+    torn2.mkdir()
+    (torn2 / "state.json").write_text("{}")
+    restored.journal.prune()
+    assert not torn2.exists()
+    restored.journal.close()
+
+
+def test_stats_cluster_counters_roundtrip_and_pre_cluster_defaults():
+    """The cluster control-plane counters (worker_failovers,
+    migrations, migration_ms) round-trip through state()/load_state,
+    and a pre-cluster state dict missing them loads with zero defaults
+    — both directions pinned (HL002's runtime contract)."""
+    s = FleetStats()
+    s.enqueued = 5
+    s.note_scored(5, "v1")
+    s.worker_failovers = 2
+    s.migrations = 7
+    s.migration_ms = 123.5
+    state = json.loads(json.dumps(s.state()))
+    s2 = FleetStats()
+    s2.load_state(state)
+    assert s2.worker_failovers == 2
+    assert s2.migrations == 7
+    assert s2.migration_ms == 123.5
+    assert s2.accounting() == s.accounting()
+    snap = s2.snapshot()
+    assert snap["worker_failovers"] == 2
+    assert snap["migrations"] == 7
+    assert snap["migration_ms"] == 123.5
+    # pre-cluster state: the fields absent entirely — zero defaults,
+    # and no unknown-key warning in either direction
+    old = json.loads(json.dumps(state))
+    old["counters"].pop("worker_failovers")
+    old["counters"].pop("migrations")
+    old.pop("migration_ms")
+    s3 = FleetStats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        s3.load_state(old)
+    assert s3.worker_failovers == 0
+    assert s3.migrations == 0
+    assert s3.migration_ms == 0.0
+    assert s3.accounting()["balanced"]

@@ -148,6 +148,33 @@ def test_gate_log_carries_harlint_verdict():
     }
 
 
+def test_gate_log_carries_cluster_failover_verdict():
+    """The multi-worker counterpart of the recovery verdict: the gate
+    log must carry a green cluster-failover check with the {workers,
+    failovers, migrated_sessions, windows_lost, migration_ms} stamp —
+    one of three workers SIGKILLed mid-dispatch, its partition migrated
+    to the survivors via journal hand-off, global conservation intact,
+    zero double-scored events, migrated streams bit-identical."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    cluster = log.get("cluster_failover")
+    assert cluster, (
+        "artifacts/test_gate.json lacks the cluster_failover verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "workers", "failovers", "migrated_sessions", "windows_lost",
+        "migration_ms",
+    ):
+        assert key in cluster
+    assert cluster["ok"] is True
+    assert cluster["failovers"] >= 1
+    assert cluster["migrated_sessions"] >= 1
+    assert cluster["windows_lost"] == 0
+    assert cluster["migration_ms"] >= 0
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
