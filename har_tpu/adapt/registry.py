@@ -111,6 +111,10 @@ class ModelRegistry:
 
     def __init__(self, root: str, *, clock: Callable[[], float] | None = None):
         self.root = os.path.abspath(os.path.expanduser(root))
+        # created_unix stamps are MEANT to be wall-clock (lineage
+        # records correlate with logs outside the process); the clock
+        # stays injectable, so tests are still deterministic
+        # harlint: disable=HL004
         self._clock = clock or time.time
         os.makedirs(os.path.join(self.root, _VERSIONS), exist_ok=True)
 

@@ -38,16 +38,21 @@ from pathlib import Path
 
 # the fleet stack: the fileset every rule reasons over by default.
 # serving.py rides along because the fleet engine's window assembly,
-# smoothing, ingest guard and pad policies live there.
+# smoothing, ingest guard and pad policies live there; utils/backoff.py
+# because the dispatch retry loop runs ON the launch path (HL001's
+# computed reachability follows retry_call's closures); har_tpu/parallel
+# because HL006/HL007 guard its traced bodies and partition specs.
 DEFAULT_FILESET = (
     "har_tpu/serve",
     "har_tpu/adapt",
     "har_tpu/serving.py",
     "har_tpu/utils/durable.py",
+    "har_tpu/utils/backoff.py",
+    "har_tpu/parallel",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*harlint:\s*(.+)$")
-_KNOWN_TOKENS = {"fetch-ok", "host-ok", "ephemeral"}
+_KNOWN_TOKENS = {"fetch-ok", "host-ok", "ephemeral", "spec-ok"}
 
 
 def _parse_tokens(comment: str) -> set[str]:
@@ -92,10 +97,20 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=self.rel)
+        # support context (path-subset runs): informs cross-file
+        # analysis — call-graph edges, traced roots, axis tables — but
+        # is never itself examined: per-file checks skip it, finalize
+        # rules don't scan its bodies, and its suppression consumption
+        # stays out of the report
+        self.support = False
         # would-be findings a token (fetch-ok / host-ok / ephemeral)
         # suppressed — rules bump this so the report can account for
         # every reviewed escape, not only `disable=` lines
         self.suppression_hits = 0
+        # (lineno, token) pairs that actually suppressed something this
+        # run — HL008 audits the complement (annotations that suppress
+        # NOTHING are rotted contracts and are themselves findings)
+        self.suppression_used: set[tuple[int, str]] = set()
         # lineno (1-based) -> set of suppression tokens on that line
         self.suppressions: dict[int, set[str]] = {}
         for i, line in enumerate(self.lines, start=1):
@@ -125,10 +140,11 @@ class FileContext:
         return lines
 
     def suppressed(self, node: ast.AST, token: str) -> bool:
-        return any(
-            token in self.suppressions.get(ln, ())
-            for ln in self._node_lines(node)
-        )
+        for ln in self._node_lines(node):
+            if token in self.suppressions.get(ln, ()):
+                self.suppression_used.add((ln, token))
+                return True
+        return False
 
     def rule_disabled(self, node: ast.AST, rule_id: str) -> bool:
         return self.suppressed(node, f"disable={rule_id}")
@@ -175,6 +191,27 @@ def walk_functions(tree: ast.Module):
     return out
 
 
+def walk_scopes(tree: ast.Module) -> list[tuple[str, ast.AST]]:
+    """``(qualname, node)`` for every def/class scope, pre-order
+    (parents before their children), qualnames dotted through nesting
+    — the one walker behind symbol labelling (iterate in order and
+    let deeper scopes overwrite: innermost wins) and enclosing-scope
+    lookups, so the qualname convention cannot drift between rules."""
+    out: list[tuple[str, ast.AST]] = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                out.append((".".join(stack + [child.name]), child))
+                visit(child, stack + [child.name])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
 def call_name(node: ast.Call) -> str | None:
     """The terminal name a call targets: ``foo()`` -> foo,
     ``a.b.foo()`` -> foo."""
@@ -195,13 +232,42 @@ def receiver_name(node: ast.Call) -> str | None:
     return None
 
 
+class Project:
+    """Shared cross-file analysis state for one lint run.
+
+    The call graph (``analyze.callgraph``) is built lazily — only runs
+    that include a graph-consuming rule (HL001/HL006) pay for it — and
+    built ONCE, however many rules then traverse it."""
+
+    def __init__(self, ctxs: list["FileContext"]):
+        self.ctxs = ctxs
+        self._graph = None
+        self.callgraph_ms = 0.0
+
+    @property
+    def callgraph(self):
+        if self._graph is None:
+            import time
+
+            from har_tpu.analyze.callgraph import CallGraph
+
+            t0 = time.perf_counter()
+            self._graph = CallGraph(self.ctxs)
+            self.callgraph_ms = (time.perf_counter() - t0) * 1e3
+        return self._graph
+
+
 class Rule:
-    """Base class: per-file ``check`` plus an optional cross-file
-    ``finalize`` (HL003 needs the whole fileset to compare record
-    writers against replay handlers)."""
+    """Base class: per-file ``check``, an optional cross-file
+    ``finalize`` (HL003 compares record writers against replay
+    handlers), and an optional ``audit`` that runs AFTER every other
+    rule's suppressions have been consumed (HL008 flags the annotations
+    nothing consumed).  ``self.project`` (set by ``run_rules``) carries
+    the shared call graph."""
 
     rule_id = "HL000"
     title = ""
+    project: Project | None = None
 
     def applies(self, rel: str) -> bool:
         return True
@@ -212,26 +278,26 @@ class Rule:
     def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
         return []
 
+    def audit(
+        self, ctxs: list[FileContext], ran: list[str]
+    ) -> list[Finding]:
+        return []
+
 
 @dataclasses.dataclass
 class LintStats:
     rules_run: list[str]
     files: int
     annotation_suppressed: int = 0
+    rule_ms: dict[str, float] = dataclasses.field(default_factory=dict)
+    callgraph_ms: float = 0.0
 
 
-def run_rules(
-    ctxs: list[FileContext], rules: list[Rule]
-) -> tuple[list[Finding], LintStats]:
-    """Run every rule over the fileset; generic ``disable=`` line
-    suppressions are applied here so individual rules never need to."""
-    by_rel = {c.rel: c for c in ctxs}
-    raw: list[Finding] = []
-    for rule in rules:
-        for ctx in ctxs:
-            if rule.applies(ctx.rel):
-                raw.extend(rule.check(ctx))
-        raw.extend(rule.finalize([c for c in ctxs if rule.applies(c.rel)]))
+def _apply_disable(
+    raw: list[Finding], by_rel: dict[str, FileContext]
+) -> tuple[list[Finding], int]:
+    """Filter generic ``disable=HL00X`` line suppressions, recording
+    which (line, token) pairs were consumed."""
     findings: list[Finding] = []
     suppressed = 0
     for f in raw:
@@ -246,19 +312,63 @@ def run_rules(
                 and ctx.lines[prev - 1].lstrip().startswith("#")
             ):
                 check_lines.append(prev)
-        if ctx is not None and any(
-            f"disable={f.rule}" in ctx.suppressions.get(ln, ())
-            for ln in check_lines
-        ):
+        hit = None
+        if ctx is not None:
+            for ln in check_lines:
+                if f"disable={f.rule}" in ctx.suppressions.get(ln, ()):
+                    hit = ln
+                    break
+        if hit is not None:
+            ctx.suppression_used.add((hit, f"disable={f.rule}"))
             suppressed += 1
             continue
         findings.append(f)
+    return findings, suppressed
+
+
+def run_rules(
+    ctxs: list[FileContext], rules: list[Rule]
+) -> tuple[list[Finding], LintStats]:
+    """Run every rule over the fileset; generic ``disable=`` line
+    suppressions are applied here so individual rules never need to.
+    Per-rule wall time is recorded (``har lint --stats`` and the
+    release gate's lint budget read it)."""
+    import time
+
+    by_rel = {c.rel: c for c in ctxs}
+    project = Project(ctxs)
+    raw: list[Finding] = []
+    rule_ms: dict[str, float] = {}
+    for rule in rules:
+        rule.project = project
+        t0 = time.perf_counter()
+        for ctx in ctxs:
+            if rule.applies(ctx.rel) and not ctx.support:
+                raw.extend(rule.check(ctx))
+        raw.extend(rule.finalize([c for c in ctxs if rule.applies(c.rel)]))
+        rule_ms[rule.rule_id] = (time.perf_counter() - t0) * 1e3
+    findings, suppressed = _apply_disable(raw, by_rel)
+    # audit pass: runs after every check/finalize has consumed its
+    # suppressions (HL008's staleness question is only answerable then)
+    ran = [r.rule_id for r in rules]
+    for rule in rules:
+        t0 = time.perf_counter()
+        audit_raw = rule.audit(
+            [c for c in ctxs if rule.applies(c.rel)], ran
+        )
+        if audit_raw:
+            audited, n = _apply_disable(audit_raw, by_rel)
+            findings.extend(audited)
+            suppressed += n
+        rule_ms[rule.rule_id] += (time.perf_counter() - t0) * 1e3
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     stats = LintStats(
-        rules_run=[r.rule_id for r in rules],
-        files=len(ctxs),
+        rules_run=ran,
+        files=len([c for c in ctxs if not c.support]),
         annotation_suppressed=suppressed
-        + sum(c.suppression_hits for c in ctxs),
+        + sum(c.suppression_hits for c in ctxs if not c.support),
+        rule_ms={k: round(v, 2) for k, v in rule_ms.items()},
+        callgraph_ms=round(project.callgraph_ms, 2),
     )
     return findings, stats
 

@@ -11,10 +11,17 @@ nondeterminism hunt is what one violation costs.
 Flagged inside ``har_tpu/serve/`` and ``har_tpu/adapt/``:
 
   - ``time.time()`` CALLS — wall-clock reads the fake-clock harness
-    cannot intercept.  (``clock or time.time`` default *references* are
-    the injectable-clock plumbing and are not calls — allowed; so are
-    ``time.monotonic()``/``perf_counter()`` duration measurements,
-    which feed reporting, not decisions.)
+    cannot intercept — and (PR 8) ``time.time`` passed/stored AS A
+    CALLABLE: ``self._clock = clock or time.time`` smuggles the same
+    wall clock past the old call-only check, one indirection later.
+    An injectable default that must be monotonic spells it
+    ``clock or time.monotonic`` (still allowed — monotonic/
+    perf_counter duration measurement feeds reporting, not
+    decisions); a deliberate wall-clock default (the registry's
+    ``created_unix`` stamps) carries a reviewed ``disable=HL004``.
+  - (PR 8) ``datetime.datetime.now()`` / ``utcnow()`` — the same wall
+    clock wearing a different module; previously invisible to the
+    ``time.time``-shaped check.
   - stdlib ``random.*`` calls — the process-global RNG, unseedable per
     run without cross-test contamination;
   - legacy global numpy RNG (``np.random.rand`` / ``np.random.seed`` /
@@ -77,11 +84,60 @@ class DeterminismRule(Rule):
                 )
             )
 
+        # callable-reference detection: `time.time` appearing OUTSIDE a
+        # call's function position (stored as an injectable default,
+        # passed as a key fn, ...) is the same wall clock one
+        # indirection later — collect the call-position nodes first so
+        # the reference walk can exclude them
+        call_funcs = {
+            id(node.func)
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call)
+        }
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"
+                and node.attr == "time"
+                and id(node) not in call_funcs
+            ):
+                flag(
+                    node,
+                    "`time.time` stored/passed as a callable — the "
+                    "wall clock rides the indirection past the "
+                    "FakeClock harness exactly like a direct call; "
+                    "default to the injectable clock (or "
+                    "`time.monotonic` for durations)",
+                )
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call) and isinstance(
                 node.func, ast.Attribute
             ):
                 f = node.func
+                # datetime.now()/utcnow(): `datetime.now(...)` on the
+                # imported class or `datetime.datetime.now(...)` on the
+                # module — both are wall clocks the harness cannot fake
+                if f.attr in ("now", "utcnow") and (
+                    (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id == "datetime"
+                    )
+                    or (
+                        isinstance(f.value, ast.Attribute)
+                        and f.value.attr == "datetime"
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id == "datetime"
+                    )
+                ):
+                    flag(
+                        node,
+                        f"`datetime.{f.attr}()` — a wall-clock read "
+                        "the FakeClock harness cannot intercept (the "
+                        "`time.time()` trap in a different module); "
+                        "take the injectable clock and derive "
+                        "timestamps from it",
+                    )
                 if isinstance(f.value, ast.Name):
                     if f.value.id == "time" and f.attr == "time":
                         flag(

@@ -9,16 +9,29 @@ the overlap ``FleetConfig.pipeline_depth`` exists to buy.  PR 5 fought
 exactly this by hand (the un-fetched launch/retire ticket split); this
 rule keeps it won.
 
-What is scanned:
+v2 (PR 8): the guarded surface is COMPUTED, not curated.  PR 6's rule
+checked a hand-listed name set (``{launch, _launch_batch, pad,
+pad_size, gather, _place}``) closed only over same-class ``self.``
+calls — a sync two calls below ``launch`` (a scorer constructor
+reached through ``_get_scorer`` → ``make_scorer``, an arena method
+reached through a typed attribute) sailed through.  Now the scanned
+set is the project call graph's reachability closure
+(``analyze.callgraph``) from:
 
-  - the LAUNCH SURFACE: every function/method named ``launch``,
-    ``_launch_batch``, ``pad``, ``pad_size``, ``gather`` or ``_place``
-    in the fileset, closed over same-class ``self.`` method calls and
-    direct module-function calls (``pad_pow2`` reached from
-    ``HostScorer.pad``);
+  - the LAUNCH ROOTS: every function/method named ``launch`` or
+    ``_launch_batch`` — the ``DispatchTicket`` entry points — closed
+    over ``self.`` methods (including subclass overrides), typed
+    attributes (``self._arena.gather``), locals typed through return
+    inference (``scorer = self._get_scorer()``), cross-module imports,
+    and closures nested in reached functions (``_attempt_launch``
+    handed to ``retry_call``).  Traversal stops at functions named
+    ``fetch`` — the one allowed sink, scanned separately;
   - every ``@jax.jit``-decorated (or ``jax.jit(fn)``-wrapped) function
     body — a host materialization inside a traced body is either a
-    tracer error waiting to happen or a silent constant-fold;
+    tracer error waiting to happen or a silent constant-fold.  (The
+    closure of jit bodies through the call graph — and shard_map/scan
+    bodies — is HL006's jit-purity surface, which reuses this module's
+    sync detectors; direct jit bodies stay here for continuity);
   - every function named ``fetch`` — the ONE allowed sink.  A fetch is
     where the host is SUPPOSED to block, but each host-sync line there
     must carry the reviewed ``# harlint: fetch-ok`` annotation, so a
@@ -46,19 +59,16 @@ from har_tpu.analyze.core import (
     Rule,
     call_name,
     receiver_name,
-    walk_functions,
 )
 
-LAUNCH_SURFACE = {
-    "launch", "_launch_batch", "pad", "pad_size", "gather", "_place",
-}
+LAUNCH_ROOTS = {"launch", "_launch_batch"}
 FETCH_SURFACE = {"fetch"}
 
 _HARD_SYNCS = {"item", "device_get", "block_until_ready"}
 _NP_NAMES = {"np", "numpy"}
 
 
-def _is_jit_marked(node: ast.FunctionDef) -> bool:
+def is_jit_marked(node: ast.FunctionDef) -> bool:
     """Decorated with jax.jit / jit / functools.partial(jax.jit, ...)."""
     for dec in node.decorator_list:
         for sub in ast.walk(dec):
@@ -69,19 +79,160 @@ def _is_jit_marked(node: ast.FunctionDef) -> bool:
     return False
 
 
-def _jit_wrapped_names(tree: ast.Module) -> set[str]:
-    """Local defs wrapped via ``jax.jit(forward)`` somewhere in the
-    file (the loadgen pattern: define, then jit by name)."""
-    names: set[str] = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and call_name(node) == "jit"
-            and node.args
-            and isinstance(node.args[0], ast.Name)
+def wrapped_def_nodes(tree: ast.Module, wrappers: set[str]) -> set[int]:
+    """AST ``id()``s of the defs wrapped via ``jax.jit(forward)`` /
+    ``shard_map(step, ...)`` — the define-then-wrap-by-name pattern, at
+    any nesting level.  The referenced Name is resolved LEXICALLY from
+    the wrapping call outward (innermost enclosing scope that binds a
+    def of that name wins, then the module), exactly like the
+    interpreter would — so an unrelated def merely SHARING the name
+    elsewhere in the file is never mistaken for a traced body.  A class
+    body is its own namespace: ``step_jit = jax.jit(step)`` next to
+    ``def step`` in a class body resolves to the member (the
+    define-then-wrap-in-class pattern), while functions NESTED inside
+    the class resolve through the enclosing function scopes only —
+    class namespaces do not participate in closures."""
+    out: set[int] = set()
+
+    def shallow(scope: ast.AST):
+        # scope's own statements (any block depth): stop at nested
+        # def/class boundaries — their interiors are separate scopes
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def bound_defs(scope: ast.AST) -> dict[str, ast.AST]:
+        defs: dict[str, ast.AST] = {}
+        for node in shallow(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        return defs
+
+    def visit(scope: ast.AST, env: list[dict[str, ast.AST]]) -> None:
+        body_env = env + [bound_defs(scope)]
+        # the class namespace is visible to the class BODY only —
+        # functions nested in the class close over the enclosing
+        # function scopes instead
+        child_env = env if isinstance(scope, ast.ClassDef) else body_env
+        for sub in shallow(scope):
+            if (
+                isinstance(sub, ast.Call)
+                and call_name(sub) in wrappers
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+            ):
+                target = sub.args[0].id
+                for table in reversed(body_env):
+                    if target in table:
+                        out.add(id(table[target]))
+                        break
+            elif isinstance(
+                sub,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                visit(sub, child_env)
+
+    visit(tree, [])
+    return out
+
+
+def scan_syncs(
+    rule_id: str,
+    ctx: FileContext,
+    qual: str,
+    node: ast.FunctionDef,
+    mode: str,
+    where: str,
+    *,
+    own_statements_only: bool = False,
+    reach_note: str = "",
+) -> list[Finding]:
+    """The shared host-sync detectors — HL001 runs them over the launch
+    reachability closure, direct jit bodies and fetch sinks; HL006
+    reuses them over the traced-body closure.  ``mode`` selects the
+    annotation contract: ``fetch`` (any sync legal WITH ``fetch-ok``),
+    ``launch`` (``host-ok`` covers soft conversions only), anything
+    else (no annotation escape, only ``disable=``)."""
+    out: list[Finding] = []
+
+    def flag(sub: ast.AST, what: str, soft: bool) -> None:
+        if mode == "fetch":
+            if ctx.suppressed(sub, "fetch-ok"):
+                ctx.suppression_hits += 1
+                return
+            msg = (
+                f"{what} {where} without the `# harlint: fetch-ok` "
+                "annotation — a fetch is the one allowed host-sync "
+                "sink, and every sync line in it must be reviewed"
+            )
+        else:
+            if soft and mode == "launch" and ctx.suppressed(sub, "host-ok"):
+                ctx.suppression_hits += 1
+                return
+            msg = (
+                f"{what} {where} forces a host sync — the device "
+                "idles while the host blocks; move it behind the "
+                "retire boundary (or annotate a reviewed "
+                "host-origin conversion with `# harlint: host-ok`)"
+            )
+        out.append(
+            Finding(
+                rule=rule_id,
+                path=ctx.rel,
+                line=getattr(sub, "lineno", 1),
+                message=msg + reach_note,
+                symbol=qual,
+                snippet=ctx.snippet(getattr(sub, "lineno", 1)),
+            )
+        )
+
+    for sub in walk_own(node) if own_statements_only else ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = call_name(sub)
+        recv = receiver_name(sub)
+        # hard syncs match BOTH spellings: `jax.device_get(h)` /
+        # `h.block_until_ready()` attributes AND the bare-name
+        # `from jax import device_get` form.  Bare `item(...)` is
+        # excluded — as a free function it is always user code, not
+        # the ndarray method.
+        if name in _HARD_SYNCS and (
+            isinstance(sub.func, ast.Attribute)
+            or name in ("device_get", "block_until_ready")
         ):
-            names.add(node.args[0].id)
-    return names
+            flag(sub, f"`.{name}()`" if name != "device_get"
+                 else "`jax.device_get`", soft=False)
+        elif name in ("asarray", "array") and recv in _NP_NAMES:
+            flag(sub, f"`np.{name}(...)`", soft=True)
+        elif (
+            isinstance(sub.func, ast.Name)
+            and sub.func.id in ("float", "int")
+            and len(sub.args) == 1
+            and isinstance(
+                sub.args[0], (ast.Call, ast.Subscript, ast.Attribute)
+            )
+        ):
+            flag(sub, f"`{sub.func.id}(...)` on a computed value",
+                 soft=True)
+    return out
+
+
+def walk_own(node: ast.FunctionDef):
+    """ast.walk that does NOT descend into nested function defs — for
+    scanning a function's own statements when its closures are separate
+    graph nodes (HL006's per-function pass)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(sub))
 
 
 class HotPathRule(Rule):
@@ -89,135 +240,88 @@ class HotPathRule(Rule):
     title = "hot-path host-sync"
 
     def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
-        # function tables across the fileset, for the launch closure
-        funcs: dict[str, list[tuple[FileContext, str, str | None, ast.FunctionDef]]] = {}
-        module_funcs: dict[str, list[tuple[FileContext, str, ast.FunctionDef]]] = {}
-        per_ctx: dict[str, list] = {}
-        for ctx in ctxs:
-            entries = walk_functions(ctx.tree)
-            per_ctx[ctx.rel] = entries
-            for qual, cls, node in entries:
-                funcs.setdefault(node.name, []).append((ctx, qual, cls, node))
-                if cls is None and "." not in qual:
-                    module_funcs.setdefault(node.name, []).append(
-                        (ctx, qual, node)
-                    )
+        from har_tpu.analyze.core import Project
 
-        # seed the scan set: launch surface, fetch sinks, jit bodies
-        work: list[tuple[FileContext, str, str | None, ast.FunctionDef, str]] = []
-        for ctx in ctxs:
-            jit_names = _jit_wrapped_names(ctx.tree)
-            for qual, cls, node in per_ctx[ctx.rel]:
-                if node.name in LAUNCH_SURFACE:
-                    work.append((ctx, qual, cls, node, "launch"))
-                elif node.name in FETCH_SURFACE:
-                    work.append((ctx, qual, cls, node, "fetch"))
-                elif _is_jit_marked(node) or (
-                    cls is None and node.name in jit_names
-                ):
-                    work.append((ctx, qual, cls, node, "jit"))
+        project = self.project or Project(ctxs)
+        graph = project.callgraph
+
+        launch_roots = [
+            fi for fi in graph.functions.values() if fi.name in LAUNCH_ROOTS
+        ]
+        # the launch surface ends at fetch sinks (scanned separately)
+        reach = graph.reachable(
+            launch_roots, stop=lambda fi: fi.name in FETCH_SURFACE
+        )
 
         findings: list[Finding] = []
-        seen: set[tuple[str, str]] = set()
-        while work:
-            ctx, qual, cls, node, mode = work.pop()
-            if (ctx.rel, qual) in seen:
+        for key, (parent, root) in reach.items():
+            fi = graph.functions[key]
+            if fi.ctx.support:
+                # subset run: the closure traverses support files (the
+                # roots and edges live there) but only requested files
+                # are examined
                 continue
-            seen.add((ctx.rel, qual))
-            findings.extend(self._scan(ctx, qual, node, mode))
-            if mode != "launch":
+            note = ""
+            if parent is not None:
+                chain = graph.chain(reach, key)
+                note = (
+                    "  [reached from launch root `"
+                    + chain[0]
+                    + "` via "
+                    + " -> ".join(f"`{q}`" for q in chain[1:])
+                    + "]"
+                )
+            # own statements only: a reached function's nested defs are
+            # separate entries in the closure (scanning both the parent
+            # walk and the nested node would double-flag)
+            findings.extend(
+                scan_syncs(
+                    self.rule_id, fi.ctx, fi.qual, fi.node, "launch",
+                    "on the dispatch launch path",
+                    own_statements_only=True,
+                    reach_note=note,
+                )
+            )
+
+        # direct jit bodies (decorator or jit-by-name), launch surface
+        # taking precedence when both apply; their call-graph closure
+        # is HL006's surface
+        for ctx in ctxs:
+            if ctx.support:
                 continue
-            # close the launch surface: self-method calls within the
-            # same class, and direct Name calls to module functions
-            for sub in ast.walk(node):
-                if not isinstance(sub, ast.Call):
+            jit_nodes = wrapped_def_nodes(ctx.tree, {"jit"})
+            for fi in graph.functions.values():
+                if fi.rel != ctx.rel or fi.key in reach:
                     continue
-                f = sub.func
-                if (
-                    isinstance(f, ast.Attribute)
-                    and isinstance(f.value, ast.Name)
-                    and f.value.id == "self"
-                    and cls is not None
-                ):
-                    for tctx, tqual, tcls, tnode in funcs.get(f.attr, ()):
-                        if tcls == cls:
-                            work.append((tctx, tqual, tcls, tnode, "launch"))
-                elif isinstance(f, ast.Name):
-                    for tctx, tqual, tnode in module_funcs.get(f.id, ()):
-                        work.append((tctx, tqual, None, tnode, "launch"))
+                if fi.name in FETCH_SURFACE:
+                    findings.extend(
+                        scan_syncs(
+                            self.rule_id, ctx, fi.qual, fi.node, "fetch",
+                            "in a retire-side fetch",
+                        )
+                    )
+                elif is_jit_marked(fi.node) or id(fi.node) in jit_nodes:
+                    # nested scans would double-count: a jit-wrapped
+                    # def nested under another jit-wrapped def is
+                    # already covered by the outer walk.  Ancestors are
+                    # the proper dotted prefixes of the qualname (class
+                    # segments simply miss the function table)
+                    parts = fi.qual.split(".")
+                    if fi.parent_qual is not None and any(
+                        (g := graph.functions.get(
+                            (fi.rel, ".".join(parts[:i]))
+                        )) is not None
+                        and (
+                            is_jit_marked(g.node)
+                            or id(g.node) in jit_nodes
+                        )
+                        for i in range(1, len(parts))
+                    ):
+                        continue
+                    findings.extend(
+                        scan_syncs(
+                            self.rule_id, ctx, fi.qual, fi.node, "jit",
+                            "inside a @jit body",
+                        )
+                    )
         return findings
-
-    # ------------------------------------------------------------ scan
-
-    def _scan(
-        self, ctx: FileContext, qual: str, node: ast.FunctionDef, mode: str
-    ) -> list[Finding]:
-        where = {
-            "launch": "on the dispatch launch path",
-            "jit": "inside a @jit body",
-            "fetch": "in a retire-side fetch",
-        }[mode]
-        out: list[Finding] = []
-
-        def flag(sub: ast.AST, what: str, soft: bool) -> None:
-            # fetch sinks: any sync is legal WITH the reviewed
-            # annotation; launch surface: host-ok covers soft
-            # (conversion) flags only; jit bodies: no annotation out
-            if mode == "fetch":
-                if ctx.suppressed(sub, "fetch-ok"):
-                    ctx.suppression_hits += 1
-                    return
-                msg = (
-                    f"{what} {where} without the `# harlint: fetch-ok` "
-                    "annotation — a fetch is the one allowed host-sync "
-                    "sink, and every sync line in it must be reviewed"
-                )
-            else:
-                if (
-                    soft
-                    and mode == "launch"
-                    and ctx.suppressed(sub, "host-ok")
-                ):
-                    ctx.suppression_hits += 1
-                    return
-                msg = (
-                    f"{what} {where} forces a host sync — the device "
-                    "idles while the host blocks; move it behind the "
-                    "retire boundary (or annotate a reviewed "
-                    "host-origin conversion with `# harlint: host-ok`)"
-                )
-            out.append(self.finding_at(ctx, sub, msg, qual))
-
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            name = call_name(sub)
-            recv = receiver_name(sub)
-            # hard syncs match BOTH spellings: `jax.device_get(h)` /
-            # `h.block_until_ready()` attributes AND the bare-name
-            # `from jax import device_get` form.  Bare `item(...)` is
-            # excluded — as a free function it is always user code, not
-            # the ndarray method.
-            if name in _HARD_SYNCS and (
-                isinstance(sub.func, ast.Attribute)
-                or name in ("device_get", "block_until_ready")
-            ):
-                flag(sub, f"`.{name}()`" if name != "device_get"
-                     else "`jax.device_get`", soft=False)
-            elif name in ("asarray", "array") and recv in _NP_NAMES:
-                flag(sub, f"`np.{name}(...)`", soft=True)
-            elif (
-                isinstance(sub.func, ast.Name)
-                and sub.func.id in ("float", "int")
-                and len(sub.args) == 1
-                and isinstance(
-                    sub.args[0], (ast.Call, ast.Subscript, ast.Attribute)
-                )
-            ):
-                flag(sub, f"`{sub.func.id}(...)` on a computed value",
-                     soft=True)
-        return out
-
-    @staticmethod
-    def finding_at(ctx, node, msg, qual) -> Finding:
-        return ctx.finding("HL001", node, msg, qual)

@@ -1,0 +1,287 @@
+"""HL006 — jit-purity: everything a traced body can reach must be pure.
+
+``jax.jit`` / ``jax.shard_map`` / ``lax.scan`` (and ``nn.scan``) trace
+a function ONCE per input shape and replay the captured computation
+thereafter.  Any side effect in the traced closure therefore fires at
+trace time only — the classic silent-staleness bugs:
+
+  - **mutating closed-over state** (``self.hits += 1``, appending to a
+    captured list, ``global``/``nonlocal`` writes): happens once per
+    compile, not once per step; counters silently freeze, caches
+    silently corrupt;
+  - **wall-clock reads** (``time.time()``, ``perf_counter()``): the
+    value is constant-folded into the program at trace time — every
+    subsequent step sees the trace-time clock;
+  - **print / logging**: executes during trace only, then vanishes —
+    the debugging trap that makes people think their step "runs once";
+  - **host fetches** (``np.asarray`` on a tracer, ``.item()``,
+    ``block_until_ready``): a tracer error waiting to happen or a
+    silent constant-fold — the same detectors as HL001
+    (``hotpath.scan_syncs``), applied through the call graph.
+
+The surface is the call-graph reachability closure
+(``analyze.callgraph``) from every traced root: jit-decorated or
+jit-by-name-wrapped functions, and functions handed to ``shard_map`` /
+``scan`` by name.  DIRECT jit bodies' syncs stay HL001's findings
+(continuity with PR 6); this rule owns the purity checks everywhere in
+the closure, and the sync detectors for everything deeper than the
+direct body — which is exactly the gap the hand-listed v1 surface had.
+
+The DrJAX-style cluster primitives (arXiv 2403.07128, PAPERS.md) and
+the ROADMAP's shared train/serve sharding layer both grow this
+pure-functional surface; this rule is their static guard.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from har_tpu.analyze.core import FileContext, Finding, Rule, call_name
+from har_tpu.analyze.hotpath import (
+    is_jit_marked,
+    scan_syncs,
+    walk_own,
+    wrapped_def_nodes,
+)
+
+_TRACE_WRAPPERS = {"shard_map", "scan"}  # jax.shard_map / lax.scan / nn.scan
+_CLOCK_ATTRS = {"time", "monotonic", "perf_counter", "process_time"}
+_LOG_RECEIVERS = {"logging", "log", "logger", "_log", "_logger"}
+# a receiver merely NAMED `log` may be a list — only the logging verbs
+# route to the logging finding; `.append` et al. stay container mutation
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log",
+}
+# conservative mutating-method set for closed-over containers; `update`
+# is deliberately absent (optax's `optimizer.update` is pure and
+# ubiquitous inside traced bodies)
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "clear",
+    "remove", "discard", "add", "setdefault",
+}
+
+
+def _bound_names(t):
+    """Names a binding target BINDS — the Name/Tuple/Starred structure
+    only.  The base of a Subscript/Attribute target (``d[k] = v``,
+    ``obj.x = v``) is a MUTATION of an existing object, not a binding:
+    walking into it would classify a closed-over dict as local and mask
+    the very write this rule exists to flag."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, ast.Starred):
+        yield from _bound_names(t.value)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _bound_names(e)
+
+
+class JitPurityRule(Rule):
+    rule_id = "HL006"
+    title = "jit-purity"
+
+    def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
+        from har_tpu.analyze.core import Project
+
+        project = self.project or Project(ctxs)
+        graph = project.callgraph
+
+        roots, direct_jit = [], set()
+        for ctx in ctxs:
+            jit_nodes = wrapped_def_nodes(ctx.tree, {"jit"})
+            traced_nodes = wrapped_def_nodes(ctx.tree, _TRACE_WRAPPERS)
+            for fi in graph.functions.values():
+                if fi.rel != ctx.rel:
+                    continue
+                jit_root = (
+                    is_jit_marked(fi.node) or id(fi.node) in jit_nodes
+                )
+                if jit_root:
+                    # HL001 scans these bodies' syncs (full walk,
+                    # nested defs included) — remember the whole
+                    # subtree so the sync pass below skips it
+                    direct_jit.add(fi.key)
+                    for g in graph.nested_under(fi):
+                        direct_jit.add(g.key)
+                if jit_root or id(fi.node) in traced_nodes:
+                    roots.append(fi)
+
+        reach = graph.reachable(roots)
+        findings: list[Finding] = []
+        for key, (parent, root) in reach.items():
+            fi = graph.functions[key]
+            if fi.ctx.support:
+                # subset run: traced roots and call edges in support
+                # files still shape the closure, but only requested
+                # files' bodies are scanned
+                continue
+            chain = graph.chain(reach, key)
+            note = (
+                ""
+                if len(chain) == 1
+                else (
+                    "  [traced via "
+                    + " -> ".join(f"`{q}`" for q in chain)
+                    + "]"
+                )
+            )
+            findings.extend(self._purity_scan(fi, note))
+            if key not in direct_jit:
+                findings.extend(
+                    scan_syncs(
+                        self.rule_id, fi.ctx, fi.qual, fi.node, "jit",
+                        "inside a traced (jit/shard_map/scan) closure",
+                        own_statements_only=True,
+                        reach_note=note,
+                    )
+                )
+        return findings
+
+    # ------------------------------------------------------------ purity
+
+    def _purity_scan(self, fi, note: str) -> list[Finding]:
+        ctx, node = fi.ctx, fi.node
+        # statement-bound names: assignments, loop targets, withitems,
+        # comprehension vars, nested def/class names.  Containers BOUND
+        # here are this trace's own values — mutating them is fine;
+        # containers that arrive as parameters are the caller's, and
+        # mutating those is the same trace-time-only trap as a closure.
+        bound: set[str] = set()
+        for sub in walk_own(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets = [sub.target]
+            elif isinstance(sub, ast.For):
+                targets = [sub.target]
+            elif isinstance(sub, ast.withitem) and sub.optional_vars:
+                targets = [sub.optional_vars]
+            elif isinstance(sub, ast.comprehension):
+                targets = [sub.target]
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                bound.add(sub.name)
+                continue
+            for t in targets:
+                bound.update(_bound_names(t))
+
+        out: list[Finding] = []
+
+        def flag(sub, msg):
+            # no in-rule disable= check: run_rules' _apply_disable owns
+            # the generic suppression for every rule, so HL006 gets the
+            # same placement semantics (finding line or the comment-only
+            # line above) as the other seven
+            line = getattr(sub, "lineno", 1)
+            out.append(
+                Finding(
+                    rule=self.rule_id,
+                    path=ctx.rel,
+                    line=line,
+                    message=msg + note,
+                    symbol=fi.qual,
+                    snippet=ctx.snippet(line),
+                )
+            )
+
+        for sub in walk_own(node):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                flag(
+                    sub,
+                    f"`{type(sub).__name__.lower()}` write inside a traced "
+                    "body — the mutation fires at trace time only "
+                    "(once per compiled shape, not once per step); "
+                    "thread the value through the carry/return instead",
+                )
+            elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        flag(
+                            sub,
+                            f"assignment to `self.{t.attr}` inside a "
+                            "traced body — jit replays the captured "
+                            "computation, so the attribute updates at "
+                            "trace time only (a silently-frozen "
+                            "counter/cache); mutate outside the traced "
+                            "fn or return the value",
+                        )
+                    elif isinstance(t, ast.Subscript):
+                        base = t.value
+                        while isinstance(base, (ast.Subscript,
+                                                ast.Attribute)):
+                            base = base.value
+                        if (
+                            isinstance(base, ast.Name)
+                            and base.id not in bound
+                        ):
+                            flag(
+                                sub,
+                                f"subscript write into closed-over "
+                                f"`{base.id}` inside a traced body — "
+                                "in-place mutation of captured state "
+                                "fires at trace time only (tracers are "
+                                "immutable; a numpy closure silently "
+                                "corrupts); use `.at[...].set(...)` on "
+                                "a carried array instead",
+                            )
+            elif isinstance(sub, ast.Call):
+                name = call_name(sub)
+                f = sub.func
+                if isinstance(f, ast.Name) and f.id == "print":
+                    flag(
+                        sub,
+                        "`print(...)` inside a traced body executes at "
+                        "trace time only (once per compiled shape) — "
+                        "use `jax.debug.print` for runtime values, or "
+                        "log outside the traced fn",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "time"
+                    and f.attr in _CLOCK_ATTRS
+                ):
+                    flag(
+                        sub,
+                        f"`time.{f.attr}()` inside a traced body is "
+                        "constant-folded at trace time — every replayed "
+                        "step sees the trace-time clock; measure "
+                        "outside the traced fn",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _LOG_RECEIVERS
+                    and f.attr in _LOG_METHODS
+                ):
+                    flag(
+                        sub,
+                        f"`{f.value.id}.{f.attr}(...)` inside a traced "
+                        "body executes at trace time only — log outside "
+                        "the traced fn",
+                    )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and name in _MUTATORS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id not in bound
+                    and f.value.id != "self"
+                ):
+                    flag(
+                        sub,
+                        f"`.{name}(...)` on closed-over `{f.value.id}` "
+                        "inside a traced body — container mutation "
+                        "fires at trace time only; thread the value "
+                        "through the carry/return instead",
+                    )
+        return out

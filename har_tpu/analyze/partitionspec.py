@@ -1,0 +1,419 @@
+"""HL007 — partition-spec coverage: every sharded program in the
+parallel package declares where its arguments live, every axis name a
+``PartitionSpec`` mentions is a declared mesh axis, and spec builders
+actually shard the >1-D kernels they exist to shard.
+
+This is the static half of the ROADMAP's ``match_partition_rules``
+refactor (the shared train/serve sharding layer): before the rule
+tables exist, the invariants they will encode are already checkable —
+
+  1. **specs for all args.**  A ``shard_map(...)`` must declare BOTH
+     ``in_specs`` and ``out_specs``; when ``in_specs`` is a literal
+     tuple and the wrapped callable resolves in the call graph to a
+     fixed-arity function, the tuple length must match its positional
+     parameter count (a silently-recycled spec after an added argument
+     is exactly the drift this catches).  A bare ``jax.jit(...)`` in
+     ``har_tpu/parallel/*.py`` with NO shardings is a finding unless
+     (a) it wraps a ``shard_map`` product (the specs live inside), or
+     (b) it carries the reviewed ``# harlint: spec-ok`` annotation —
+     the placement-driven-GSPMD pattern (inputs arrive sharded and XLA
+     propagates), which is correct but must be a visible, reviewed
+     contract, not a default.  Declaring only one of ``in_shardings``/
+     ``out_shardings`` is flagged the same way.
+
+  2. **axis names exist.**  Every axis a ``P(...)``/``PartitionSpec``
+     names — as a string literal, a ``*_AXIS`` constant (resolved
+     through the import map), or a parameter default — must be one of
+     the axes the parallel package declares (``mesh.py``'s
+     ``DP/TP/DP_DCN`` plus the ``EP``/``PP`` linear-mesh axes).  An
+     axis typo does not error at spec-construction time; it surfaces
+     later as a mesh-resolution failure or, worse, silent replication.
+
+  3. **no implicit full replication of a >1-D kernel.**  A spec
+     builder (a function named ``*specs*``, e.g.
+     ``dense_alternating_specs``) whose assigned/returned specs never
+     include a ≥2-dim ``P`` carrying a real axis has lost its kernel
+     branch — every 2-D kernel falls through to ``P()`` and the model
+     silently serves fully replicated.  Likewise a ``shard_map`` whose
+     literal ``in_specs`` are ALL empty ``P()`` maps nothing.
+
+Scope: ``har_tpu/parallel/*.py`` + ``har_tpu/serve/dispatch.py`` (the
+serving-side placement).  Pure stdlib, like every harlint rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from har_tpu.analyze.core import (
+    FileContext,
+    Finding,
+    Rule,
+    call_name,
+    walk_scopes,
+)
+
+_SCOPE_PREFIX = "har_tpu/parallel/"
+_SCOPE_FILES = {"har_tpu/serve/dispatch.py"}
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+# The files whose module-level ``*_AXIS`` constants define the declared
+# mesh axes this rule validates against.  Path-subset runs (``har lint
+# --changed``) load these as support contexts so an edited parallel
+# module is judged against the real axis table instead of an empty one
+# (see ``run_harlint``).
+AXIS_DECLARERS = (
+    "har_tpu/parallel/mesh.py",
+    "har_tpu/parallel/expert_parallel.py",
+    "har_tpu/parallel/pipeline_parallel.py",
+)
+
+
+def _is_jit_ref(expr: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` referenced (not called) — the decorator
+    and ``partial(jax.jit, ...)`` spellings."""
+    return (isinstance(expr, ast.Attribute) and expr.attr == "jit") or (
+        isinstance(expr, ast.Name) and expr.id == "jit"
+    )
+
+
+class PartitionSpecRule(Rule):
+    rule_id = "HL007"
+    title = "partition-spec coverage"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_SCOPE_PREFIX) or rel in _SCOPE_FILES
+
+    def finalize(self, ctxs: list[FileContext]) -> list[Finding]:
+        from har_tpu.analyze.core import Project
+
+        project = self.project or Project(ctxs)
+        graph = project.callgraph
+
+        # declared axes: module-level `*_AXIS = "name"` constants across
+        # the scope (mesh.py's dp/tp/dp_dcn + expert/pipeline ep/pp)
+        declared: dict[str, str] = {}
+        for ctx in ctxs:
+            for node in ctx.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                            declared[node.value.value] = t.id
+        axis_list = ", ".join(sorted(declared)) or "<none declared>"
+
+        findings: list[Finding] = []
+        for ctx in ctxs:
+            # support ctxs (subset runs) contribute their axis table
+            # above but are not themselves examined
+            if not ctx.support:
+                findings.extend(
+                    self._check_file(ctx, graph, declared, axis_list)
+                )
+        return findings
+
+    # ------------------------------------------------------------- file
+
+    def _check_file(self, ctx, graph, declared, axis_list):
+        findings: list[Finding] = []
+        functions = walk_scopes(ctx.tree)
+
+        def symbol_at(line: int) -> str:
+            best = ""
+            for qual, node in functions:
+                if node.lineno <= line <= (node.end_lineno or node.lineno):
+                    best = qual  # innermost wins: keep overwriting
+            return best
+
+        def flag(node, msg, symbol=None):
+            if ctx.suppressed(node, "spec-ok"):
+                ctx.suppression_hits += 1
+                return
+            findings.append(
+                ctx.finding(
+                    self.rule_id, node, msg,
+                    symbol if symbol is not None
+                    else symbol_at(getattr(node, "lineno", 1)),
+                )
+            )
+
+        def resolve_axis(expr, line) -> list[str]:
+            """Axis strings an expression can name; [] when opaque."""
+            if expr is None or (
+                isinstance(expr, ast.Constant) and expr.value is None
+            ):
+                return []
+            if isinstance(expr, ast.Constant) and isinstance(
+                expr.value, str
+            ):
+                return [expr.value]
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                out = []
+                for e in expr.elts:
+                    out.extend(resolve_axis(e, line))
+                return out
+            if isinstance(expr, ast.BoolOp):
+                out = []
+                for e in expr.values:
+                    out.extend(resolve_axis(e, line))
+                return out
+            if isinstance(expr, ast.Name):
+                got = graph.resolve_const(ctx.rel, expr.id)
+                if got is not None:
+                    return [got]
+                # parameter default: `def f(..., tp_axis=TP_AXIS)`
+                for qual, node in functions:
+                    if not isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    if not (
+                        node.lineno <= line
+                        <= (node.end_lineno or node.lineno)
+                    ):
+                        continue
+                    a = node.args
+                    pos = a.posonlyargs + a.args
+                    for p, d in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                        if p.arg == expr.id:
+                            return resolve_axis(d, node.lineno)
+                    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+                        if d is not None and p.arg == expr.id:
+                            return resolve_axis(d, node.lineno)
+                return []
+            return []
+
+        # ---- P(...) axis-name validation
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _SPEC_NAMES
+            ):
+                continue
+            axes = []
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    continue
+                for ax in resolve_axis(arg, node.lineno):
+                    axes.append((ax, arg))
+            for ax, arg in axes:
+                if ax not in declared:
+                    flag(
+                        arg if hasattr(arg, "lineno") else node,
+                        f"PartitionSpec axis `{ax}` is not a declared "
+                        f"mesh axis (declared: {axis_list}) — a typo "
+                        "here surfaces later as a mesh-resolution "
+                        "failure or silent replication",
+                    )
+
+        def jit_contract(node, kw, spelling, symbol=None):
+            """The one reviewed-placement contract, whatever the jit
+            spelling (call, decorator, partial): both shardings, or a
+            `# harlint: spec-ok` annotation."""
+            has_in = "in_shardings" in kw
+            has_out = "out_shardings" in kw
+            if has_in and has_out:
+                return
+            if has_in != has_out:
+                which = "in_shardings" if has_in else "out_shardings"
+                other = "out_shardings" if has_in else "in_shardings"
+                flag(
+                    node,
+                    f"`{spelling}` declares {which} but not {other} — "
+                    "half-declared placement leaves the other side "
+                    "to silent GSPMD inference; declare both",
+                    symbol=symbol,
+                )
+                return
+            flag(
+                node,
+                f"`{spelling}` in the parallel package with no "
+                "in_shardings/out_shardings — placement-driven GSPMD "
+                "(inputs arrive sharded, XLA propagates) is a "
+                "reviewed pattern: annotate `# harlint: spec-ok` or "
+                "declare the shardings",
+                symbol=symbol,
+            )
+
+        # ---- shard_map / jit call-site checks
+        shard_map_products: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value) == "shard_map"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        shard_map_products.add(t.id)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            if name == "shard_map":
+                missing = [
+                    k for k in ("in_specs", "out_specs") if k not in kw
+                ]
+                if missing:
+                    flag(
+                        node,
+                        f"`shard_map(...)` without {' / '.join(missing)} "
+                        "— every argument and result of a sharded "
+                        "program must declare its placement",
+                    )
+                in_specs = kw.get("in_specs")
+                if isinstance(in_specs, ast.Tuple) and in_specs.elts:
+                    self._arity_check(
+                        ctx, graph, node, in_specs, flag, functions
+                    )
+                    if all(
+                        isinstance(e, ast.Call)
+                        and call_name(e) in _SPEC_NAMES
+                        and not e.args
+                        for e in in_specs.elts
+                    ):
+                        flag(
+                            node,
+                            "every `in_specs` entry of this shard_map "
+                            "is a fully-replicated `P()` — the map "
+                            "shards nothing; at least the batch (or "
+                            "parameter) axis must be partitioned",
+                        )
+            elif (
+                name == "partial"
+                and ctx.rel.startswith(_SCOPE_PREFIX)
+                and any(_is_jit_ref(a) for a in node.args)
+            ):
+                # `partial(jax.jit, ...)` (usually as a decorator): the
+                # wrap is deferred but the shardings live in THESE
+                # kwargs — same contract as the direct call form
+                jit_contract(node, kw, "partial(jit, ...)")
+            elif name == "jit" and ctx.rel.startswith(_SCOPE_PREFIX):
+                wrapped = node.args[0] if node.args else None
+                if "in_shardings" not in kw and "out_shardings" not in kw and (
+                    (
+                        isinstance(wrapped, ast.Name)
+                        and wrapped.id in shard_map_products
+                    )
+                    or (
+                        isinstance(wrapped, ast.Call)
+                        and call_name(wrapped) == "shard_map"
+                    )
+                ):
+                    pass  # jit of a shard_map product (assigned name
+                    #       or inline call): the specs live inside
+                else:
+                    jit_contract(node, kw, "jit(...)")
+
+        # ---- decorator-form bare jit (`@jax.jit` / `@jit`): the same
+        # reviewed-placement contract as the call form — HL001/HL006's
+        # is_jit_marked already treats these as jit roots, so without
+        # this check the decorator spelling is an unreviewed bypass.
+        # Call-form decorators (`@jax.jit(...)`, `@partial(jax.jit,
+        # ...)`) are ast.Call nodes the walk above already judged.
+        if ctx.rel.startswith(_SCOPE_PREFIX):
+            for qual, fnode in functions:
+                if not isinstance(fnode, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                    continue
+                for dec in fnode.decorator_list:
+                    if not isinstance(dec, ast.Call) and _is_jit_ref(dec):
+                        jit_contract(dec, {}, "@jit", symbol=qual)
+
+        # ---- spec-builder replication check (`*specs*` functions)
+        for qual, fnode in functions:
+            if not isinstance(fnode, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            if "specs" not in fnode.name:
+                continue
+            produced = []  # P calls in assignment/return value position
+            for sub in ast.walk(fnode):
+                vals = []
+                if isinstance(sub, (ast.Assign, ast.Return)):
+                    vals = [sub.value] if sub.value is not None else []
+                elif isinstance(sub, ast.AnnAssign) and sub.value:
+                    vals = [sub.value]
+                for v in vals:
+                    for c in ast.walk(v):
+                        if (
+                            isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Name)
+                            and c.func.id in _SPEC_NAMES
+                        ):
+                            produced.append(c)
+            if not produced:
+                continue
+            def _sharded_multidim(c):
+                # a ≥2-dim spec (two positional entries) naming at
+                # least one real axis — the kernel-spec shape
+                if len(c.args) < 2:
+                    return False
+                axes = []
+                for arg in c.args:
+                    axes.extend(resolve_axis(arg, c.lineno))
+                return any(ax in declared for ax in axes)
+            if not any(_sharded_multidim(c) for c in produced):
+                flag(
+                    fnode,
+                    f"spec builder `{fnode.name}` produces no ≥2-dim "
+                    "PartitionSpec carrying a declared axis — every "
+                    ">1-D kernel it covers is implicitly FULLY "
+                    "REPLICATED (the lost-kernel-branch failure mode); "
+                    "restore the sharded kernel spec",
+                    symbol=qual,
+                )
+        return findings
+
+    # ------------------------------------------------------------ arity
+
+    def _arity_check(self, ctx, graph, call, in_specs, flag, functions):
+        wrapped = call.args[0] if call.args else None
+        if not isinstance(wrapped, ast.Name):
+            return
+        fi = None
+        # nested def resolved LEXICALLY: the innermost def/class scope
+        # enclosing the call, walked outward — never a same-named def
+        # from an unrelated function (wrong arity both ways: spurious
+        # findings AND masked genuine drift)
+        enclosing = ""
+        for qual, node in functions:
+            if node.lineno <= call.lineno <= (node.end_lineno
+                                              or node.lineno):
+                enclosing = qual  # innermost wins: keep overwriting
+        while enclosing:
+            fi = graph.functions.get(
+                (ctx.rel, f"{enclosing}.{wrapped.id}")
+            )
+            if fi is not None:
+                break
+            enclosing = (
+                enclosing.rsplit(".", 1)[0] if "." in enclosing else ""
+            )
+        if fi is None:
+            got = graph.resolve_symbol(ctx.rel, wrapped.id)
+            from har_tpu.analyze.callgraph import FuncInfo
+
+            if isinstance(got, FuncInfo):
+                fi = got
+        if fi is None:
+            return
+        a = fi.node.args
+        if a.vararg is not None:
+            return  # *args: arity is dynamic, nothing to pin
+        n_pos = len(a.posonlyargs) + len(a.args)
+        if len(in_specs.elts) != n_pos:
+            flag(
+                call,
+                f"shard_map in_specs declares {len(in_specs.elts)} "
+                f"placements but `{fi.name}` takes {n_pos} positional "
+                "arguments — an added argument is silently riding a "
+                "recycled spec (declare one spec per argument)",
+            )

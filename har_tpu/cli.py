@@ -363,15 +363,18 @@ def _parser() -> argparse.ArgumentParser:
     ln = sub.add_parser(
         "lint",
         help="harlint: AST-based invariant checker for the fleet stack "
-             "(HL001 hot-path host-sync, HL002 state completeness, "
-             "HL003 journal/replay exhaustiveness, HL004 determinism, "
-             "HL005 durability); rc 1 on any non-baselined finding",
+             "(HL001 hot-path host-sync via call-graph reachability, "
+             "HL002 state completeness, HL003 journal/replay "
+             "exhaustiveness, HL004 determinism, HL005 durability, "
+             "HL006 jit-purity, HL007 partition-spec coverage, HL008 "
+             "stale suppressions); rc 1 on any non-baselined finding",
     )
     ln.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (repo-relative); "
                          "default is the fleet-stack fileset "
-                         "(har_tpu/serve, har_tpu/adapt, serving.py, "
-                         "utils/durable.py)")
+                         "(har_tpu/serve, har_tpu/adapt, har_tpu/"
+                         "parallel, serving.py, utils/durable.py, "
+                         "utils/backoff.py)")
     ln.add_argument("--json", action="store_true", dest="as_json",
                     help="one JSON report line (the release gate's "
                          "consumption format) instead of text findings")
@@ -384,6 +387,23 @@ def _parser() -> argparse.ArgumentParser:
     ln.add_argument("--check", action="store_true",
                     help="summary only (no per-finding lines); rc is "
                          "the verdict — the release-gate invocation")
+    ln.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only fileset files changed vs a git ref "
+                         "(default HEAD) — the fast pre-commit run; "
+                         "rc/json semantics unchanged.  HL003 and "
+                         "HL008 are skipped (their bijection/staleness "
+                         "checks only hold over the full fileset); "
+                         "the release gate still runs the full set")
+    ln.add_argument("--rule", action="append", default=None,
+                    metavar="HL00X",
+                    help="run only the named rule (repeatable)")
+    ln.add_argument("--stats", action="store_true",
+                    help="print per-rule timing + file count after the "
+                         "report (slow-rule regressions surface before "
+                         "they eat the gate's 5s lint budget); with "
+                         "--json the timings ride the report's "
+                         "rule_ms/callgraph_ms/lint_ms keys")
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
@@ -418,12 +438,55 @@ def main(argv=None) -> int:
     if args.command == "lint":
         # pure-stdlib path by design: `har lint` must run in the
         # release gate without initializing a jax backend
-        from har_tpu.analyze import run_harlint
+        from har_tpu.analyze import (
+            changed_fileset_paths,
+            default_rules,
+            repo_root,
+            run_harlint,
+        )
 
+        rules = None
+        if args.rule:
+            known = {r.rule_id: r for r in default_rules()}
+            bad = [r for r in args.rule if r not in known]
+            if bad:
+                raise SystemExit(
+                    f"unknown rule id(s) {', '.join(bad)} — "
+                    f"available: {', '.join(sorted(known))}"
+                )
+            # dedupe, order-preserving: a repeated --rule HL00X must
+            # not run the rule twice (doubled findings, doubled rc)
+            rules = [known[r] for r in dict.fromkeys(args.rule)]
+        paths = args.paths or None
+        if args.changed is not None:
+            if paths is not None:
+                raise SystemExit(
+                    "--changed computes its own path subset; drop the "
+                    "explicit paths (or drop --changed)"
+                )
+            paths = changed_fileset_paths(repo_root(), args.changed)
+            if not paths:
+                if args.as_json:
+                    # --json promises one parseable report line even
+                    # for the cleanest commit — same shape, zero files
+                    from har_tpu.analyze import LintReport
+
+                    print(json.dumps(LintReport(
+                        findings=[], baselined=0,
+                        annotation_suppressed=0, rules_run=[],
+                        files=0, baseline_path="", baseline_size=0,
+                    ).to_json()))
+                else:
+                    print(
+                        f"harlint: no fileset files changed vs "
+                        f"{args.changed} — nothing to lint"
+                    )
+                return 0
         report = run_harlint(
-            paths=args.paths or None,
+            paths=paths,
             baseline=args.baseline,
             update_baseline=args.update_baseline,
+            rules=rules,
         )
         if args.as_json:
             print(json.dumps(report.to_json()))
@@ -434,6 +497,8 @@ def main(argv=None) -> int:
             )
         else:
             print(report.render())
+        if args.stats and not args.as_json:
+            print(report.render_stats())
         return 0 if report.ok else 1
 
     if args.command == "bench":

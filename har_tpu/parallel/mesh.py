@@ -98,6 +98,9 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
 
 def data_shard_count(mesh: Mesh) -> int:
     """How many ways the batch dimension splits on this mesh."""
+    # host-side mesh-shape arithmetic (a dict of ints), evaluated once
+    # at scorer construction — no device value is touched
+    # harlint: host-ok
     return int(
         np.prod([mesh.shape[a] for a in data_axes(mesh)], dtype=np.int64)
     ) if data_axes(mesh) else 1

@@ -163,6 +163,11 @@ def make_gspmd_scan_fit(
         )
         return params, opt_state, losses
 
+    # placement-driven GSPMD by design (module docstring): params
+    # arrive tp-sharded via shard_params, the batch is constrained to
+    # P(dp) inside the step, and XLA propagates — declaring
+    # in_shardings here would force one layout per call site instead
+    # harlint: spec-ok
     return jax.jit(fit, donate_argnums=(0, 1))
 
 
@@ -202,6 +207,10 @@ def make_gspmd_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    # same reviewed placement-driven pattern as make_gspmd_scan_fit:
+    # input placements (shard_params + trainer.batch_sharding) drive
+    # the partitioning
+    # harlint: spec-ok
     return jax.jit(step, donate_argnums=(0, 1))
 
 

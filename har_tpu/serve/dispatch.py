@@ -379,8 +379,11 @@ class ShardedScorer(DeviceScorer):
 
         self.mesh = mesh
         self.devices = data_shard_count(mesh)
+        # mesh.devices is the host-side device-object grid; enumerating
+        # ids at construction touches no device buffer
         self.device_labels = tuple(
-            str(d.id) for d in np.asarray(mesh.devices).flat
+            str(d.id)
+            for d in np.asarray(mesh.devices).flat  # harlint: host-ok
         )
         self._sharding = batch_sharding(mesh, ndim=3)
 

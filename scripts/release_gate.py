@@ -17,12 +17,15 @@ that impossible to repeat by construction:
                                           #   without running the suite
 
 What it does:
-  0. ``har lint --check`` (harlint, har_tpu.analyze): the five fleet
-     invariant rules — hot-path host-sync, state completeness,
-     journal/replay exhaustiveness, determinism, durability — must
-     report zero non-baselined findings; any finding refuses the
-     snapshot before the suite runs.  ``{rules_run, findings,
-     suppressed}`` is stamped into the gate log.
+  0. ``har lint --check`` (harlint, har_tpu.analyze): the eight fleet
+     invariant rules — hot-path host-sync over the computed launch
+     reachability, state completeness, journal/replay exhaustiveness,
+     determinism, durability, jit-purity, partition-spec coverage,
+     stale-suppression audit — must report zero non-baselined
+     findings AND finish inside the 5 s fresh-interpreter budget; any
+     finding (or a slow lint) refuses the snapshot before the suite
+     runs.  ``{rules_run, findings, per_rule, suppressed, lint_ms}``
+     is stamped into the gate log.
   1. ``pytest tests/ -m "not slow" -q``; any failure => exit 1, no edits.
   2. ``pytest --collect-only`` for both tiers; rewrites the two count
      lines in README.md (anchored on the ``# smoke tier:`` / ``# full
@@ -207,17 +210,27 @@ def _cluster_smoke() -> dict:
     )
 
 
+LINT_BUDGET_MS = 5000  # fresh-interpreter wall clock, import included
+
+
 def _harlint() -> dict:
-    """harlint verdict (`har lint --check --json`): the five fleet
-    invariant rules (hot-path purity HL001, state completeness HL002,
-    journal/replay exhaustiveness HL003, determinism HL004, durability
-    HL005) must report zero non-baselined findings.  Runs in its own
-    interpreter like every other smoke, but the rules are pure-stdlib
-    ast walking: no jax backend is ever initialized (the subprocess
-    pays only the package's module import — har_tpu/__init__ tolerates
-    a missing jax outright) and the whole stage costs a couple of
-    seconds, so it runs FIRST: a structural violation fails the gate
-    before the suite burns minutes proving it differently."""
+    """harlint verdict (`har lint --check --json`): the eight fleet
+    invariant rules (hot-path purity HL001 over the computed launch
+    reachability, state completeness HL002, journal/replay
+    exhaustiveness HL003, determinism HL004, durability HL005,
+    jit-purity HL006, partition-spec coverage HL007, stale-suppression
+    audit HL008) must report zero non-baselined findings.  Runs in its
+    own interpreter like every other smoke, but the rules are
+    pure-stdlib ast walking: no jax backend is ever initialized (the
+    subprocess pays only the package's module import — har_tpu/__init__
+    tolerates a missing jax outright), so it runs FIRST: a structural
+    violation fails the gate before the suite burns minutes proving it
+    differently.  The stamp carries ``per_rule`` finding counts and
+    ``lint_ms`` — the FRESH-INTERPRETER wall clock, which the gate
+    budgets at 5 s: a lint slow enough to get skipped in pre-commit
+    loops is a lint that stops guarding, so a slow rule is RED here
+    exactly like a finding."""
+    t0 = time.perf_counter()
     proc = subprocess.run(
         [
             sys.executable, "-m", "har_tpu.cli", "lint",
@@ -227,18 +240,33 @@ def _harlint() -> dict:
         capture_output=True,
         text=True,
     )
+    lint_ms = round((time.perf_counter() - t0) * 1e3, 1)
     try:
         out = json.loads(proc.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         return {
             "ok": False,
+            "lint_ms": lint_ms,
             "error": (
                 f"unparseable har lint output (rc={proc.returncode}): "
                 f"{(proc.stdout + proc.stderr)[-500:]}"
             ),
         }
     out.pop("findings_list", None)  # gate log carries counts, not bodies
-    out["ok"] = bool(out.get("ok")) and proc.returncode == 0
+    out["lint_ms"] = lint_ms  # subprocess wall beats the in-process
+    #                           number: imports + parse are real cost
+    out["budget_ms"] = LINT_BUDGET_MS
+    out["ok"] = (
+        bool(out.get("ok"))
+        and proc.returncode == 0
+        and lint_ms <= LINT_BUDGET_MS
+    )
+    if lint_ms > LINT_BUDGET_MS:
+        out["error"] = (
+            f"lint took {lint_ms:.0f} ms > {LINT_BUDGET_MS} ms budget "
+            "(fresh interpreter) — run `har lint --stats` to find the "
+            "slow rule"
+        )
     return out
 
 

@@ -1,8 +1,10 @@
 """harlint (har_tpu.analyze): every rule pinned against minimal
-positive AND negative fixture snippets, plus the two acceptance
-mutations — deleting a FleetStats field from state() and deleting a
-replay handler from recover.py must each produce a finding (which the
-release gate turns into a non-zero exit).
+positive AND negative fixture snippets, plus the acceptance mutations
+— a sync inserted in a helper reachable from `launch` (NOT on PR 6's
+old name list), a FleetStats field deleted from state(), a replay
+handler deleted from recover.py, a mesh-axis typo / deleted kernel
+spec in tensor_parallel.py, and a stale fetch-ok annotation must each
+produce a finding (which the release gate turns into a non-zero exit).
 
 The fixtures run through ``lint_sources`` (in-memory path→source
 pairs), so each rule's trigger surface is pinned without touching the
@@ -12,11 +14,13 @@ contract.
 """
 
 import json
+import subprocess
 from pathlib import Path
 
 import pytest
 
 from har_tpu.analyze import (
+    changed_fileset_paths,
     default_rules,
     lint_sources,
     repo_root,
@@ -27,14 +31,23 @@ from har_tpu.analyze.baseline import (
     load_baseline,
     write_baseline,
 )
+from har_tpu.analyze.callgraph import CallGraph
 from har_tpu.analyze.core import FileContext
 from har_tpu.analyze.determinism import DeterminismRule
 from har_tpu.analyze.durability import DurabilityRule
 from har_tpu.analyze.hotpath import HotPathRule
+from har_tpu.analyze.jitpurity import JitPurityRule
 from har_tpu.analyze.journalcheck import JournalExhaustivenessRule
+from har_tpu.analyze.partitionspec import PartitionSpecRule
 from har_tpu.analyze.statecheck import StateCompletenessRule
+from har_tpu.analyze.suppressions import SuppressionAuditRule
 
 REPO = Path(__file__).resolve().parent.parent
+
+ALL_RULES = (
+    "HL001", "HL002", "HL003", "HL004",
+    "HL005", "HL006", "HL007", "HL008",
+)
 
 
 def _rules_of(findings):
@@ -143,6 +156,40 @@ class S:
     assert any("@jit body" in f.message for f in findings)
     # .item() is a real sync wherever it appears: host-ok never covers it
     assert any(".item()" in f.message for f in findings)
+
+
+def test_hl001_jit_by_name_is_lexically_scoped():
+    """`jax.jit(forward)` resolves its Name LEXICALLY (the innermost
+    enclosing scope binding a def of that name, then the module) — an
+    unrelated nested def merely SHARING the name elsewhere in the file
+    is never scanned as a traced body."""
+    src = """
+import jax
+import numpy as np
+
+class A:
+    def __init__(self):
+        def forward(x):
+            return x + 1
+        self.fn = jax.jit(forward)
+
+class B:
+    def __init__(self, x):
+        def forward(v):
+            return np.asarray(v)
+        self.labels = forward(x)
+"""
+    assert lint_sources(
+        {"har_tpu/serve/loadgen.py": src}, [HotPathRule()]
+    ) == []
+    # the def the wrapping call actually resolves to IS scanned
+    bad = src.replace("return x + 1", "return np.asarray(x)")
+    findings = lint_sources(
+        {"har_tpu/serve/loadgen.py": bad}, [HotPathRule()]
+    )
+    assert len(findings) == 1
+    assert findings[0].symbol.endswith("forward")
+    assert "@jit body" in findings[0].message
 
 
 # --------------------------------------------------------------- HL002
@@ -646,6 +693,38 @@ def test_update_baseline_on_path_subset_preserves_other_entries(tmp_path):
     assert r3.ok and r3.baselined == 1
 
 
+def test_update_baseline_on_rule_subset_preserves_other_rules(tmp_path):
+    """`--rule HL004 --update-baseline` must not retire another rule's
+    reviewed entries: the rewrite's coverage is (rule × file), and a
+    rule that did not run produced no findings by construction —
+    absence of evidence, not a fixed violation."""
+    pkg = tmp_path / "har_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "adapt").mkdir()
+    (pkg / "serve" / "engine.py").write_text(
+        "import time\na = time.time()\n"
+    )
+    (pkg / "adapt" / "registry.py").write_text(
+        "import json\n\n\ndef save(path, meta):\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(meta, f)\n"
+    )
+    base = tmp_path / "base.json"
+    r = run_harlint(root=tmp_path, baseline=base, update_baseline=True)
+    assert r.ok and r.baselined == 2
+    # a single-rule pass over the SAME files rewrites only its own axis
+    r2 = run_harlint(
+        root=tmp_path, baseline=base, update_baseline=True,
+        rules=[DeterminismRule()],
+    )
+    assert r2.ok
+    assert any(
+        e.startswith("HL005|") for e in load_baseline(base)
+    ), "rule-subset --update-baseline retired HL005's reviewed entry"
+    r3 = run_harlint(root=tmp_path, baseline=base)
+    assert r3.ok and r3.baselined == 2
+
+
 def test_analyze_package_is_stdlib_only():
     """The release gate runs `har lint` before anything jax-shaped: no
     module in har_tpu/analyze may import jax or numpy (and
@@ -698,13 +777,14 @@ def test_repo_lints_clean_with_committed_baseline():
     annotations, not baseline entries)."""
     report = run_harlint()
     assert report.ok, "\n" + report.render()
-    assert report.rules_run == [
-        "HL001", "HL002", "HL003", "HL004", "HL005",
-    ]
-    assert report.files >= 15  # serve + adapt + serving + durable
-    assert report.baseline_size <= 5  # near-empty by policy
+    assert report.rules_run == list(ALL_RULES)
+    assert report.files >= 25  # serve + adapt + parallel + shared
+    assert report.baseline_size == 0  # EMPTY by policy since PR 8
     # the reviewed in-code escapes are accounted, not invisible
-    assert report.annotation_suppressed >= 8
+    assert report.annotation_suppressed >= 13
+    # per-rule accounting is zero-filled over every rule that ran
+    assert set(report.per_rule) == set(ALL_RULES)
+    assert all(v == 0 for v in report.per_rule.values())
 
 
 def test_cli_lint_json_and_rc(capsys):
@@ -714,11 +794,13 @@ def test_cli_lint_json_and_rc(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["ok"] is True
     assert out["findings"] == 0
-    assert set(out["rules_run"]) == {
-        "HL001", "HL002", "HL003", "HL004", "HL005",
-    }
-    for key in ("suppressed", "baselined", "baseline_size"):
+    assert set(out["rules_run"]) == set(ALL_RULES)
+    for key in (
+        "suppressed", "baselined", "baseline_size", "per_rule",
+        "rule_ms", "callgraph_ms", "lint_ms",
+    ):
         assert key in out
+    assert set(out["per_rule"]) == set(ALL_RULES)
 
 
 def test_cli_lint_nonzero_on_finding(tmp_path, capsys):
@@ -735,3 +817,1094 @@ def test_cli_lint_nonzero_on_finding(tmp_path, capsys):
     # the real repo, restricted to one clean file, still exits 0
     assert main(["lint", "har_tpu/utils/durable.py", "--check"]) == 0
     capsys.readouterr()
+
+
+# ----------------------------------------------------- callgraph (PR 8)
+
+
+_GRAPH_FIXTURE = {
+    "har_tpu/serve/engine.py": """
+from har_tpu.serve.dispatch import StagingArena, make_scorer
+
+class FleetServer:
+    def __init__(self):
+        self._arena = StagingArena(8)
+        self._scorer = None
+
+    def _get_scorer(self):
+        if self._scorer is None:
+            self._scorer = make_scorer(object())
+        return self._scorer
+
+    def _launch_batch(self):
+        scorer = self._get_scorer()
+        windows = scorer.pad(self._arena.gather([0]))
+
+        def _attempt():
+            return scorer.launch(windows)
+
+        return _attempt()
+""",
+    "har_tpu/serve/dispatch.py": """
+import numpy as np
+
+class StagingArena:
+    def __init__(self, cap):
+        self._buf = [0] * cap
+
+    def gather(self, slots):
+        return self.helper(slots)
+
+    def helper(self, slots):
+        return np.asarray(slots)          # two calls below launch
+
+class HostScorer:
+    def pad(self, w):
+        return w
+
+    def launch(self, w):
+        return w
+
+class DeviceScorer(HostScorer):
+    def _place(self, w):
+        return w.block_until_ready()      # subclass override reached
+
+    def launch(self, w):
+        return self._place(w)
+
+def make_scorer(model):
+    try:
+        return DeviceScorer()
+    except ValueError:
+        return HostScorer()
+""",
+}
+
+
+def test_callgraph_resolves_typed_attrs_returns_and_closures():
+    """The tentpole mechanics in one fixture: `self._arena` typed from
+    its constructor, `scorer` typed through `_get_scorer`'s return into
+    `make_scorer`'s constructed classes, subclass overrides of
+    `_place`, nested closures, and cross-module imports all resolve."""
+    ctxs = [
+        FileContext(rel, src) for rel, src in sorted(_GRAPH_FIXTURE.items())
+    ]
+    graph = CallGraph(ctxs)
+    roots = [
+        fi for fi in graph.functions.values() if fi.name == "_launch_batch"
+    ]
+    reach = graph.reachable(roots)
+    quals = {graph.functions[k].qual for k in reach}
+    assert "FleetServer._get_scorer" in quals
+    assert "make_scorer" in quals                  # via return inference
+    assert "StagingArena.gather" in quals          # via attr type
+    assert "StagingArena.helper" in quals          # two calls deep
+    assert "DeviceScorer._place" in quals          # self-call
+    assert "HostScorer.pad" in quals               # inherited lookup
+    assert "FleetServer._launch_batch._attempt" in quals  # closure
+
+
+def test_hl001_reaches_syncs_beyond_the_old_name_list():
+    """The v1 gap, closed: `StagingArena.helper` is on no name list but
+    holds a host sync two calls below `launch` — flagged, with the
+    reach chain named in the message."""
+    findings = lint_sources(dict(_GRAPH_FIXTURE), [HotPathRule()])
+    by_sym = {f.symbol: f for f in findings}
+    assert "StagingArena.helper" in by_sym
+    assert "np.asarray" in by_sym["StagingArena.helper"].message
+    assert "reached from launch root" in by_sym["StagingArena.helper"].message
+    assert "DeviceScorer._place" in by_sym
+    assert "block_until_ready" in by_sym["DeviceScorer._place"].message
+
+
+def test_hl001_acceptance_real_sync_two_calls_below_launch():
+    """THE tentpole acceptance mutation: a host sync inserted into
+    `_split_predict` — reachable only through `_launch_batch` →
+    `_get_scorer` → `make_scorer` → `DeviceScorer.__init__`, absent
+    from PR 6's hand-listed surface — must produce an HL001 finding
+    (the release gate then exits non-zero)."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serving.py",
+        "har_tpu/utils/backoff.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/parallel/sharding.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(dict(sources), [HotPathRule()]) == []
+    mutated = sources["har_tpu/serve/dispatch.py"].replace(
+        "    pre = None\n    inner = model\n",
+        "    pre = None\n    model.params.block_until_ready()\n"
+        "    inner = model\n",
+    )
+    assert mutated != sources["har_tpu/serve/dispatch.py"], (
+        "dispatch.py _split_predict anchor changed"
+    )
+    sources["har_tpu/serve/dispatch.py"] = mutated
+    findings = lint_sources(sources, [HotPathRule()])
+    assert [f.symbol for f in findings] == ["_split_predict"]
+    assert "block_until_ready" in findings[0].message
+    assert "reached from launch root" in findings[0].message
+
+
+# --------------------------------------------------------------- HL006
+
+
+def test_hl006_flags_impurity_through_the_closure():
+    src = """
+import time
+import jax
+
+class Counter:
+    pass
+
+hits = {}
+
+def helper(x, log):
+    hits["n"] = 1                 # closed-over subscript write
+    log.append(x)                 # closed-over container mutation
+    print("step", x)              # trace-time print
+    t = time.perf_counter()       # trace-time clock
+    return x
+
+@jax.jit
+def step(x, log):
+    return helper(x, log)
+"""
+    findings = lint_sources({"har_tpu/serve/loadgen.py": src},
+                            [JitPurityRule()])
+    msgs = " | ".join(f.message for f in findings)
+    assert {f.symbol for f in findings} == {"helper"}
+    assert len(findings) == 4
+    assert "subscript write into closed-over `hits`" in msgs
+    assert "`.append(...)` on closed-over `log`" in msgs
+    assert "`print(...)`" in msgs
+    assert "time.perf_counter()" in msgs
+    assert "traced via" in msgs
+
+
+def test_hl006_self_mutation_and_shard_map_roots():
+    src = """
+import jax
+
+class Model:
+    def make(self, mesh):
+        def local_step(p, x):
+            self.calls = self.calls + 1   # frozen-counter trap
+            return self._mul(p, x)
+
+        return jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(None, None), out_specs=None,
+        )
+
+    def _mul(self, p, x):
+        return p * x
+"""
+    findings = lint_sources({"har_tpu/serve/loadgen.py": src},
+                            [JitPurityRule()])
+    assert len(findings) == 1
+    assert "assignment to `self.calls`" in findings[0].message
+    assert findings[0].symbol == "Model.make.local_step"
+
+
+def test_hl006_negative_pure_traced_bodies_and_syncs_stay_hl001():
+    """Pure jit/shard_map/scan bodies are clean; a sync DIRECTLY in a
+    jit body stays HL001's finding (one finding, not two)."""
+    pure = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(params, x):
+    def mean_loss(p):
+        return jnp.sum(p * x)
+
+    loss, grads = jax.value_and_grad(mean_loss)(params)
+    params = {k: v - grads[k] for k, v in params.items()}
+    return params, loss
+"""
+    assert lint_sources({"har_tpu/serve/loadgen.py": pure},
+                        [JitPurityRule()]) == []
+    direct = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return np.asarray(x) + 1
+"""
+    both = lint_sources({"har_tpu/serve/loadgen.py": direct},
+                        [HotPathRule(), JitPurityRule()])
+    assert [f.rule for f in both] == ["HL001"]
+
+
+def test_hl006_real_parallel_package_is_pure():
+    """The real traced surfaces (tensor/data/pipeline/expert parallel,
+    zero1, dispatch, loadgen) lint pure — the merge-time contract for
+    the DrJAX-style primitives the ROADMAP grows."""
+    sources = {}
+    for rel in (
+        "har_tpu/parallel/tensor_parallel.py",
+        "har_tpu/parallel/data_parallel.py",
+        "har_tpu/parallel/pipeline_parallel.py",
+        "har_tpu/parallel/expert_parallel.py",
+        "har_tpu/parallel/zero1.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serve/loadgen.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JitPurityRule()]) == []
+
+
+# --------------------------------------------------------------- HL007
+
+
+_SPEC_FIXTURE = """
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+def dense_specs(params, tp_axis=TP_AXIS):
+    specs = {}
+    for i, path in enumerate(params):
+        specs[path] = P(None, tp_axis) if i % 2 == 0 else P(tp_axis, None)
+    return specs
+
+def make_step(fn, mesh):
+    def local_step(p, x):
+        return fn(p, x)
+
+    return jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(DP_AXIS)), out_specs=P(),
+    )
+"""
+
+
+def test_hl007_clean_fixture_passes():
+    assert lint_sources(
+        {"har_tpu/parallel/fixture.py": _SPEC_FIXTURE},
+        [PartitionSpecRule()],
+    ) == []
+
+
+def test_hl007_axis_typo_and_missing_specs_and_bare_jit():
+    src = _SPEC_FIXTURE.replace("P(DP_AXIS)", 'P("dpp")').replace(
+        'in_specs=(P(), P("dpp")), out_specs=P(),',
+        'in_specs=(P(), P("dpp")),',
+    ) + "\n\ndef jit_it(fn):\n    return jax.jit(fn)\n"
+    assert "out_specs" not in src.split("def jit_it")[0].split(
+        "def make_step"
+    )[1], "fixture mutation failed to drop out_specs"
+    findings = lint_sources(
+        {"har_tpu/parallel/fixture.py": src}, [PartitionSpecRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "axis `dpp` is not a declared mesh axis" in msgs
+    assert "without out_specs" in msgs
+    assert "no in_shardings/out_shardings" in msgs
+
+
+def test_hl007_arity_replication_and_spec_ok():
+    src = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+DP_AXIS = "dp"
+
+def make(fn, mesh):
+    def local_step(p, x, mask):
+        return fn(p, x, mask)
+
+    return jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(DP_AXIS)), out_specs=P(),
+    )
+
+def make_replicated(fn, mesh):
+    def local(p, x):
+        return fn(p, x)
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+    )
+
+def reviewed(fn):
+    # placement-driven: inputs arrive sharded
+    # harlint: spec-ok
+    return jax.jit(fn)
+"""
+    findings = lint_sources(
+        {"har_tpu/parallel/fixture.py": src}, [PartitionSpecRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "declares 2 placements but `local_step` takes 3" in msgs
+    assert "fully-replicated `P()`" in msgs
+    assert "spec-ok" not in {f.symbol for f in findings}
+    assert not any(f.symbol == "reviewed" for f in findings)
+
+
+def test_hl007_acceptance_real_tensor_parallel_mutations():
+    """THE HL007 acceptance mutations against the REAL sources: (1) a
+    mesh-axis typo in dense_alternating_specs' default, (2) deleting
+    the kernel spec (everything falls to P() — implicit full
+    replication of every 2-D kernel) — each fails the gate; the
+    committed tree is clean."""
+    sources = {}
+    for rel in (
+        "har_tpu/parallel/tensor_parallel.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/parallel/data_parallel.py",
+        "har_tpu/parallel/sharding.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(dict(sources), [PartitionSpecRule()]) == []
+    # (1) axis typo: the default param silently names a ghost axis
+    typo = dict(sources)
+    typo["har_tpu/parallel/tensor_parallel.py"] = sources[
+        "har_tpu/parallel/tensor_parallel.py"
+    ].replace("tp_axis: str = TP_AXIS", 'tp_axis: str = "tpz"')
+    assert typo != sources
+    findings = lint_sources(typo, [PartitionSpecRule()])
+    msgs = " | ".join(f.message for f in findings)
+    assert "`tpz` is not a declared mesh axis" in msgs
+    # (2) deleted kernel spec: dense_alternating_specs shards nothing
+    flat = dict(sources)
+    flat["har_tpu/parallel/tensor_parallel.py"] = sources[
+        "har_tpu/parallel/tensor_parallel.py"
+    ].replace(
+        "spec = (\n                P(None, tp_axis) if kernel_index % 2 "
+        "== 0 else P(tp_axis, None)\n            )",
+        "spec = P()",
+    )
+    assert (
+        flat["har_tpu/parallel/tensor_parallel.py"]
+        != sources["har_tpu/parallel/tensor_parallel.py"]
+    ), "tensor_parallel.py kernel-spec anchor changed"
+    findings2 = lint_sources(flat, [PartitionSpecRule()])
+    msgs2 = " | ".join(f.message for f in findings2)
+    assert "dense_alternating_specs" in msgs2
+    assert "implicitly FULLY REPLICATED" in msgs2
+
+
+# --------------------------------------------------------------- HL008
+
+
+def test_hl008_stale_annotation_is_flagged_and_live_one_is_not():
+    live = """
+import numpy as np
+
+class Scorer:
+    def fetch(self, handle, k):
+        return np.asarray(handle[:k])  # harlint: fetch-ok
+"""
+    assert lint_sources(
+        {"har_tpu/serve/dispatch.py": live},
+        [HotPathRule(), SuppressionAuditRule()],
+    ) == []
+    stale = live.replace("np.asarray(handle[:k])", "handle[:k]")
+    findings = lint_sources(
+        {"har_tpu/serve/dispatch.py": stale},
+        [HotPathRule(), SuppressionAuditRule()],
+    )
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "HL008" and "stale `# harlint: fetch-ok`" in f.message
+    assert f.symbol == "Scorer.fetch"
+
+
+def test_hl008_owner_rule_must_run_and_disable_staleness():
+    """A `--rule` subset that skips the owning rule cannot judge its
+    annotations (no false stale); a stale `disable=` is flagged too."""
+    src = """
+import numpy as np
+
+class Scorer:
+    def fetch(self, handle, k):
+        return handle[:k]  # harlint: fetch-ok
+"""
+    # HL001 did not run: the fetch-ok is unjudgeable, not stale
+    assert lint_sources(
+        {"har_tpu/serve/dispatch.py": src},
+        [DeterminismRule(), SuppressionAuditRule()],
+    ) == []
+    stale_disable = (
+        "import time\n"
+        "now = 1  # harlint: disable=HL004\n"
+    )
+    findings = lint_sources(
+        {"har_tpu/serve/engine.py": stale_disable},
+        [DeterminismRule(), SuppressionAuditRule()],
+    )
+    assert len(findings) == 1
+    assert "stale `# harlint: disable=HL004`" in findings[0].message
+
+
+def test_hl008_acceptance_real_dispatch_sync_removed():
+    """THE HL008 acceptance mutation: removing the reviewed sync under
+    a real `# harlint: fetch-ok` in dispatch.py leaves the annotation
+    stale — flagged; the committed tree is clean."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serving.py",
+        "har_tpu/utils/backoff.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/parallel/sharding.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    rules = lambda: [HotPathRule(), SuppressionAuditRule()]
+    assert lint_sources(dict(sources), rules()) == []
+    mutated = sources["har_tpu/serve/dispatch.py"].replace(
+        "return np.asarray(handle[:k], np.float64)  # harlint: fetch-ok\n"
+        "\n"
+        "    def measure",
+        "return handle[:k]  # harlint: fetch-ok\n"
+        "\n"
+        "    def measure",
+    )
+    assert mutated != sources["har_tpu/serve/dispatch.py"], (
+        "dispatch.py HostScorer.fetch anchor changed"
+    )
+    sources["har_tpu/serve/dispatch.py"] = mutated
+    findings = lint_sources(sources, rules())
+    assert [f.rule for f in findings] == ["HL008"]
+    assert "stale `# harlint: fetch-ok`" in findings[0].message
+    assert findings[0].symbol == "HostScorer.fetch"
+
+
+# ---------------------------------------------------------- HL004 (gap)
+
+
+def test_hl004_gap_clock_callables_and_datetime():
+    src = """
+import datetime
+import time
+
+class Registry:
+    def __init__(self, clock=None):
+        self._clock = clock or time.time      # callable, not a call
+
+    def stamp(self):
+        a = datetime.datetime.now()
+        b = datetime.datetime.utcnow()
+        return a, b
+"""
+    findings = lint_sources(
+        {"har_tpu/adapt/registry2.py": src}, [DeterminismRule()]
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "stored/passed as a callable" in msgs
+    assert "`datetime.now()`" in msgs
+    assert "`datetime.utcnow()`" in msgs
+    # the monotonic injectable default stays allowed
+    ok = src.replace("time.time", "time.monotonic").replace(
+        "a = datetime.datetime.now()", "a = None"
+    ).replace("b = datetime.datetime.utcnow()", "b = None")
+    assert lint_sources(
+        {"har_tpu/adapt/registry2.py": ok}, [DeterminismRule()]
+    ) == []
+
+
+def test_hl004_real_registry_wall_clock_is_a_reviewed_contract():
+    """The real finding this gap closed at introduction: the registry's
+    wall-clock default is now an annotated, reviewed contract — and
+    un-annotating it re-flags."""
+    real = (REPO / "har_tpu" / "adapt" / "registry.py").read_text()
+    assert lint_sources(
+        {"har_tpu/adapt/registry.py": real}, [DeterminismRule()]
+    ) == []
+    unannotated = real.replace("        # harlint: disable=HL004\n", "")
+    assert unannotated != real, "registry.py HL004 annotation anchor changed"
+    findings = lint_sources(
+        {"har_tpu/adapt/registry.py": unannotated}, [DeterminismRule()]
+    )
+    assert len(findings) == 1
+    assert "stored/passed as a callable" in findings[0].message
+
+
+# ------------------------------------------- baseline property + CLI
+
+
+def test_baseline_survives_rename_move_and_line_shift():
+    """Satellite property pin: a baselined finding keyed
+    rule|path|symbol|snippet stays suppressed through a file
+    rename/move AND a ±50-line shift (exact keys absorb the shift;
+    the path-agnostic fallback absorbs the rename)."""
+    src = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+    )
+    base_findings = lint_sources(
+        {"har_tpu/serve/engine.py": src}, [DeterminismRule()]
+    )
+    assert len(base_findings) == 1
+    baseline = {f.key() for f in base_findings}
+    for shift in (-50, -7, 0, 13, 50):
+        for rel in (
+            "har_tpu/serve/engine.py",            # unchanged path
+            "har_tpu/serve/renamed_engine.py",    # rename
+            "har_tpu/adapt/moved_here.py",        # move across dirs
+        ):
+            pad = max(0, shift)
+            lead = "# pad\n" * pad
+            shifted = lint_sources(
+                {rel: lead + src}, [DeterminismRule()]
+            )
+            assert len(shifted) == 1
+            fresh, n = apply_baseline(shifted, baseline)
+            assert fresh == [] and n == 1, (rel, shift)
+    # the fallback consumes each entry ONCE: a second copy of the
+    # violation is fresh, not silently covered
+    twice = lint_sources(
+        {
+            "har_tpu/serve/engine.py": src,
+            "har_tpu/serve/copy.py": src,
+        },
+        [DeterminismRule()],
+    )
+    assert len(twice) == 2
+    fresh, n = apply_baseline(twice, baseline)
+    assert n == 1 and len(fresh) == 1
+
+
+def test_changed_fileset_paths_and_subset_semantics(tmp_path):
+    """`har lint --changed` plumbing: only fileset files that differ
+    from the ref (or are untracked) are linted; HL008 is dropped on
+    the subset (staleness is a whole-fileset property)."""
+    pkg = tmp_path / "har_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "engine.py").write_text("a = 1\n")
+    (pkg / "other.py").write_text("b = 2\n")
+    (tmp_path / "README.md").write_text("x\n")
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "commit", "-qm", "seed"],
+    ):
+        subprocess.run(cmd, cwd=tmp_path, check=True, env={
+            **__import__("os").environ, **env,
+        })
+    (pkg / "engine.py").write_text("import time\nnow = time.time()\n")
+    (pkg / "fresh.py").write_text("c = 3\n")  # untracked joins the set
+    changed = changed_fileset_paths(tmp_path, "HEAD")
+    assert changed == [
+        "har_tpu/serve/engine.py", "har_tpu/serve/fresh.py",
+    ]
+    report = run_harlint(
+        root=tmp_path, paths=changed, baseline=tmp_path / "b.json"
+    )
+    assert "HL008" not in report.rules_run  # subset drops the audit
+    assert len(report.findings) == 1
+    assert report.files == 2
+
+
+def test_cli_lint_rule_filter(capsys):
+    from har_tpu.cli import main
+
+    assert main(["lint", "--rule", "HL005", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["rules_run"] == ["HL005"]
+    with pytest.raises(SystemExit):
+        main(["lint", "--rule", "HL099"])
+
+
+def test_cli_lint_stats_renders(capsys):
+    from har_tpu.cli import main
+
+    assert main(["lint", "har_tpu/utils/durable.py", "--check",
+                 "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert "harlint --stats (per-rule):" in out
+    assert "callgraph build:" in out
+
+
+# ----------------------------------------------- code-review regressions
+
+
+def test_callgraph_depth_reaches_real_scorer_pad_family():
+    """Depth-cap regression pin: resolving `scorer.pad(...)` in the
+    REAL `_launch_batch` costs 7 inference levels (`self._get_scorer()`
+    -> `return self._scorer` -> attr expr `make_scorer(...)` -> its
+    returns -> the constructed scorer classes).  A cap one level short
+    silently dropped the whole pad family from the launch closure —
+    and a memoized depth-truncated (empty) return-type set kept it
+    dropped for every later query.  The pad family PR 6 covered by
+    name must stay reachable, and a sync planted in a pad body must
+    flag."""
+    rels = (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serving.py",
+        "har_tpu/utils/backoff.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/parallel/sharding.py",
+    )
+    sources = {rel: (REPO / rel).read_text() for rel in rels}
+    ctxs = [FileContext(rel, src) for rel, src in sorted(sources.items())]
+    graph = CallGraph(ctxs)
+    roots = [
+        fi for fi in graph.functions.values()
+        if fi.name == "_launch_batch" and fi.rel == "har_tpu/serve/engine.py"
+    ]
+    assert roots, "engine.py lost _launch_batch — update the pin"
+    quals = {
+        graph.functions[k].qual for k in graph.reachable(roots)
+    }
+    for pad in ("HostScorer.pad", "DeviceScorer.pad", "ShardedScorer.pad"):
+        assert pad in quals, f"{pad} fell out of the launch closure"
+    # and the teeth: a sync in HostScorer.pad is an HL001 finding
+    anchor = "    def pad(self, windows: np.ndarray) -> np.ndarray:\n" \
+             "        return pad_pow2(windows)\n"
+    assert anchor in sources["har_tpu/serve/dispatch.py"], (
+        "HostScorer.pad anchor changed"
+    )
+    mutated = dict(sources)
+    mutated["har_tpu/serve/dispatch.py"] = mutated[
+        "har_tpu/serve/dispatch.py"
+    ].replace(
+        anchor,
+        "    def pad(self, windows: np.ndarray) -> np.ndarray:\n"
+        "        windows.block_until_ready()\n"
+        "        return pad_pow2(windows)\n",
+        1,
+    )
+    findings = lint_sources(mutated, [HotPathRule()])
+    assert [f.symbol for f in findings] == ["HostScorer.pad"]
+    assert "block_until_ready" in findings[0].message
+
+
+def test_hl006_subscript_write_into_argument_container():
+    """A traced body writing `cache[key] = value` into a PASSED-IN dict
+    is the same trace-time-only corruption as a closure write — the
+    parameter must not shield the subscript check (it does not shield
+    the `.append` check either), while a locally-bound container stays
+    fair game."""
+    src = """
+import jax
+
+@jax.jit
+def step(cache, x):
+    cache["k"] = x                # argument container: flagged
+    own = {}
+    own["k"] = x                  # locally bound: fine
+    return x
+"""
+    findings = lint_sources({"har_tpu/serve/loadgen.py": src},
+                            [JitPurityRule()])
+    assert len(findings) == 1
+    assert "subscript write into closed-over `cache`" in findings[0].message
+
+
+def test_hl007_inline_jit_of_shard_map_is_clean():
+    """The idiomatic one-liner `jax.jit(jax.shard_map(...))` carries
+    its placements inside the shard_map call — it must not be flagged
+    as a bare jit (only a genuinely spec-less jit is)."""
+    src = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+DP_AXIS = "dp"
+
+def make(fn, mesh):
+    def local_step(p, x):
+        return fn(p, x)
+
+    return jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(DP_AXIS), P(DP_AXIS)), out_specs=P(DP_AXIS),
+    ))
+"""
+    assert lint_sources(
+        {"har_tpu/parallel/fixture.py": src}, [PartitionSpecRule()]
+    ) == []
+
+
+def test_baseline_covers_duplicate_identical_lines(tmp_path):
+    """The baseline file is a set, so N identical violating lines in
+    one function write ONE deduplicated entry — an exact-key entry must
+    suppress all N (else --update-baseline followed by har lint goes
+    red with zero code change), while an entry not consumed exactly
+    still covers at most one finding through the path-agnostic
+    fallback (a copy in a second file stays fresh)."""
+    src = (
+        "import time\n\ndef f(out):\n"
+        "    out.append(time.time())\n"
+        "    out.append(time.time())\n"
+        "    return out\n"
+    )
+    findings = lint_sources({"har_tpu/serve/x.py": src},
+                            [DeterminismRule()])
+    assert len(findings) == 2
+    assert len({f.key() for f in findings}) == 1
+    p = tmp_path / "b.json"
+    write_baseline(p, findings)
+    fresh, n = apply_baseline(findings, load_baseline(p))
+    assert fresh == [] and n == 2
+    copied = lint_sources(
+        {"har_tpu/serve/x.py": src, "har_tpu/serve/y.py": src},
+        [DeterminismRule()],
+    )
+    fresh2, _ = apply_baseline(copied, load_baseline(p))
+    assert {f.path for f in fresh2} == {"har_tpu/serve/y.py"}
+    assert len(fresh2) == 2
+
+
+def test_hl007_subset_run_loads_axis_declarers():
+    """`har lint --changed` after editing only tensor_parallel.py must
+    judge it against the REAL axis table (mesh.py et al. ride along as
+    support contexts), not an empty one — the spec-builder check
+    false-positived on clean code otherwise.  Support files inform the
+    analysis only: the report covers just the requested path."""
+    report = run_harlint(
+        paths=["har_tpu/parallel/tensor_parallel.py"]
+    )
+    assert report.ok, [f.message for f in report.findings]
+    assert report.files == 1
+
+
+def test_cli_lint_changed_json_empty_set(capsys, monkeypatch):
+    """`har lint --changed --json` on a commit touching no fileset
+    files still prints one parseable JSON report line (the contract
+    the release gate's own parser relies on), rc 0."""
+    import har_tpu.analyze as analyze
+    from har_tpu import cli
+
+    monkeypatch.setattr(
+        analyze, "changed_fileset_paths", lambda root, ref: []
+    )
+    rc = cli.main(["lint", "--changed", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    report = json.loads(out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["files"] == 0
+    assert report["findings"] == 0
+
+
+def test_baseline_stale_entry_cannot_launder_new_file():
+    """A baseline entry whose recorded file WAS linted (and is clean —
+    the violation was fixed without retiring the entry) must not cover
+    an identical brand-new violation in a different file through the
+    path-agnostic fallback; only a genuinely renamed-away file
+    (absent from the linted set) transfers."""
+    src = "import time\ndef stamp():\n    return time.time()\n"
+    entry = {"HL004|har_tpu/serve/engine.py|stamp|return time.time()"}
+    findings = lint_sources(
+        {"har_tpu/serve/other.py": src}, [DeterminismRule()]
+    )
+    assert len(findings) == 1
+    # engine.py was linted (clean): the entry is retired, not portable
+    fresh, n = apply_baseline(
+        findings, entry,
+        fileset_files={"har_tpu/serve/engine.py", "har_tpu/serve/other.py"},
+    )
+    assert len(fresh) == 1 and n == 0
+    # engine.py gone from the fileset: a real rename — covered
+    fresh, n = apply_baseline(
+        findings, entry, fileset_files={"har_tpu/serve/other.py"}
+    )
+    assert fresh == [] and n == 1
+
+
+def test_baseline_rename_keeps_duplicates_covered():
+    """N identical violating lines write one deduplicated entry; after
+    a rename the fallback must cover all N (set semantics like the
+    exact pass), not go red on the (N-1)th duplicate."""
+    src = (
+        "import time\n\ndef f(out):\n"
+        "    out.append(time.time())\n"
+        "    out.append(time.time())\n"
+        "    return out\n"
+    )
+    original = lint_sources({"har_tpu/serve/x.py": src},
+                            [DeterminismRule()])
+    baseline = {f.key() for f in original}
+    assert len(baseline) == 1
+    renamed = lint_sources({"har_tpu/serve/x_renamed.py": src},
+                           [DeterminismRule()])
+    assert len(renamed) == 2
+    fresh, n = apply_baseline(
+        renamed, baseline, fileset_files={"har_tpu/serve/x_renamed.py"}
+    )
+    assert fresh == [] and n == 2
+
+
+def test_hl007_arity_check_resolves_nested_def_lexically():
+    """Two functions each nest a `step` with different arities: the
+    arity check must pin the shard_map against ITS enclosing scope's
+    `step`, not whichever same-named def the function table yields
+    first — wrong both ways (spurious finding / masked drift)."""
+    src = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+DP_AXIS = "dp"
+
+def other(fn, mesh):
+    def step(p, x, mask):
+        return fn(p, x, mask)
+
+    return jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(DP_AXIS), P()), out_specs=P(),
+    )
+
+def make(fn, mesh):
+    def step(p, x):
+        return fn(p, x)
+
+    return jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(DP_AXIS)), out_specs=P(),
+    )
+"""
+    assert lint_sources(
+        {"har_tpu/parallel/fixture.py": src}, [PartitionSpecRule()]
+    ) == []
+    # genuine drift in `make` still flags (and names the 2-arg step)
+    drifted = src.replace("def step(p, x):", "def step(p, x, extra):")
+    findings = lint_sources(
+        {"har_tpu/parallel/fixture.py": drifted}, [PartitionSpecRule()]
+    )
+    assert len(findings) == 1
+    assert "declares 2 placements but `step` takes 3" in findings[0].message
+
+
+def test_subset_run_drops_whole_fileset_rules():
+    """`har lint --changed` touching recover.py (or chaos.py) must not
+    drown in bogus HL003 orphan findings: HL003's writer↔handler↔
+    kill-point bijections only hold over the full fileset, so subset
+    runs drop it exactly like HL008 — the full-set release gate stays
+    the verdict."""
+    report = run_harlint(paths=["har_tpu/serve/recover.py"])
+    assert report.ok, [f.message for f in report.findings]
+    assert "HL003" not in report.rules_run
+    assert "HL008" not in report.rules_run
+    assert "HL001" in report.rules_run
+
+
+def test_hl001_hl006_class_body_define_then_wrap():
+    """A def wrapped BY NAME in its own class body (`step_jit =
+    jax.jit(step)`) executes in the class namespace, where the member
+    name resolves — the wrap must mark `step` a traced root for both
+    HL001 (direct-body syncs) and HL006 (purity), exactly like the
+    module-level define-then-wrap.  A function nested INSIDE the class
+    does not see the class namespace (class scopes do not close), so a
+    same-name reference there must not resolve to the member."""
+    src = """
+import time
+import jax
+
+class Runner:
+    def step(self, x):
+        time.time()
+        return x.item()
+
+    step_jit = jax.jit(step)
+"""
+    hl001 = lint_sources({"har_tpu/serve/fixture.py": src},
+                         [HotPathRule()])
+    assert [f.rule for f in hl001] == ["HL001"]
+    assert ".item()" in hl001[0].message
+    hl006 = lint_sources({"har_tpu/serve/fixture.py": src},
+                         [JitPurityRule()])
+    assert any("time.time()" in f.message for f in hl006)
+    # a method-body wrap cannot reach a class member by bare name
+    # (NameError at runtime) — it must not mark `helper` traced
+    neg = """
+import jax
+
+class Runner:
+    def helper(self, x):
+        return x.item()
+
+    def build(self):
+        return jax.jit(helper)
+"""
+    assert lint_sources({"har_tpu/serve/fixture.py": neg},
+                        [HotPathRule()]) == []
+
+
+def test_changed_subset_loads_launch_roots_as_support(tmp_path):
+    """The --changed fast path judges a changed helper against the
+    REAL reachability roots: `Engine.launch` lives in an unchanged
+    (unrequested) file, yet a host sync in the changed helper it calls
+    must flag exactly as the full run flags it — root-bearing files
+    load as support contexts, and findings in them stay dropped."""
+    pkg = tmp_path / "har_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "eng.py").write_text(
+        "from har_tpu.serve.helper import place\n\n\n"
+        "class Engine:\n"
+        "    def launch(self, batch):\n"
+        "        return place(batch)\n"
+    )
+    (pkg / "helper.py").write_text(
+        "def place(batch):\n"
+        "    return batch.block_until_ready()\n"
+    )
+    report = run_harlint(
+        root=tmp_path, paths=["har_tpu/serve/helper.py"],
+        baseline=tmp_path / "b.json",
+    )
+    assert report.files == 1
+    assert [f.path for f in report.findings] == [
+        "har_tpu/serve/helper.py"
+    ]
+    assert [f.rule for f in report.findings] == ["HL001"]
+    assert "launch" in report.findings[0].message  # names its chain
+
+
+def test_hl006_disable_placement_matches_other_rules():
+    """disable=HL006 is filtered by the same run_rules._apply_disable
+    layer as every other rule: the finding line (or a comment-only
+    line directly above) suppresses; a token on a LATER line of a
+    multi-line statement does not — HL006 no longer carries a private,
+    wider span rule than HL001's identical placement."""
+    line_ok = """
+import jax
+
+@jax.jit
+def step(x):
+    print(x)  # harlint: disable=HL006
+    return x
+"""
+    assert lint_sources({"har_tpu/serve/fixture.py": line_ok},
+                        [JitPurityRule()]) == []
+    span = """
+import jax
+
+@jax.jit
+def step(x, log):
+    log.info(
+        x,
+    )  # harlint: disable=HL006
+    return x
+"""
+    findings = lint_sources({"har_tpu/serve/fixture.py": span},
+                            [JitPurityRule()])
+    assert len(findings) == 1
+    assert "log.info" in findings[0].message
+
+
+def test_hl007_decorator_form_bare_jit_and_partial():
+    """The decorator spellings carry the same reviewed-placement
+    contract as the call form: a bare `@jax.jit` (and a
+    `@partial(jax.jit, ...)` with no shardings) in the parallel
+    package is a finding — is_jit_marked already treats both as jit
+    roots, so before this pin the decorator form was an unreviewed
+    HL007 bypass.  `spec-ok` on the annotation surface suppresses."""
+    bare = """
+import jax
+
+@jax.jit
+def step(p, x):
+    return p + x
+"""
+    findings = lint_sources(
+        {"har_tpu/parallel/fixture.py": bare}, [PartitionSpecRule()]
+    )
+    assert [f.rule for f in findings] == ["HL007"]
+    assert findings[0].symbol == "step"
+    assert "spec-ok" in findings[0].message
+
+    reviewed = bare.replace(
+        "@jax.jit", "# harlint: spec-ok\n@jax.jit"
+    )
+    assert lint_sources(
+        {"har_tpu/parallel/fixture.py": reviewed}, [PartitionSpecRule()]
+    ) == []
+
+    part = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=0)
+def step(n, x):
+    return x * n
+"""
+    findings = lint_sources(
+        {"har_tpu/parallel/fixture.py": part}, [PartitionSpecRule()]
+    )
+    assert [f.rule for f in findings] == ["HL007"]
+    assert "partial(jit, ...)" in findings[0].message
+
+    # outside the parallel package the decorator is not HL007's scope
+    assert lint_sources(
+        {"har_tpu/serve/fixture.py": bare}, [PartitionSpecRule()]
+    ) == []
+
+
+def test_subset_run_examines_requested_files_only(tmp_path):
+    """Support contexts inform the cross-file analysis but are never
+    themselves examined: a subset run's suppression accounting covers
+    the REQUESTED files only (a 1-file --changed run used to report
+    the full fileset's annotation count), and the support files'
+    bodies are not re-scanned just to have their findings dropped."""
+    pkg = tmp_path / "har_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "eng.py").write_text(
+        "import time\n"
+        "from har_tpu.serve.helper import place\n\n\n"
+        "class Engine:\n"
+        "    def launch(self, batch):\n"
+        "        return place(batch)\n\n"
+        "    def fetch(self, handle):\n"
+        "        return handle.block_until_ready()  # harlint: fetch-ok\n"
+    )
+    (pkg / "helper.py").write_text(
+        "def place(batch):\n"
+        "    return batch\n"
+    )
+    full = run_harlint(root=tmp_path, baseline=tmp_path / "b.json")
+    assert full.annotation_suppressed == 1  # eng.py's fetch-ok
+    subset = run_harlint(
+        root=tmp_path, paths=["har_tpu/serve/helper.py"],
+        baseline=tmp_path / "b.json",
+    )
+    assert subset.files == 1
+    assert subset.findings == []
+    # eng.py loaded as support: its fetch-ok consumption is not part
+    # of this run's report
+    assert subset.annotation_suppressed == 0
+
+
+def test_cli_lint_rule_filter_dedupes_duplicates(capsys):
+    """`--rule HL004 --rule HL004` runs the rule once: duplicated ids
+    used to run the same instance twice, doubling every finding and
+    every suppression count."""
+    from har_tpu.cli import main
+
+    assert main(["lint", "--rule", "HL004", "--rule", "HL004",
+                 "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["rules_run"] == ["HL004"]
+    assert out["suppressed"] == 1  # registry's disable=HL004, once
+
+
+def test_cli_rule_hl003_on_path_subset_loads_writers_as_support(capsys):
+    """An explicit `--rule HL003` over a path subset judges the
+    bijections against the FULL fileset (journal writers and kill-point
+    call sites load as support): recover.py linted alone used to report
+    every replay handler as orphaned — 11 findings, rc 1, on a clean
+    tree."""
+    from har_tpu.cli import main
+
+    assert main(["lint", "har_tpu/serve/recover.py",
+                 "--rule", "HL003", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["findings"] == 0
+    assert out["rules_run"] == ["HL003"]
+    assert out["files"] == 1  # support files don't count as linted

@@ -129,8 +129,11 @@ def test_gate_log_carries_recovery_smoke_verdict():
 def test_gate_log_carries_harlint_verdict():
     """The static-analysis counterpart of the smoke verdicts: the gate
     log must carry a green harlint run with the {rules_run, findings,
-    suppressed} stamp — all five fleet invariant rules executed, zero
-    non-baselined findings at the published snapshot."""
+    per_rule, suppressed, lint_ms} stamp — all eight fleet invariant
+    rules executed, zero non-baselined findings at the published
+    snapshot, and the fresh-interpreter lint inside the gate's 5 s
+    budget (a lint slow enough to get skipped pre-commit stops
+    guarding)."""
     log = json.loads(
         (REPO / "artifacts" / "test_gate.json").read_text()
     )
@@ -139,13 +142,18 @@ def test_gate_log_carries_harlint_verdict():
         "artifacts/test_gate.json lacks the harlint verdict — run "
         "scripts/release_gate.py"
     )
-    for key in ("rules_run", "findings", "suppressed"):
+    for key in ("rules_run", "findings", "per_rule", "suppressed",
+                "lint_ms", "budget_ms"):
         assert key in h
     assert h["ok"] is True
     assert h["findings"] == 0
     assert set(h["rules_run"]) == {
         "HL001", "HL002", "HL003", "HL004", "HL005",
+        "HL006", "HL007", "HL008",
     }
+    assert set(h["per_rule"]) == set(h["rules_run"])
+    assert all(v == 0 for v in h["per_rule"].values())
+    assert 0 < h["lint_ms"] <= h["budget_ms"] == 5000
 
 
 def test_gate_log_carries_cluster_failover_verdict():
