@@ -42,6 +42,7 @@ nav {{ margin-bottom: 1.5rem; font-size: .95em; }}
 <a href="parallelism.html">parallelism</a> ·
 <a href="serving.html">serving</a> ·
 <a href="multihost.html">multihost</a> ·
+<a href="elasticity.html">elasticity</a> ·
 <a href="adaptation.html">adaptation</a> ·
 <a href="recovery.html">recovery</a> ·
 <a href="static_analysis.html">harlint</a> ·
@@ -70,8 +71,8 @@ def build() -> list[str]:
         # README.md) have no HTML export and must stay as written
         body = re.sub(
             r'href="(index|architecture|parallelism|serving|multihost'
-            r'|adaptation|recovery|static_analysis|api|roofline'
-            r'|bilstm_profile)\.md"',
+            r'|elasticity|adaptation|recovery|static_analysis|api'
+            r'|roofline|bilstm_profile)\.md"',
             r'href="\1.html"',
             body,
         )

@@ -247,6 +247,29 @@ def _parser() -> argparse.ArgumentParser:
                          "fail over to the survivors via journal "
                          "hand-off and the summary reports the global "
                          "conservation verdict")
+    sv.add_argument("--trace", default=None,
+                    choices=["diurnal", "bursty", "storm"],
+                    help="elastic traffic mode (har_tpu.serve.traffic): "
+                         "instead of N flat sessions, drive a seeded "
+                         "arrival process with session connect/"
+                         "disconnect churn — a 10x diurnal swing "
+                         "(--sessions is the PEAK), Poisson-modulated "
+                         "bursts, or a mid-run overnight-cohort "
+                         "disconnect storm; slow-client stalls and "
+                         "mixed per-session rates included.  The trace "
+                         "spec is printed in the summary (replayable "
+                         "by seed+params)")
+    sv.add_argument("--trace-rounds", type=int, default=96,
+                    help="delivery rounds (= one diurnal period) for "
+                         "--trace")
+    sv.add_argument("--autoscale", action="store_true",
+                    help="with --trace: attach the load-adaptive "
+                         "capacity controller "
+                         "(har_tpu.serve.traffic.autoscale) — "
+                         "hysteresis/cooldown policy loop resizing "
+                         "target_batch and pipeline_depth online at "
+                         "dispatch boundaries (zero-drop, journaled) "
+                         "as the swing loads and unloads the engine")
     sv.add_argument("--max-delay-ms", type=float, default=50.0,
                     help="deadline: max time a due window waits for "
                          "batch coalescing")
@@ -739,35 +762,6 @@ def main(argv=None) -> int:
             # training-free analytic model: the scheduler-overhead
             # baseline (a checkpoint adds device dispatch on top)
             model = AnalyticDemoModel()
-        recordings, class_names = synthetic_sessions(
-            args.sessions,
-            windows_per_session=args.windows_per_session,
-            window=window,
-            seed=args.seed,
-        )
-        # reference stats come from the CLEAN pool (computed before the
-        # drift mutation, so injected drift is drift relative to the
-        # trained distribution) — and only when a monitor needs them:
-        # a plain `serve` must not duplicate the whole fleet's samples,
-        # and the concatenated copy is dropped as soon as the two
-        # per-channel moments are out
-        monitor_ref = None
-        if args.monitor or args.adapt:
-            pool = np.concatenate(recordings)
-            monitor_ref = (pool.mean(axis=0), pool.std(axis=0))
-            del pool
-        # a fraction: clamp to [0, 1] so --inject-drift 1.5 means "all
-        # sessions", not an index past the recordings list
-        n_drifted = int(
-            args.sessions * min(max(args.inject_drift, 0.0), 1.0)
-        )
-        if n_drifted:
-            # population-scale sensor re-mount: the first n_drifted
-            # sessions' second halves shift far out of distribution
-            for i in range(n_drifted):
-                rec = recordings[i].copy()
-                rec[len(rec) // 2 :] += 25.0
-                recordings[i] = rec
         fault_hook = None
         if args.inject_stall_every:
             fault_hook = DispatchFaults(
@@ -799,6 +793,215 @@ def main(argv=None) -> int:
                 flush_every=args.journal_flush_every,
                 snapshot_every=args.journal_snapshot_every,
             )
+        if args.trace:
+            # elastic traffic (har_tpu.serve.traffic): instead of N
+            # flat sessions, drive a seeded arrival process with
+            # session churn — and, with --autoscale, let the capacity
+            # controller walk target_batch / pipeline_depth (/ the
+            # mesh, when --mesh names a ladder ceiling) up the swing
+            # and back down through FleetServer.resize's zero-drop
+            # dispatch-boundary path
+            if (
+                args.resume
+                or args.adapt
+                or args.kill_after_polls
+                or (args.workers and args.workers > 1)
+                or args.monitor
+                or args.inject_drift
+                or args.inject_drop
+                or args.inject_delay
+                or args.calibrate_device
+            ):
+                # refuse, never silently ignore: every one of these
+                # flags is serviced only by the steady N-session path
+                raise SystemExit(
+                    "--trace drives its own churn fleet; it does not "
+                    "combine with --workers/--resume/--adapt/"
+                    "--kill-after-polls/--monitor/--inject-drift/"
+                    "--inject-drop/--inject-delay/--calibrate-device "
+                    "(run those modes against the steady N-session "
+                    "load)"
+                )
+            from har_tpu.data.raw_windows import synthetic_raw_stream
+            from har_tpu.serve.traffic import (
+                AutoscaleConfig,
+                CapacityController,
+                TraceSpec,
+                TrafficTrace,
+                drive_trace,
+                undeclared_drops,
+            )
+
+            # label names only — trace mode builds its own sample pool
+            # inside drive_trace, so the steady-state recording corpus
+            # is never generated here
+            class_names = synthetic_raw_stream(
+                n_windows=1, seed=args.seed, window=window
+            ).class_names
+            rounds = args.trace_rounds
+            spec = TraceSpec(
+                kind=args.trace,
+                peak_sessions=args.sessions,
+                swing=10.0,
+                rounds=rounds,
+                period=rounds,
+                # the overnight cohort leaves on the downslope
+                storms=(
+                    ((int(rounds * 0.65), 0.5),)
+                    if args.trace == "storm"
+                    else ()
+                ),
+                burst_prob=0.15 if args.trace == "bursty" else 0.0,
+                burst_size=max(2, args.sessions // 8),
+                slow_prob=0.02,
+                slow_rounds=3,
+                rate_mix=(1, 1, 2),
+                seed=args.seed,
+            )
+            trace = TrafficTrace(spec)
+            # autoscaled runs START at the controller's floor — the
+            # whole point is capacity tracking the swing up from the
+            # trough; static runs serve the configured batch throughout.
+            # A --target-batch below the default floor LOWERS the floor
+            # (never silently unreachable); above it, it is the ceiling.
+            floor_tb = min(16, args.target_batch)
+            initial_tb = floor_tb if args.autoscale else args.target_batch
+            server = FleetServer(
+                model,
+                window=window,
+                channels=channels,
+                hop=args.hop,
+                smoothing=args.smoothing,
+                class_names=class_names,
+                config=FleetConfig(
+                    max_sessions=max(2 * args.sessions, 64),
+                    target_batch=initial_tb,
+                    max_delay_ms=args.max_delay_ms,
+                    pipeline_depth=(
+                        1 if args.autoscale else args.pipeline_depth
+                    ),
+                ),
+                fault_hook=fault_hook,
+                journal=args.journal,
+                journal_config=journal_cfg,
+                mesh=None if args.autoscale else mesh,
+            )
+            controller = None
+            if args.autoscale:
+                ladder = (1,)
+                mesh_for = None
+                if args.mesh and args.mesh > 1:
+                    import jax as _jax
+
+                    from har_tpu.parallel.mesh import create_mesh
+
+                    ladder = (1, args.mesh)
+                    mesh_for = lambda d: create_mesh(
+                        dp=d, tp=1, devices=_jax.devices()[:d]
+                    )
+                controller = CapacityController(
+                    server,
+                    config=AutoscaleConfig(
+                        min_target_batch=floor_tb,
+                        # the operator's --target-batch IS the ceiling
+                        # (floor <= ceiling by construction): the
+                        # controller may batch smaller, never bigger
+                        max_target_batch=args.target_batch,
+                        min_depth=1,
+                        max_depth=max(args.pipeline_depth, 2),
+                        mesh_ladder=ladder,
+                        up_after=1,
+                        down_after=3,
+                        cooldown_s=0.0,
+                    ),
+                    mesh_for=mesh_for,
+                )
+            import time as _time
+
+            t0 = _time.perf_counter()
+            events, report = drive_trace(
+                server,
+                trace,
+                on_round=(
+                    controller.on_round if controller is not None else None
+                ),
+            )
+            duration = _time.perf_counter() - t0
+            snap = server.stats_snapshot()
+            acct = snap["accounting"]
+            print(
+                json.dumps(
+                    {
+                        "trace": spec.kind,
+                        "trace_spec": trace.spec(),
+                        "rounds": report.rounds,
+                        "peak_active": report.peak_active,
+                        "trough_active": report.trough_active,
+                        "connects": report.connects,
+                        "disconnects": report.disconnects,
+                        "storm_disconnects": report.storm_disconnects,
+                        "slow_stalls": report.slow_stalls,
+                        "n_events": len(events),
+                        "enqueued": acct["enqueued"],
+                        "scored": acct["scored"],
+                        "dropped": acct["dropped"],
+                        "undeclared_drops": undeclared_drops(snap),
+                        "balanced": acct["balanced"],
+                        "windows_per_sec": (
+                            round(acct["scored"] / duration, 1)
+                            if duration
+                            else None
+                        ),
+                        "event_p99_ms": snap["stages"]["event_ms"].get(
+                            "p99_ms"
+                        ),
+                        "autoscale": (
+                            None
+                            if controller is None
+                            else controller.status()
+                        ),
+                        "resizes": snap["resizes"],
+                        "scale_ups": snap["scale_ups"],
+                        "scale_downs": snap["scale_downs"],
+                        "target_batch_final": server.config.target_batch,
+                        "pipeline_depth_final": (
+                            server.config.pipeline_depth
+                        ),
+                        "journal": args.journal,
+                    }
+                )
+            )
+            return 0
+
+        recordings, class_names = synthetic_sessions(
+            args.sessions,
+            windows_per_session=args.windows_per_session,
+            window=window,
+            seed=args.seed,
+        )
+        # reference stats come from the CLEAN pool (computed before the
+        # drift mutation, so injected drift is drift relative to the
+        # trained distribution) — and only when a monitor needs them:
+        # a plain `serve` must not duplicate the whole fleet's samples,
+        # and the concatenated copy is dropped as soon as the two
+        # per-channel moments are out
+        monitor_ref = None
+        if args.monitor or args.adapt:
+            pool = np.concatenate(recordings)
+            monitor_ref = (pool.mean(axis=0), pool.std(axis=0))
+            del pool
+        # a fraction: clamp to [0, 1] so --inject-drift 1.5 means "all
+        # sessions", not an index past the recordings list
+        n_drifted = int(
+            args.sessions * min(max(args.inject_drift, 0.0), 1.0)
+        )
+        if n_drifted:
+            # population-scale sensor re-mount: the first n_drifted
+            # sessions' second halves shift far out of distribution
+            for i in range(n_drifted):
+                rec = recordings[i].copy()
+                rec[len(rec) // 2 :] += 25.0
+                recordings[i] = rec
         if args.workers and args.workers > 1:
             # multi-worker control plane (har_tpu.serve.cluster):
             # sessions partition across N journaled FleetServers behind

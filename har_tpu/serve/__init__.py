@@ -18,6 +18,13 @@ Public surface:
                                             (FleetCluster: router,
                                             heartbeat failover, journal
                                             hand-off migration)
+  har_tpu.serve.traffic                   — elastic traffic engine
+                                            (TrafficTrace: diurnal/
+                                            bursty/storm churn loadgen;
+                                            CapacityController: online
+                                            target_batch / depth / mesh
+                                            / worker-count autoscaling;
+                                            elastic_smoke)
 
 See docs/serving.md for the architecture and the equivalence contract,
 docs/recovery.md for the journal format and the recovery invariants.
@@ -74,10 +81,25 @@ from har_tpu.serve.slo import (
     fleet_slo_smoke,
 )
 from har_tpu.serve.stats import FleetStats, StageHistogram
+from har_tpu.serve.traffic import (
+    AutoscaleConfig,
+    CapacityController,
+    TraceReport,
+    TraceSpec,
+    TrafficTrace,
+    drive_trace,
+    elastic_smoke,
+)
 
 __all__ = [
     "AdmissionError",
     "AnalyticDemoModel",
+    "AutoscaleConfig",
+    "CapacityController",
+    "TraceReport",
+    "TraceSpec",
+    "TrafficTrace",
+    "elastic_smoke",
     "CLUSTER_KILL_POINTS",
     "run_cluster_kill_point",
     "DeliveryFaults",
@@ -103,6 +125,7 @@ __all__ = [
     "StageHistogram",
     "StagingArena",
     "drive_fleet",
+    "drive_trace",
     "events_equal",
     "fleet_pipeline_smoke",
     "fleet_slo_smoke",

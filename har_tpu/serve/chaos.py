@@ -24,6 +24,10 @@ Kill points (KILL_POINTS), in pipeline order::
     post_score_pre_ack  scores computed, acks not yet journaled
     mid_snapshot        snapshot tmp written, rename not yet done
     mid_swap            swap applied in memory, record not yet durable
+    mid_resize          elastic resize applied in memory (target_batch /
+                        pipeline_depth / mesh), record not yet durable —
+                        recovery serves the pre-resize capacity and the
+                        controller re-issues
     mid_promote         registry promoted, fleet swap not yet applied
     mid_rollback        registry rolled back, swap-back not yet applied
 
@@ -70,6 +74,7 @@ KILL_POINTS = (
     "post_score_pre_ack",
     "mid_snapshot",
     "mid_swap",
+    "mid_resize",
 )
 ENGINE_KILL_POINTS = ("mid_promote", "mid_rollback")
 # the cluster control plane's migration stage boundaries
@@ -94,6 +99,7 @@ _DEFAULT_AT = {
     "post_score_pre_ack": 2,
     "mid_snapshot": 1,
     "mid_swap": 1,
+    "mid_resize": 1,
     "mid_handoff": 1,
     "mid_migration": 2,
 }
@@ -174,6 +180,16 @@ def _run_schedule(server, recordings, cursors, *, hop, clock, models,
     SimulatedCrash raised mid-schedule."""
     _deliver(server, recordings, cursors, swap_sample, hop, clock, events)
     if server.model_version == "A":
+        # elastic resize at the same schedule point the swap fires:
+        # gives mid_resize a boundary to kill at, and proves depth/
+        # batch changes never move an event (scores are row-independent
+        # and retire order is FIFO, so the reference run — which
+        # resizes identically — stays bit-identical).  Guarded like the
+        # cluster path so a crash-resume re-issue is a true no-op: a
+        # recovered server already at 48 must not journal a second
+        # resize record or double-count stats.resizes.
+        if server.config.target_batch != 48:
+            server.resize(target_batch=48)
         server.swap_model(models["B"], version="B")
     _deliver(
         server, recordings, cursors, max(map(len, recordings)), hop,
@@ -696,6 +712,13 @@ def _cluster_schedule(cluster, recordings, cursors, *, hop, clock,
         cluster, recordings, cursors, swap_sample, hop, clock, events,
         on_round,
     )
+    # per-worker elastic resize at the swap point — the cluster-side
+    # boundary mid_resize kills at.  Guarded per worker exactly like
+    # the idempotent swap broadcast: a resumed schedule re-issues it
+    # only where it has not landed.
+    for w in cluster._workers.values():
+        if w.alive and w.server.config.target_batch != 48:
+            w.server.resize(target_batch=48)
     cluster.swap_model(models["B"], version="B")
     _drive_cluster(
         cluster, recordings, cursors, max(map(len, recordings)), hop,
@@ -718,7 +741,7 @@ def run_cluster_kill_point(
     kill_round: int = 3,
 ) -> dict:
     """Kill one worker of an N-worker cluster at a stage boundary (any
-    of the 8 engine KILL_POINTS, fired inside the victim's own journal
+    of the engine KILL_POINTS, fired inside the victim's own journal
     hook) or kill the CONTROLLER inside the migration machinery
     (CLUSTER_KILL_POINTS), then let failover / takeover finish the job
     and demand the cross-worker contract:

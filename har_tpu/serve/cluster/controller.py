@@ -270,6 +270,51 @@ class FleetCluster:
         self._workers[wid].add_session(session_id, monitor=monitor)
         self._placement[session_id] = wid
 
+    def disconnect_session(self, session_id: Hashable) -> list:
+        """Graceful churn disconnect on the session's worker (elastic
+        traffic, har_tpu.serve.traffic): the worker flushes the
+        assembler's partial window, settles its pending queue (those
+        events are returned — the worker's drain is fleet-local), and
+        journals the eviction; the placement entry is dropped."""
+        wid = self.worker_of(session_id)
+        worker = self._workers.get(wid)
+        if worker is None:
+            raise WorkerUnavailable(
+                f"session {session_id!r} is mid-failover"
+            )
+        try:
+            events = worker.disconnect_session(session_id)
+        except WorkerUnavailable:
+            self._membership.note_failure(wid)
+            raise
+        self._membership.note_ok(wid)
+        del self._placement[session_id]
+        return events
+
+    def disconnect_sessions(self, session_ids) -> list:
+        """Batched graceful churn disconnect: leavers group by owning
+        worker so each worker settles ONCE for its whole departing
+        cohort (the storm case) instead of once per session."""
+        by_worker: dict = {}
+        for sid in session_ids:
+            by_worker.setdefault(self.worker_of(sid), []).append(sid)
+        events: list = []
+        for wid, sids in by_worker.items():
+            worker = self._workers.get(wid)
+            if worker is None:
+                raise WorkerUnavailable(
+                    f"sessions {sids!r} are mid-failover"
+                )
+            try:
+                events.extend(worker.disconnect_sessions(sids))
+            except WorkerUnavailable:
+                self._membership.note_failure(wid)
+                raise
+            self._membership.note_ok(wid)
+            for sid in sids:
+                del self._placement[sid]
+        return events
+
     def push(self, session_id: Hashable, samples) -> int:
         """Route one delivery to the session's worker.  Fails FAST on
         an unreachable worker (``WorkerUnavailable``) — the evidence

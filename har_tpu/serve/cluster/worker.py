@@ -59,6 +59,19 @@ class ClusterWorker:
         self._guard()
         self.server.add_session(session_id, monitor=monitor)
 
+    def disconnect_session(self, session_id: Hashable) -> list:
+        """Graceful churn disconnect: partial-window flush + settle +
+        journaled eviction (``FleetServer.disconnect_session``); the
+        settle's events are returned to the caller."""
+        self._guard()
+        return self.server.disconnect_session(session_id)
+
+    def disconnect_sessions(self, session_ids) -> list:
+        """Batched graceful disconnect — one settle for the whole
+        cohort leaving this worker (``FleetServer.disconnect_sessions``)."""
+        self._guard()
+        return self.server.disconnect_sessions(session_ids)
+
     def adopt(self, export: dict) -> None:
         """Adopt a migrated session and make the adopt record durable
         before returning — the target-side half of the hand-off

@@ -78,6 +78,21 @@ from har_tpu.serving import (
 )
 
 
+# "mesh unchanged" sentinel for FleetServer.resize — None is a real
+# mesh value there (back to single-device), so absence needs its own
+_MESH_UNSET = object()
+
+
+def _mesh_devices(mesh) -> int:
+    """Data-shard count of a dispatch mesh (1 for no mesh) — the
+    capacity-direction arithmetic's device factor."""
+    if mesh is None:
+        return 1
+    from har_tpu.parallel.mesh import data_shard_count
+
+    return data_shard_count(mesh)
+
+
 class AdmissionError(RuntimeError):
     """Session refused: fleet at max_sessions, or duplicate/unknown id."""
 
@@ -310,6 +325,11 @@ class FleetServer:
         # next dispatch BOUNDARY, so an in-flight batch always completes
         # on the model that started scoring it
         self._staged_swap: tuple | None = None
+        # elastic resize state (har_tpu.serve.traffic): same boundary
+        # discipline as the swap — a staged resize applies at the next
+        # dispatch boundary, and in-flight tickets retire on the OLD
+        # scorer/placement (each ticket carries its own scorer)
+        self._staged_resize: dict | None = None
         self._in_dispatch = False
         # dispatch tap (shadow evaluation): called AFTER a batch's
         # events are finalized, off the per-event latency path
@@ -622,6 +642,111 @@ class FleetServer:
         # replay re-derives the dropped windows from the same queue
         # state, so the record carries only the eviction itself
         self._jappend({"t": "remove", "sid": session_id})
+
+    def disconnect_session(self, session_id: Hashable) -> list[FleetEvent]:
+        """Graceful disconnect — the load plane's churn counterpart of
+        ``remove_session`` (which is a hard evict that DROPS the queue).
+
+        A real session that hangs up mid-stream still owns data the
+        fleet has accepted: queued windows waiting for a batch, and the
+        tail samples in its assembler's ring that never reached a hop
+        boundary.  The steady-state loadgen never saw either (every
+        recording ends exactly on the grid and the final ``flush``
+        drains the queue); session churn hits both constantly.  So a
+        disconnect (1) flushes the assembler's partial window — one
+        final window covering the last ``window`` samples, emitted at
+        ``t_index = n_seen`` (off the hop grid by construction, so it
+        can never collide with a grid ack) — (2) SETTLES the pending
+        queue through a forced poll, so every accepted window scores
+        and its ack is durable, and only then (3) journals the
+        ``remove`` eviction.  Returns the events the settle produced
+        (the drain is fleet-wide: a forced poll retires every queued
+        window, not only this session's — all of them are returned).
+
+        Replay order matches: the ``disc`` record re-derives the flush
+        window from the recovered ring bit-identically, the acks
+        consume it, the ``remove`` record evicts — so a crash anywhere
+        inside a disconnect recovers without dropping or double-scoring
+        a window (the re-issued disconnect is idempotent: a flushed
+        assembler never flushes twice)."""
+        return self.disconnect_sessions((session_id,))
+
+    def disconnect_sessions(self, session_ids) -> list[FleetEvent]:
+        """Batched graceful disconnect: flush every leaver's partial
+        window, settle ONCE, then evict.  A churn round that evicts a
+        whole cohort (the overnight storm) pays one forced poll, not
+        one per session — and the settle's forced drain is the reason
+        the traffic driver applies disconnects AFTER the round's
+        regular poll: the capacity controller's backlog signal and the
+        micro-batcher's coalescing both survive churn."""
+        sessions = []
+        for sid in session_ids:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise AdmissionError(f"unknown session {sid!r}")
+            sessions.append(sess)
+        for sess in sessions:
+            self._flush_partial(sess)
+        events: list[FleetEvent] = []
+        if any(sess.n_live for sess in sessions):
+            # settle: acks (and any dispatch-failure drop records) are
+            # durable before the remove records are even buffered
+            events = self.poll(force=True)
+        for sess in sessions:
+            self.remove_session(sess.sid)
+        return events
+
+    def _flush_partial(self, sess: _FleetSession) -> int:
+        """Enqueue the disconnecting session's final partial window (the
+        last ``window`` samples, ending at the stream position) when one
+        exists: the session has seen a full window's worth of samples
+        AND some of them arrived after the last emitted hop boundary.
+        Advancing ``next_emit`` past the flushed position makes the
+        flush idempotent — a crash-resumed disconnect re-issues it as a
+        no-op.  Shared verbatim by the live path and the ``disc``
+        journal replay, so the recovered window is bit-identical by
+        construction."""
+        asm = sess.asm
+        if (
+            asm._n_seen < self.window
+            or asm._n_seen <= asm._next_emit - self.hop
+        ):
+            return 0
+        self._jappend({"t": "disc", "sid": sess.sid})
+        p = _Pending(
+            sess,
+            asm._n_seen,
+            self._arena.put(asm._ring),
+            bool(
+                asm.drift_report is not None and asm.drift_report.drifting
+            ),
+            self._clock(),
+        )
+        sess.pending.append(p)
+        self._queue.append(p)
+        sess.n_live += 1
+        sess.n_enqueued += 1
+        self._n_live += 1
+        self._n_unlaunched += 1
+        self.stats.enqueued += 1
+        # the session is leaving: future grid boundaries are moot, and
+        # parking next_emit one hop past the flush position is what
+        # guarantees a second _flush_partial finds nothing to flush
+        asm._next_emit = asm._n_seen + self.hop
+        # the flush honors the same global bound push enforces: a mass
+        # cohort's partials must not balloon the queue past
+        # max_queue_windows — overflow sheds stalest fleet-wide (a
+        # DECLARED backpressure shed, the documented overload
+        # behavior).  The check lives HERE, not in the cohort loop,
+        # because this function is shared verbatim with the ``disc``
+        # journal replay: the shed re-derives on recovery exactly like
+        # push-time sheds do, keeping replay bit-identical to the live
+        # run (record=False — never journaled, by the same design)
+        overflow = self._n_live - self.config.max_queue_windows
+        if overflow > 0:
+            self._shed_stalest(overflow, "backpressure")
+        self.stats.note_queue_depth(self._n_live)
+        return 1
 
     # ------------------------------------------- cluster hand-off
     # (har_tpu.serve.cluster: live session migration between workers.
@@ -967,7 +1092,6 @@ class FleetServer:
             self.write_snapshot()
         self._chaos("pre_dispatch")
         events: list[FleetEvent] = []
-        depth = self.config.pipeline_depth
         inflight = self._inflight
         # tickets carried from the previous poll crunched on-device
         # through the delivery phase; their results are due now.  The
@@ -988,7 +1112,9 @@ class FleetServer:
         while inflight:
             events.extend(self._retire_ticket(inflight.popleft()))
         while self._n_unlaunched and (force or self.due()):
-            if len(inflight) >= depth:
+            # depth read live: an elastic resize applied at a launch
+            # boundary inside this poll re-bounds the pipe immediately
+            while len(inflight) >= self.config.pipeline_depth:
                 events.extend(self._retire_ticket(inflight.popleft()))
             t_h0 = self._clock()
             ticket = self._launch_batch()
@@ -1005,7 +1131,7 @@ class FleetServer:
             self.stats.note_inflight_depth(len(inflight))
         # drain down to the carry allowance: nothing on a forced drain
         # (flush/shutdown), up to depth-1 tickets otherwise
-        keep = 0 if force else depth - 1
+        keep = 0 if force else self.config.pipeline_depth - 1
         while len(inflight) > keep:
             events.extend(self._retire_ticket(inflight.popleft()))
         now = self._clock()
@@ -1015,6 +1141,8 @@ class FleetServer:
             # a completed dispatch IS a boundary: a swap staged from a
             # dispatch tap applies as soon as its batch has finished
             self._apply_swap()
+        if self._staged_resize is not None:
+            self._apply_resize()  # same boundary rule as the swap
         self.stats.note_queue_depth(self._n_live)
         if self._journal is not None and not self._replaying:
             # THE ack boundary: every event about to be returned has its
@@ -1069,6 +1197,113 @@ class FleetServer:
         if self._journal is not None and not self._replaying:
             self._journal.flush()
 
+    def resize(
+        self,
+        *,
+        target_batch: int | None = None,
+        pipeline_depth: int | None = None,
+        mesh=_MESH_UNSET,
+    ) -> dict:
+        """Stage an online capacity resize; returns the normalized
+        request.  ``target_batch`` and ``pipeline_depth`` replace the
+        corresponding ``FleetConfig`` knobs; ``mesh`` re-shards the
+        scorer (None = back to single-device; omitted = unchanged).
+
+        Same boundary discipline as ``swap_model``: the resize applies
+        at the next dispatch BOUNDARY (a call from a dispatch tap
+        defers to the end of that dispatch; a call between polls
+        applies immediately — the engine is idle then), queued windows
+        are never dropped, and in-flight tickets retire on the OLD
+        scorer/placement — each ticket carries its own scorer, so a
+        mesh resize can never re-tile a batch that already launched.
+        The pad policy follows the new scorer (pow2 single-device,
+        devices × pow2 sharded), keeping the log2 program budget.
+
+        Journaled as a ``resize`` record (target_batch /
+        pipeline_depth / device count / capacity direction) so a
+        journal-suffix replay recovers the post-resize schedule; the
+        mesh OBJECT itself is a runtime resource and is never journaled
+        — recovery re-shards onto whatever mesh ``restore`` was given,
+        the same stance the restore path takes for the model.
+
+        Staged resizes COMPOSE: a second call before the boundary
+        reads its unspecified knobs from the already-staged request,
+        so ``resize(target_batch=32)`` then ``resize(pipeline_depth=2)``
+        from the same dispatch tap lands as one 32/2 resize — never a
+        silent revert of the first."""
+        cfg = self.config
+        staged = self._staged_resize
+        base_tb = staged["target_batch"] if staged else cfg.target_batch
+        base_depth = (
+            staged["pipeline_depth"] if staged else cfg.pipeline_depth
+        )
+        base_mesh = staged["mesh"] if staged else self._mesh
+        tb = base_tb if target_batch is None else int(target_batch)
+        depth = base_depth if pipeline_depth is None else int(pipeline_depth)
+        if tb <= 0:
+            raise ValueError("target_batch must be positive")
+        if depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        new_mesh = base_mesh if mesh is _MESH_UNSET else mesh
+        mesh_changed = new_mesh is not self._mesh
+        old_devices = _mesh_devices(self._mesh)
+        new_devices = _mesh_devices(new_mesh)
+        # capacity direction judged against the APPLIED config: the
+        # composed request resolves at one boundary as one resize
+        old_cap = cfg.target_batch * cfg.pipeline_depth * old_devices
+        new_cap = tb * depth * new_devices
+        req = {
+            "target_batch": tb,
+            "pipeline_depth": depth,
+            "mesh": new_mesh,
+            "mesh_changed": mesh_changed,
+            "devices": new_devices,
+            "dir": (new_cap > old_cap) - (new_cap < old_cap),
+        }
+        self._staged_resize = req
+        if not self._in_dispatch:
+            self._apply_resize()
+        return {k: req[k] for k in ("target_batch", "pipeline_depth",
+                                    "devices", "dir")}
+
+    def _apply_resize(self) -> None:
+        req = self._staged_resize
+        self._staged_resize = None
+        self.config = dataclasses.replace(
+            self.config,
+            target_batch=req["target_batch"],
+            pipeline_depth=req["pipeline_depth"],
+        )
+        if req["mesh_changed"]:
+            # re-shard: the next launch builds a scorer over the new
+            # mesh; tickets already in flight keep their old scorer and
+            # retire on the old placement.  Device calibration belongs
+            # to the old placement's programs — cleared with it.
+            self._mesh = req["mesh"]
+            self._scorer = None
+            self._device_ms.clear()
+        self.stats.resizes += 1
+        if req["dir"] > 0:
+            self.stats.scale_ups += 1
+        elif req["dir"] < 0:
+            self.stats.scale_downs += 1
+        # journaled resize boundary, mirroring the swap: record
+        # appended, the chaos hook may kill here (record buffered, NOT
+        # durable — recovery then serves the pre-resize capacity and
+        # the controller re-issues), then the flush makes it durable
+        self._jappend(
+            {
+                "t": "resize",
+                "tb": req["target_batch"],
+                "depth": req["pipeline_depth"],
+                "devices": req["devices"],
+                "dir": req["dir"],
+            }
+        )
+        self._chaos("mid_resize")
+        if self._journal is not None and not self._replaying:
+            self._journal.flush()
+
     def set_dispatch_tap(self, tap: Callable | None) -> None:
         """Install (or clear, with None) the mirrored-dispatch consumer.
 
@@ -1101,9 +1336,11 @@ class FleetServer:
         its windows out of the staging arena, and start it on-device
         (device_put + jitted predict, un-fetched).  Returns the ticket
         the retire half later blocks on — or None when nothing is live."""
+        if self._staged_resize is not None:
+            self._apply_resize()  # the dispatch boundary (capacity)
         cfg = self.config
         if self._staged_swap is not None:
-            self._apply_swap()  # the dispatch boundary
+            self._apply_swap()  # the dispatch boundary (model)
         batch: list[_Pending] = []
         while self._queue and len(batch) < cfg.target_batch:
             p = self._queue.popleft()
@@ -1113,6 +1350,10 @@ class FleetServer:
         if not batch:
             return None
         self._n_unlaunched -= len(batch)
+        # live fill gauge: how full this dispatch ran relative to the
+        # configured capacity — the capacity controller's scale-down
+        # evidence (har_tpu.serve.traffic.autoscale)
+        self.stats.utilization = len(batch) / cfg.target_batch
         self._chaos("mid_dispatch")
         t_assembled = self._clock()
         for p in batch:

@@ -252,6 +252,32 @@ def restore_server(
                 server.model_version = meta["ver"]
                 server.stats.model_swaps += 1
                 server._device_ms.clear()
+            elif t == "resize":
+                # elastic capacity resize (FleetServer.resize): the
+                # schedule knobs replay exactly; the mesh OBJECT is a
+                # runtime resource — recovery shards onto whatever mesh
+                # restore_server was given, same stance as the model
+                server.config = dataclasses.replace(
+                    server.config,
+                    target_batch=int(meta["tb"]),
+                    pipeline_depth=int(meta["depth"]),
+                )
+                server.stats.resizes += 1
+                if int(meta.get("dir", 0)) > 0:
+                    server.stats.scale_ups += 1
+                elif int(meta.get("dir", 0)) < 0:
+                    server.stats.scale_downs += 1
+            elif t == "disc":
+                # graceful disconnect, flush half: re-derive the final
+                # partial window from the recovered ring — bit-identical
+                # by construction (same _flush_partial, same ring); the
+                # following ack then consumes it like any other window
+                sess = server._sessions.get(meta["sid"])
+                if sess is None:
+                    raise RecoveryError(
+                        f"disc record for unknown session {meta['sid']!r}"
+                    )
+                server._flush_partial(sess)
             elif t == "shed":
                 on = bool(meta.get("on"))
                 if on and not server._smoothing_shed:

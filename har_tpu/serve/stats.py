@@ -181,6 +181,18 @@ class FleetStats:
         self.worker_failovers = 0
         self.migrations = 0
         self.migration_ms = 0.0
+        # elastic capacity (har_tpu.serve.traffic): online resizes this
+        # engine has applied (target_batch / pipeline_depth / mesh, at a
+        # dispatch boundary — FleetServer.resize), split by capacity
+        # direction.  ``utilization`` is the live fill fraction of the
+        # most recent dispatched batch (k / target_batch) — the load
+        # signal the capacity controller's scale-DOWN evidence reads;
+        # recomputed by the next dispatch, deliberately not snapshot
+        # state
+        self.resizes = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.utilization = 0.0  # harlint: ephemeral
         # forward-compat guard (the runtime half of harlint HL002):
         # state keys a NEWER writer persisted that this version does
         # not know — counted and warned in load_state, never silently
@@ -300,6 +312,10 @@ class FleetStats:
             "worker_failovers": self.worker_failovers,
             "migrations": self.migrations,
             "migration_ms": round(self.migration_ms, 3),
+            "resizes": self.resizes,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "utilization": round(self.utilization, 4),
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
             "overlap_pct": self.overlap_pct(),
@@ -329,6 +345,7 @@ class FleetStats:
         "recoveries", "lost_in_crash", "model_swaps", "rollbacks",
         "shadow_batches", "shadow_windows", "shadow_errors",
         "worker_failovers", "migrations",
+        "resizes", "scale_ups", "scale_downs",
         "unknown_state_keys",
     )
     _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")

@@ -1,0 +1,44 @@
+"""Elastic traffic engine: replayable churn loadgen + load-adaptive
+capacity control (docs/elasticity.md).
+
+Public surface:
+  TraceSpec / TrafficTrace / TraceReport / drive_trace
+      — seeded diurnal/bursty/storm arrival processes driving session
+        connect/disconnect churn, overnight-cohort storms, slow-client
+        stalls and mixed per-session rates through the fleet engine; a
+        trace is a replayable artifact (export/replay by seed+params).
+  AutoscaleConfig / CapacityController
+      — the hysteresis/cooldown policy loop that resizes target_batch /
+        pipeline_depth / the dispatch mesh online (FleetServer.resize,
+        zero-drop at a dispatch boundary) and drives the cluster's
+        add_worker / retire_worker from load.
+  elastic_smoke — the release gate's elastic-traffic check.
+"""
+
+from har_tpu.serve.traffic.autoscale import (
+    AutoscaleConfig,
+    CapacityController,
+)
+from har_tpu.serve.traffic.generate import (
+    TraceReport,
+    TraceSpec,
+    TrafficTrace,
+    drive_trace,
+)
+from har_tpu.serve.traffic.smoke import (
+    DECLARED_SHEDS,
+    elastic_smoke,
+    undeclared_drops,
+)
+
+__all__ = [
+    "AutoscaleConfig",
+    "CapacityController",
+    "DECLARED_SHEDS",
+    "TraceReport",
+    "TraceSpec",
+    "TrafficTrace",
+    "drive_trace",
+    "elastic_smoke",
+    "undeclared_drops",
+]

@@ -1,0 +1,450 @@
+"""The release gate's elastic-traffic check.
+
+``elastic_smoke()`` runs the whole elastic story once, small, in two
+phases, and returns the ``{swing, resizes, p99_ms, shed_rate,
+windows_lost}`` verdict the gate log stamps:
+
+  phase 1 (engine)   a seeded 10× diurnal swing with a mid-run
+                     overnight-cohort disconnect storm, slow clients
+                     and mixed per-session rates, served by the jitted
+                     demo model while a CapacityController walks the
+                     target_batch → pipeline_depth → mesh ladder up the
+                     swing and back down — at least one online resize
+                     must land (a MESH re-shard when >1 device is
+                     visible; the gate forces the 8-device dry-run
+                     mesh), with the conservation law balanced in every
+                     per-round snapshot and zero windows dropped
+                     outside the SLO ladder's declared shed reasons;
+
+  phase 2 (cluster)  the same churn against a 2-worker FleetCluster
+                     while the controller scales the worker count: one
+                     ``add_worker(rebalance=True)`` at the peak and one
+                     drained ``retire_worker`` at the trough, global
+                     conservation balanced in every per-round snapshot.
+
+Everything is seeded and round-indexed (the trace is a replayable
+artifact); the clock only feeds latency histograms.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+
+import numpy as np
+
+# shed reasons the SLO ladder / bounded queues DECLARE: a drop under
+# one of these is the engine degrading as designed.  Anything else
+# (dispatch_failed, session_removed) is a lost window the elastic run
+# must not produce.
+DECLARED_SHEDS = ("slo_shed", "backpressure", "session_queue")
+
+
+def undeclared_drops(stats_snapshot: dict) -> int:
+    by_reason = stats_snapshot["dropped_by_reason"]
+    return sum(
+        n for reason, n in by_reason.items()
+        if reason not in DECLARED_SHEDS
+    )
+
+
+def elastic_smoke(seed: int = 0) -> dict:
+    import jax
+
+    from har_tpu.parallel.mesh import create_mesh
+    from har_tpu.serve.engine import FleetConfig, FleetServer
+    from har_tpu.serve.loadgen import AnalyticDemoModel, JitDemoModel
+    from har_tpu.serve.traffic.autoscale import (
+        AutoscaleConfig,
+        CapacityController,
+    )
+    from har_tpu.serve.traffic.generate import (
+        TraceSpec,
+        TrafficTrace,
+        drive_trace,
+    )
+
+    # ---- phase 1: engine ladder over a 10x diurnal swing -----------------
+    n_dev = min(2, len(jax.devices()))
+    spec = TraceSpec(
+        kind="storm",
+        peak_sessions=32,
+        swing=10.0,
+        rounds=48,
+        period=48,
+        storms=((30, 0.5),),
+        slow_prob=0.05,
+        slow_rounds=2,
+        rate_mix=(1, 1, 2),
+        seed=seed,
+    )
+    trace = TrafficTrace(spec)
+    server = FleetServer(
+        JitDemoModel(tunnel_rtt_ms=1.0),
+        window=200,
+        hop=200,
+        smoothing="ema",
+        config=FleetConfig(
+            max_sessions=4096, target_batch=8, max_delay_ms=5.0
+        ),
+    )
+    controller = CapacityController(
+        server,
+        config=AutoscaleConfig(
+            min_target_batch=8,
+            max_target_batch=32,
+            max_depth=2,
+            mesh_ladder=tuple(sorted({1, n_dev})),
+            queue_high=1.0,
+            util_low=0.4,
+            up_after=1,
+            down_after=2,
+            cooldown_s=0.0,
+        ),
+        mesh_for=lambda d: create_mesh(
+            dp=d, tp=1, devices=jax.devices()[:d]
+        ),
+    )
+    balance = {"ok": True}
+    devices_seen = {"max": 1}
+
+    def on_round(target, r):
+        out = controller.on_round(target, r)
+        snap = target.stats.accounting()
+        balance["ok"] = balance["ok"] and snap["balanced"]
+        scorer = target._scorer
+        if scorer is not None:
+            devices_seen["max"] = max(devices_seen["max"], scorer.devices)
+        return out
+
+    events, report = drive_trace(server, trace, on_round=on_round)
+    snap = server.stats_snapshot()
+    acct = snap["accounting"]
+    lost_engine = undeclared_drops(snap)
+    shed_rate = (
+        round(acct["dropped"] / acct["enqueued"], 4)
+        if acct["enqueued"]
+        else 0.0
+    )
+    mesh_ok = devices_seen["max"] > 1 or n_dev == 1
+
+    # ---- phase 2: cluster worker scaling over churn ----------------------
+    from har_tpu.serve.cluster.controller import FleetCluster
+    from har_tpu.serve.faults import FakeClock
+
+    root = tempfile.mkdtemp(prefix="har_elastic_smoke_")
+    try:
+        clock = FakeClock()
+        cluster = FleetCluster(
+            AnalyticDemoModel(),
+            root,
+            workers=2,
+            window=200,
+            hop=200,
+            smoothing="ema",
+            fleet_config=FleetConfig(max_sessions=4096, target_batch=16),
+            clock=clock,
+        )
+        cspec = TraceSpec(
+            kind="diurnal",
+            peak_sessions=24,
+            swing=6.0,
+            rounds=36,
+            period=36,
+            seed=seed + 1,
+        )
+        ccontroller = CapacityController(
+            cluster=cluster,
+            config=AutoscaleConfig(
+                sessions_per_worker_high=9,
+                sessions_per_worker_low=2,
+                min_workers=2,
+                max_workers=3,
+                up_after=1,
+                down_after=2,
+                cooldown_s=0.0,
+            ),
+            clock=clock,
+        )
+        cbalance = {"ok": True}
+
+        def c_on_round(target, r):
+            out = ccontroller.on_round(target, r)
+            acct = target.accounting()
+            cbalance["ok"] = cbalance["ok"] and acct["balanced"]
+            return out
+
+        c_events, c_report = drive_trace(
+            cluster, TrafficTrace(cspec), clock=clock, on_round=c_on_round
+        )
+        c_acct = cluster.accounting()
+        lost_cluster = sum(
+            undeclared_drops(w.server.stats.snapshot())
+            for w in cluster._workers.values()
+        )
+        c_stats = cluster.cluster_stats()
+        cluster.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    windows_lost = lost_engine + lost_cluster
+    p99 = snap["stages"]["event_ms"].get("p99_ms")
+    ok = bool(
+        server.stats.resizes >= 2
+        and server.stats.scale_ups >= 1
+        # the advertised contract is up the swing AND back down — a
+        # dead scale-down path (capacity stuck at the ceiling after
+        # the trough returns) must go red here
+        and server.stats.scale_downs >= 1
+        and mesh_ok
+        and report.storm_disconnects > 0
+        and balance["ok"]
+        and acct["balanced"]
+        and acct["pending"] == 0
+        and ccontroller.worker_adds >= 1
+        and ccontroller.worker_retires >= 1
+        and cbalance["ok"]
+        and c_acct["balanced"]
+        and c_acct["pending"] == 0
+        and windows_lost == 0
+    )
+    return {
+        "ok": ok,
+        "swing": round(
+            report.peak_active / max(report.trough_active, 1), 1
+        ),
+        "resizes": server.stats.resizes,
+        "scale_ups": server.stats.scale_ups,
+        "scale_downs": server.stats.scale_downs,
+        "mesh_devices": devices_seen["max"],
+        "p99_ms": p99,
+        "shed_rate": shed_rate,
+        "windows_lost": windows_lost,
+        "storm_disconnects": report.storm_disconnects,
+        "connects": report.connects,
+        "disconnects": report.disconnects,
+        "events": len(events),
+        "worker_adds": ccontroller.worker_adds,
+        "worker_retires": ccontroller.worker_retires,
+        "workers": c_stats["workers"],
+        "cluster_migrated": c_stats["migrated_sessions"],
+        "balanced_every_round": balance["ok"] and cbalance["ok"],
+    }
+
+
+class _DispatchCost:
+    """Deterministic dispatch-cost model on the injected clock: every
+    dispatch attempt charges a fixed launch/RTT cost plus a per-window
+    compute cost (``base_ms + per_window_ms × k``), advancing the
+    FakeClock instead of sleeping.  This is the capacity tradeoff the
+    bench lane measures, made reproducible: small batches pay the
+    fixed cost many times over at peak load, large batches pay the
+    coalescing wait at trough load — and windows/s stays a wall-clock
+    measurement, untouched by the fake latency."""
+
+    def __init__(self, clock, base_ms: float, per_window_ms: float):
+        self.clock = clock
+        self.base_ms = float(base_ms)
+        self.per_window_ms = float(per_window_ms)
+        self.dispatches = 0
+
+    def __call__(self, windows) -> None:
+        self.dispatches += 1
+        self.clock.advance(
+            (self.base_ms + self.per_window_ms * len(windows)) / 1e3
+        )
+
+
+def elastic_traffic_benchmark(
+    n_runs: int = 3, smoke: bool = False, seed: int = 0
+) -> dict:
+    """The ``elastic_traffic`` bench lane's measurement: the same
+    seeded 10× diurnal swing (storm + slow clients + mixed rates)
+    served three ways — a static floor configuration, a static ceiling
+    configuration, and the autoscaled run — under a deterministic
+    dispatch-cost model on the FakeClock (event p99 and shed rate are
+    exactly reproducible; windows/s is wall time).
+
+    The lane's claim: the autoscaled run beats the BEST static
+    configuration on p99 or shed rate at equal windows/s across the
+    swing (``beats_static``), because no single static batch size wins
+    both ends — the floor pays the per-dispatch launch cost dozens of
+    times over at peak, the ceiling pays the coalescing deadline at
+    every sub-peak round."""
+    import time
+
+    from har_tpu.serve.engine import FleetConfig, FleetServer
+    from har_tpu.serve.faults import FakeClock
+    from har_tpu.serve.loadgen import AnalyticDemoModel
+    from har_tpu.serve.traffic.autoscale import (
+        AutoscaleConfig,
+        CapacityController,
+    )
+    from har_tpu.serve.traffic.generate import (
+        TraceSpec,
+        TrafficTrace,
+        drive_trace,
+    )
+
+    spec = TraceSpec(
+        kind="storm",
+        peak_sessions=48 if smoke else 192,
+        swing=10.0,
+        rounds=24 if smoke else 48,
+        period=24 if smoke else 48,
+        storms=((16 if smoke else 32, 0.5),),
+        slow_prob=0.05,
+        slow_rounds=2,
+        rate_mix=(1, 1, 2),
+        seed=seed,
+    )
+    trace = TrafficTrace(spec)
+    floor_tb, ceil_tb = 16, 256
+    # per-dispatch launch/RTT charge (a conservative third of the
+    # documented ~30 ms remote-tunnel RTT) + per-window compute charge
+    base_ms, per_window_ms = 10.0, 0.1
+    configs = {
+        "static_floor": {"target_batch": floor_tb, "autoscale": False},
+        "static_ceiling": {"target_batch": ceil_tb, "autoscale": False},
+        "autoscaled": {"target_batch": floor_tb, "autoscale": True},
+    }
+
+    def one_run(cfg):
+        clock = FakeClock()
+        cost = _DispatchCost(clock, base_ms, per_window_ms)
+        server = FleetServer(
+            AnalyticDemoModel(),
+            window=200,
+            hop=200,
+            smoothing="ema",
+            config=FleetConfig(
+                max_sessions=4096,
+                target_batch=cfg["target_batch"],
+                max_delay_ms=50.0,
+            ),
+            fault_hook=cost,
+            clock=clock,
+        )
+        controller = None
+        if cfg["autoscale"]:
+            controller = CapacityController(
+                server,
+                config=AutoscaleConfig(
+                    min_target_batch=floor_tb,
+                    # the operator-sized ceiling: the largest batch
+                    # whose one-dispatch cost still clears the SLO —
+                    # the ladder's job is to find the best rung UNDER
+                    # it, not to chase the backlog into a batch size
+                    # that trades stacking for coalescing waits
+                    max_target_batch=128,
+                    max_depth=1,
+                    queue_high=1.0,
+                    util_low=0.5,
+                    up_after=2,
+                    down_after=4,
+                    cooldown_s=0.0,
+                ),
+                clock=clock,
+            )
+        t0 = time.perf_counter()
+        _events, _report = drive_trace(
+            server,
+            trace,
+            clock=clock,
+            round_dt=0.05,  # one 20 Hz hop of wall time per round
+            on_round=(
+                controller.on_round if controller is not None else None
+            ),
+        )
+        duration = time.perf_counter() - t0
+        snap = server.stats_snapshot()
+        acct = snap["accounting"]
+        return {
+            "windows_per_sec": (
+                acct["scored"] / duration if duration else 0.0
+            ),
+            "p99_ms": snap["stages"]["event_ms"].get("p99_ms") or 0.0,
+            "shed_rate": (
+                acct["dropped"] / acct["enqueued"]
+                if acct["enqueued"]
+                else 0.0
+            ),
+            "resizes": snap["resizes"],
+            "contract_ok": bool(
+                acct["balanced"]
+                and acct["pending"] == 0
+                and undeclared_drops(snap) == 0
+            ),
+        }
+
+    rows = {}
+    for name, cfg in configs.items():
+        runs = [one_run(cfg) for _ in range(n_runs)]
+        rows[name] = {
+            "target_batch": cfg["target_batch"],
+            "autoscale": cfg["autoscale"],
+            "n_runs": n_runs,
+            "windows_per_sec_median": round(
+                float(np.median([r["windows_per_sec"] for r in runs])), 1
+            ),
+            "windows_per_sec_std": round(
+                float(np.std([r["windows_per_sec"] for r in runs])), 1
+            ),
+            # fake-clock latencies: identical across runs by seeding
+            "p99_ms_median": round(
+                float(np.median([r["p99_ms"] for r in runs])), 3
+            ),
+            "shed_rate_median": round(
+                float(np.median([r["shed_rate"] for r in runs])), 4
+            ),
+            "resizes": runs[-1]["resizes"],
+            "contract_ok": all(r["contract_ok"] for r in runs),
+        }
+    auto = rows["autoscaled"]
+    statics = [rows["static_floor"], rows["static_ceiling"]]
+    best_static_p99 = min(r["p99_ms_median"] for r in statics)
+    best_static_shed = min(r["shed_rate_median"] for r in statics)
+    best_static_wps = max(r["windows_per_sec_median"] for r in statics)
+    # "at equal windows/s": every configuration scores the same offered
+    # load, so throughput parity is a wall-clock measurement with noise
+    # — the autoscaled median must stay within this declared tolerance
+    # of the best static's, and the measured ratio is stamped so the
+    # tolerance is never hidden in the verdict.  Smoke-scale runs last
+    # ~100 ms wall; their parity draw is pure noise (measured swinging
+    # 0.73–0.95 on identical inputs), so smoke mode stamps the ratio
+    # but excludes it from the verdict — the p99/shed comparison stays
+    # exactly reproducible (fake clock) at any scale
+    parity_floor = 0.9
+    parity_checked = not smoke
+    wps_parity = round(
+        auto["windows_per_sec_median"] / best_static_wps, 3
+    ) if best_static_wps else 0.0
+    return {
+        "trace": trace.spec(),
+        "swing": round(
+            trace.peak_active / max(trace.trough_active, 1), 1
+        ),
+        "dispatch_cost_model": {
+            "base_ms": base_ms, "per_window_ms": per_window_ms,
+        },
+        "configs": rows,
+        "best_static_p99_ms": best_static_p99,
+        "best_static_shed_rate": best_static_shed,
+        "windows_per_sec_parity": wps_parity,
+        "parity_floor": parity_floor,
+        "parity_checked": parity_checked,
+        "beats_static": bool(
+            (
+                auto["p99_ms_median"] < best_static_p99
+                or auto["shed_rate_median"] < best_static_shed
+            )
+            and (wps_parity >= parity_floor or not parity_checked)
+        ),
+        "contract_ok": all(r["contract_ok"] for r in rows.values()),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(elastic_smoke()))

@@ -54,13 +54,22 @@ What it does:
      — its sessions must migrate to the survivors via journal hand-off
      with global conservation, zero double-scored events and
      bit-identical migrated streams; red refuses the snapshot.
-  7. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+  7. Runs the elastic-traffic smoke (``har_tpu.serve.traffic.smoke.
+     elastic_smoke``): a seeded 10× diurnal swing with an
+     overnight-cohort disconnect storm while the capacity controller
+     resizes target_batch / pipeline_depth / the mesh online at
+     dispatch boundaries, plus a cluster phase with one worker add and
+     one drained retire — zero windows lost outside the declared shed
+     reasons, conservation balanced in every per-round snapshot; red
+     refuses the snapshot.
+  8. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
      the fleet ``{sessions, p99_ms, dropped}`` verdict, the adapt
      ``{swaps, rollbacks, shadow_agreement}`` verdict, the recovery
      ``{kill_points, recovered, windows_lost, recovery_ms}`` stamp,
      the cluster ``{workers, failovers, migrated_sessions,
-     windows_lost, migration_ms}`` stamp, git HEAD — the run log the
-     README numbers trace back to.
+     windows_lost, migration_ms}`` stamp, the elastic ``{swing,
+     resizes, p99_ms, shed_rate, windows_lost}`` stamp, git HEAD —
+     the run log the README numbers trace back to.
 
 The end-of-round snapshot workflow is: run this, commit only on rc 0.
 """
@@ -210,6 +219,31 @@ def _cluster_smoke() -> dict:
     )
 
 
+def _elastic_smoke() -> dict:
+    """Elastic-traffic smoke verdict: a seeded 10× diurnal swing with
+    a disconnect storm, slow clients and mixed rates while the
+    capacity controller walks the target_batch → pipeline_depth → mesh
+    ladder up the swing AND back down (zero-drop dispatch-boundary
+    resizes — the journaled variant is pinned by the chaos matrix and
+    test_recovery), then a 2-worker cluster phase with one add_worker
+    and one drained retire_worker — zero windows lost outside the SLO
+    ladder's declared shed reasons, conservation balanced in every
+    per-round snapshot (har_tpu.serve.traffic.smoke.elastic_smoke).
+    The dry-run mesh is forced like the pipeline smoke's: the online
+    mesh re-shard rung must be proven on every host, not only ones
+    that happen to expose >1 device."""
+    return _run_smoke(
+        "har_tpu.serve.traffic.smoke",
+        "elastic_smoke",
+        extra_env={
+            "XLA_FLAGS": (
+                __import__("os").environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            )
+        },
+    )
+
+
 LINT_BUDGET_MS = 5000  # fresh-interpreter wall clock, import included
 
 
@@ -327,6 +361,7 @@ def main(argv=None) -> int:
     adapt = None
     recovery = None
     cluster = None
+    elastic = None
     harlint = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
@@ -340,6 +375,7 @@ def main(argv=None) -> int:
             adapt = prior.get("adapt_smoke")
             recovery = prior.get("recovery_smoke")
             cluster = prior.get("cluster_failover")
+            elastic = prior.get("elastic_smoke")
             harlint = prior.get("harlint")
         except (OSError, ValueError):
             fleet = None
@@ -347,6 +383,7 @@ def main(argv=None) -> int:
             adapt = None
             recovery = None
             cluster = None
+            elastic = None
             harlint = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
@@ -434,6 +471,18 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # elastic gate: the 10x diurnal swing with churn, online
+        # resizes and a worker add/retire — zero windows lost outside
+        # the declared sheds, conservation balanced every round,
+        # stamping {swing, resizes, p99_ms, shed_rate, windows_lost}
+        elastic = _elastic_smoke()
+        if not elastic.get("ok"):
+            print(
+                "\nrelease_gate: RED elastic traffic smoke "
+                f"({json.dumps(elastic)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -449,6 +498,7 @@ def main(argv=None) -> int:
                 "adapt_smoke": adapt,
                 "recovery_smoke": recovery,
                 "cluster_failover": cluster,
+                "elastic_smoke": elastic,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -474,6 +524,9 @@ def main(argv=None) -> int:
                 ),
                 "cluster_failover_ok": (
                     None if cluster is None else cluster["ok"]
+                ),
+                "elastic_smoke_ok": (
+                    None if elastic is None else elastic["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

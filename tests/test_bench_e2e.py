@@ -84,7 +84,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
-        "fleet_recovery", "cluster_failover",
+        "fleet_recovery", "cluster_failover", "elastic_traffic",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -179,6 +179,32 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == failover["failover_ms_median"]
         )
         assert extra["cluster_failover_contract_ok"] is True
+    # r14 elastic-traffic lane: the autoscaled diurnal swing vs the
+    # static floor/ceiling configurations under the deterministic
+    # dispatch-cost model — the adaptive run must beat the best static
+    # on p99 or shed rate at equal windows/s, with conservation intact
+    # in every configuration; or a deadline-skip marker; never
+    # silently absent
+    elastic = extra["lanes"]["elastic_traffic"]
+    if "skipped" not in elastic:
+        assert elastic["n_runs"] >= 3
+        assert set(elastic["configs"]) == {
+            "static_floor", "static_ceiling", "autoscaled",
+        }
+        for cfg in elastic["configs"].values():
+            assert cfg["windows_per_sec_median"] > 0
+            assert cfg["contract_ok"] is True
+        assert elastic["configs"]["autoscaled"]["resizes"] >= 2
+        assert elastic["swing"] >= 8.0
+        assert elastic["beats_static"] is True
+        assert elastic["contract_ok"] is True
+        assert "chip_state_probe" in elastic
+        assert (
+            extra["elastic_p99_ms_median"]
+            == elastic["configs"]["autoscaled"]["p99_ms_median"]
+        )
+        assert extra["elastic_beats_static"] is True
+        assert extra["elastic_contract_ok"] is True
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",

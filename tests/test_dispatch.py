@@ -423,3 +423,209 @@ def test_pipeline_depth_validated():
     with pytest.raises(ValueError, match="pipeline_depth"):
         FleetConfig(pipeline_depth=0)
     assert FleetConfig(pipeline_depth=2).pipeline_depth == 2
+
+
+# ------------------------------------------------- elastic resize
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_resize_during_flight_bit_identical_and_balanced(depth):
+    """THE elastic pin (har_tpu.serve.traffic): a run that resizes
+    target_batch mid-stream — at depth 2 the resize lands while a
+    carried ticket is still in flight — emits the EXACT event stream of
+    a no-resize run (row-independent scores + strict FIFO retire make
+    batch geometry invisible), with zero drops and the conservation law
+    balanced in every per-round snapshot."""
+    n = 16
+    recs = _recordings(n, n_samples=800, seed=21)
+
+    def run(resize_at):
+        clock = FakeClock()
+        server = FleetServer(
+            _StubModel(), window=100, hop=50, smoothing="ema",
+            config=FleetConfig(
+                max_sessions=n, target_batch=8, max_delay_ms=0.0,
+                pipeline_depth=depth,
+            ),
+            clock=clock,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, snaps = [], []
+        cursors = [0] * n
+        rng = np.random.default_rng(3)
+        rnd = 0
+        while any(c < len(recs[i]) for i, c in enumerate(cursors)):
+            for i in range(n):
+                if cursors[i] >= len(recs[i]):
+                    continue
+                step = int(rng.integers(30, 90))
+                server.push(i, recs[i][cursors[i]: cursors[i] + step])
+                cursors[i] += step
+            if resize_at is not None and rnd == resize_at:
+                # between polls at depth 2 a carried ticket is STILL IN
+                # FLIGHT: the resize applies now (engine idle), the
+                # flying ticket retires on its old batch geometry
+                server.resize(target_batch=32)
+            # unforced: depth 2 carries up to depth-1 tickets across
+            events.extend(server.poll())
+            snaps.append(server.stats.accounting())
+            clock.advance(0.01)
+            rnd += 1
+        events.extend(server.flush())
+        snaps.append(server.stats.accounting())
+        return server, events, snaps
+
+    sA, evA, snapsA = run(resize_at=4)
+    sB, evB, snapsB = run(resize_at=None)
+    assert all(s["balanced"] for s in snapsA + snapsB)
+    assert sA.stats.dropped_total == sB.stats.dropped_total == 0
+    dA, dB = _decisions(evA), _decisions(evB)
+    assert dA.keys() == dB.keys()
+    for sid in dA:
+        assert dA[sid] == dB[sid]
+    assert sA.stats.resizes == 1 and sA.stats.scale_ups == 1
+    assert sA.config.target_batch == 32
+    assert sB.stats.resizes == 0
+    final = sA.stats.accounting()
+    assert final["balanced"] and final["pending"] == 0
+
+
+def test_resize_mesh_mid_run_matches_single_device_run():
+    """An online mesh re-shard (1 device → 8-device dry-run mesh) at a
+    dispatch boundary: decisions stay label-equal to the never-resized
+    single-device run (probs to 1e-6 — the GSPMD re-tiling drift the
+    sharded-scoring pin documents), zero drops, and the post-resize
+    scorer really is sharded over the new placement."""
+    mesh = _mesh(8)
+    n = 24
+    model = JitDemoModel()
+    recordings, _ = synthetic_sessions(n, windows_per_session=4, seed=9)
+    halves = [(r[: len(r) // 2], r[len(r) // 2:]) for r in recordings]
+
+    def run(resize_mesh):
+        server = FleetServer(
+            model, window=200, hop=200, smoothing="ema",
+            config=FleetConfig(max_sessions=n, target_batch=32),
+        )
+        for i in range(n):
+            server.add_session(i)
+        ev1, _ = drive_fleet(server, [h[0] for h in halves], seed=9)
+        if resize_mesh is not None:
+            server.resize(mesh=resize_mesh)
+        ev2, _ = drive_fleet(server, [h[1] for h in halves], seed=10)
+        return server, ev1 + ev2
+
+    s1, ev_flat = run(None)
+    s8, ev_resized = run(mesh)
+    assert isinstance(s8.scorer, ShardedScorer)
+    assert s8.scorer.devices == 8
+    assert s8.stats.resizes == 1
+    assert s1.stats.dropped_total == s8.stats.dropped_total == 0
+    d1, d8 = _decisions(ev_flat), _decisions(ev_resized)
+    assert d1.keys() == d8.keys()
+    for sid in d1:
+        a, b = d1[sid], d8[sid]
+        assert [x[:4] for x in a] == [y[:4] for y in b]  # labels/drift
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(
+                np.frombuffer(x[4]), np.frombuffer(y[4]), atol=1e-6
+            )
+    for s in (s1, s8):
+        acct = s.stats.accounting()
+        assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_resize_from_dispatch_tap_defers_to_boundary():
+    """A resize issued from inside a dispatch tap (i.e. mid-dispatch)
+    must NOT mutate capacity under the batch being finalized: it stages,
+    and applies at that dispatch's end — the same boundary discipline
+    as swap_model."""
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0),
+    )
+    server.add_session(0)
+    seen = []
+
+    def tap(sids, windows, probs):
+        if not seen:
+            server.resize(target_batch=16)
+            # deferred: the config is untouched inside the dispatch
+            seen.append(server.config.target_batch)
+        return 0
+
+    server.set_dispatch_tap(tap)
+    server.push(0, np.zeros((10 * 4, 3), np.float32))
+    server.poll(force=True)
+    assert seen == [4]
+    assert server.config.target_batch == 16
+    assert server.stats.resizes == 1
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_resize_validates_and_counts_directions():
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=8, max_delay_ms=0.0),
+    )
+    with pytest.raises(ValueError):
+        server.resize(target_batch=0)
+    with pytest.raises(ValueError):
+        server.resize(pipeline_depth=0)
+    up = server.resize(target_batch=16)
+    assert up["dir"] == 1
+    down = server.resize(target_batch=8)
+    assert down["dir"] == -1
+    flat = server.resize(target_batch=8)  # no capacity change
+    assert flat["dir"] == 0
+    assert server.stats.resizes == 3
+    assert server.stats.scale_ups == 1
+    assert server.stats.scale_downs == 1
+
+
+def test_dispatch_fill_utilization_gauge_tracks_last_batch():
+    """stats.utilization is the live fill fraction of the most recent
+    dispatch (k / target_batch) — the controller's scale-down signal."""
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=8, max_delay_ms=0.0),
+    )
+    server.add_session(0)
+    server.push(0, np.zeros((10 * 2, 3), np.float32))  # 2 of 8 slots
+    server.poll(force=True)
+    assert server.stats.utilization == pytest.approx(2 / 8)
+    server.push(0, np.zeros((10 * 8, 3), np.float32))  # a full batch
+    server.poll(force=True)
+    assert server.stats.utilization == pytest.approx(1.0)
+
+
+def test_staged_resizes_compose_at_one_boundary():
+    """Two resize() calls staged inside the same dispatch compose —
+    the second reads its unspecified knobs from the staged request, so
+    a tap issuing target_batch then pipeline_depth lands ONE combined
+    resize instead of silently reverting the first."""
+    server = FleetServer(
+        _StubModel(), window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0),
+    )
+    server.add_session(0)
+    staged = []
+
+    def tap(sids, windows, probs):
+        if not staged:
+            server.resize(target_batch=32)
+            second = server.resize(pipeline_depth=2)
+            staged.append(second)
+        return 0
+
+    server.set_dispatch_tap(tap)
+    server.push(0, np.zeros((10 * 4, 3), np.float32))
+    server.poll(force=True)
+    # the second call's normalized request carried the first's knob
+    assert staged[0]["target_batch"] == 32
+    assert server.config.target_batch == 32
+    assert server.config.pipeline_depth == 2
+    assert server.stats.resizes == 1  # one composed boundary resize
+    assert server.stats.scale_ups == 1

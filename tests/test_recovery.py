@@ -810,3 +810,99 @@ def test_stats_cluster_counters_roundtrip_and_pre_cluster_defaults():
     assert s3.migrations == 0
     assert s3.migration_ms == 0.0
     assert s3.accounting()["balanced"]
+
+
+def test_stats_elastic_counters_roundtrip_and_pre_elastic_defaults():
+    """The elastic-capacity counters (resizes, scale_ups, scale_downs)
+    round-trip through state()/load_state, and a pre-elastic state dict
+    missing them loads with zero defaults — both directions pinned
+    (HL002's runtime contract).  The utilization gauge is EPHEMERAL by
+    design: recomputed by the next dispatch, never persisted."""
+    s = FleetStats()
+    s.enqueued = 4
+    s.note_scored(4, "v1")
+    s.resizes = 3
+    s.scale_ups = 2
+    s.scale_downs = 1
+    s.utilization = 0.75
+    state = json.loads(json.dumps(s.state()))
+    assert "utilization" not in state  # live gauge: not snapshot state
+    assert "utilization" not in state["counters"]
+    s2 = FleetStats()
+    s2.load_state(state)
+    assert s2.resizes == 3
+    assert s2.scale_ups == 2
+    assert s2.scale_downs == 1
+    assert s2.utilization == 0.0  # recomputed at the next dispatch
+    assert s2.accounting() == s.accounting()
+    snap = s2.snapshot()
+    assert snap["resizes"] == 3
+    assert snap["scale_ups"] == 2
+    assert snap["scale_downs"] == 1
+    # pre-elastic state: the fields absent entirely — zero defaults,
+    # and no unknown-key warning in either direction
+    old = json.loads(json.dumps(state))
+    old["counters"].pop("resizes")
+    old["counters"].pop("scale_ups")
+    old["counters"].pop("scale_downs")
+    s3 = FleetStats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        s3.load_state(old)
+    assert s3.resizes == 0
+    assert s3.scale_ups == 0
+    assert s3.scale_downs == 0
+    assert s3.unknown_state_keys == 0
+    assert s3.accounting()["balanced"]
+
+
+def test_resize_record_replays_schedule_knobs(tmp_path):
+    """A journaled elastic resize replays exactly: the restored server
+    serves the post-resize target_batch/pipeline_depth with the resize
+    counters intact — while the mesh OBJECT stays a runtime resource
+    (recovery shards onto whatever mesh restore() was given, the same
+    stance the model takes)."""
+    server = _journaled_server(tmp_path)
+    server.add_session(0)
+    rng = np.random.default_rng(6)
+    server.push(0, rng.normal(size=(250, 3)).astype(np.float32))
+    server.poll(force=True)
+    server.resize(target_batch=32, pipeline_depth=2)
+    server.push(0, rng.normal(size=(250, 3)).astype(np.float32))
+    server.poll(force=True)
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.config.target_batch == 32
+    assert restored.config.pipeline_depth == 2
+    assert restored.stats.resizes == 1
+    assert restored.stats.scale_ups == 1
+    assert restored.stats.scale_downs == 0
+    restored.flush()
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_unflushed_resize_record_lost_with_pre_resize_capacity(tmp_path):
+    """mid_resize crash semantics, hand-driven: a resize applied in
+    memory whose record never reached disk recovers serving the
+    PRE-resize capacity (the controller re-issues on its next step) —
+    never a half-applied schedule."""
+    server = _journaled_server(tmp_path)  # flush_every=4
+    server.add_session(0)
+    rng = np.random.default_rng(8)
+    server.push(0, rng.normal(size=(250, 3)).astype(np.float32))
+    server.poll(force=True)  # acks flushed at the poll boundary
+    # journal hook level: buffer the resize record, then SIGKILL before
+    # any flush — exactly what the chaos matrix's mid_resize point does
+    server._journal.flush = lambda: None  # the crash window
+    server.resize(target_batch=64)
+    server.journal.kill()
+
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    assert restored.config.target_batch == 8  # pre-resize capacity
+    assert restored.stats.resizes == 0
+    acct = restored.stats.accounting()
+    assert acct["balanced"]

@@ -183,6 +183,41 @@ def test_gate_log_carries_cluster_failover_verdict():
     assert cluster["migration_ms"] >= 0
 
 
+def test_gate_log_carries_elastic_smoke_verdict():
+    """The elastic counterpart of the cluster verdict: the gate log
+    must carry a green elastic-traffic check with the {swing, resizes,
+    p99_ms, shed_rate, windows_lost} stamp — a seeded 10× diurnal
+    swing with a disconnect storm, online capacity resizes at dispatch
+    boundaries, one cluster worker add + one drained retire, zero
+    windows lost outside the declared sheds, conservation balanced in
+    every per-round snapshot."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    elastic = log.get("elastic_smoke")
+    assert elastic, (
+        "artifacts/test_gate.json lacks the elastic_smoke verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "swing", "resizes", "p99_ms", "shed_rate", "windows_lost",
+    ):
+        assert key in elastic
+    assert elastic["ok"] is True
+    assert elastic["swing"] >= 8.0
+    assert elastic["resizes"] >= 2
+    assert elastic["scale_ups"] >= 1
+    assert elastic["scale_downs"] >= 1  # ...AND back down
+    # the gate forces the dry-run mesh (like the pipeline smoke), so
+    # the online mesh re-shard rung genuinely ran — a 1-device stamp
+    # here means the gate stopped forcing devices
+    assert elastic["mesh_devices"] >= 2
+    assert elastic["windows_lost"] == 0
+    assert elastic["worker_adds"] >= 1
+    assert elastic["worker_retires"] >= 1
+    assert elastic["balanced_every_round"] is True
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
