@@ -20,7 +20,13 @@ runs), scores the candidate on the mirror, and accumulates:
     the exact failure mode the loop exists to fix;
   - mean |Δp|: probability-level divergence over ALL mirrored windows
     (drifted included — a candidate can agree on argmax while moving
-    every confidence; the drifted-side movement is worth seeing);
+    every confidence; the drifted-side movement is worth seeing).
+    CAVEAT: when the incumbent serves FUSED, the tap's incumbent
+    probabilities are the compact decision-confidence surrogate
+    (serve.dispatch.compact_probs — exact at the decision label,
+    uniform elsewhere), so Δp then measures against the surrogate and
+    overstates off-label movement.  The agreement gate — the actual
+    promotion criterion — compares argmaxes and is exact either way;
   - candidate latency per mirrored batch — a candidate that is too slow
     to serve must fail the gate BEFORE the swap, not after.
 
@@ -53,10 +59,19 @@ class ShadowConfig:
     # incumbent's observed mean dispatch latency (None disables —
     # host-stub incumbents measure microseconds that no real model meets)
     max_latency_factor: float | None = None
+    # initial scored batches EXCLUDED from the latency sample: the
+    # candidate's first mirrored batch pays its jit compilation, which
+    # is deployment cadence, not serving speed — a latency gate that
+    # reads the compile as serving would reject every jitted candidate
+    # (the int8 promotion path gates on exactly this sample).  The
+    # batches still count toward agreement/Δp evidence.
+    latency_warmup: int = 1
 
     def __post_init__(self):
         if self.sample_every < 1:
             raise ValueError("sample_every must be >= 1")
+        if self.latency_warmup < 0:
+            raise ValueError("latency_warmup must be >= 0")
         if self.min_windows < 1:
             # 0 would let gates() pass with NO evidence at all (no
             # agreement, no latency) and promote an unscored candidate
@@ -120,7 +135,8 @@ class ShadowEvaluator:
         t0 = self._clock()
         preds = self.candidate.transform(windows)
         cand = np.asarray(preds.probability[:k], np.float64)
-        self._cand_ms.append((self._clock() - t0) * 1e3)
+        if self.n_batches >= self.config.latency_warmup:
+            self._cand_ms.append((self._clock() - t0) * 1e3)
         inc = np.asarray(incumbent_probs, np.float64)
         self.n_batches += 1
         trusted = np.asarray(
@@ -194,18 +210,27 @@ class ShadowEvaluator:
                 f"agreement {agr:.4f} < min_agreement="
                 f"{cfg.min_agreement}"
             )
-        if (
-            cfg.max_latency_factor is not None
-            and self._cand_ms
-            and self._incumbent_ms is not None
-        ):
-            cand = float(np.mean(self._cand_ms))
-            inc = self._incumbent_ms
-            if cand > cfg.max_latency_factor * inc:
+        if cfg.max_latency_factor is not None:
+            if not self._cand_ms:
+                # a configured latency gate may NEVER pass on zero
+                # latency evidence: with latency_warmup excluding the
+                # compile batch, the first mirrored batch alone could
+                # otherwise satisfy min_windows and promote a slow
+                # candidate entirely unmeasured
                 reasons.append(
-                    f"candidate batch latency {cand:.3f}ms > "
-                    f"{cfg.max_latency_factor}x incumbent {inc:.3f}ms"
+                    "no post-warmup latency evidence yet "
+                    f"(latency_warmup={cfg.latency_warmup}) — the "
+                    "max_latency_factor gate needs a measured batch"
                 )
+            elif self._incumbent_ms is not None:
+                cand = float(np.mean(self._cand_ms))
+                inc = self._incumbent_ms
+                if cand > cfg.max_latency_factor * inc:
+                    reasons.append(
+                        f"candidate batch latency {cand:.3f}ms > "
+                        f"{cfg.max_latency_factor}x incumbent "
+                        f"{inc:.3f}ms"
+                    )
         out = {"passed": not reasons, "reasons": reasons}
         out.update(self.report())
         return out

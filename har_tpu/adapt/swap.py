@@ -292,11 +292,21 @@ class AdaptationEngine:
         # BOTH sides of the swap: pre-swap the incumbent is not a
         # trustworthy reference on them (a corrective candidate SHOULD
         # disagree there), post-swap the replaced model isn't either
-        self._exclude = frozenset(job.session_ids)
+        self._start_shadow(
+            mv, candidate, frozenset(job.session_ids),
+            self.shadow_config,
+        )
+        self._note("shadow_started", version=mv.name, job_id=job.job_id)
+
+    def _start_shadow(self, mv, candidate, exclude, shadow_config) -> None:
+        """Enter ``shadowing`` for a registered candidate — shared by
+        the drift-retrain path and operator-proposed candidates
+        (``propose_candidate`` / the int8 promotion path)."""
+        self._exclude = exclude
         self._shadow = ShadowEvaluator(
             candidate,
-            self.shadow_config,
-            exclude_sessions=self._exclude,
+            shadow_config,
+            exclude_sessions=exclude,
             clock=self._clock,
         )
         self._candidate = (mv, candidate)
@@ -308,7 +318,73 @@ class AdaptationEngine:
             + self.server.stats.dispatch_failures
         )
         self.state = "shadowing"
-        self._note("shadow_started", version=mv.name, job_id=job.job_id)
+
+    # ------------------------------------------- proposed candidates
+
+    def propose_candidate(
+        self,
+        candidate,
+        *,
+        note: str = "candidate:proposed",
+        shadow_config: ShadowConfig | None = None,
+    ) -> str:
+        """Inject a candidate WITHOUT a drift trigger — same evidence
+        discipline as a retrained one: register in the lineage, shadow
+        against live traffic, gate, hot-swap at a dispatch boundary,
+        probation with automatic rollback.  No session is excluded from
+        the agreement gate (there is no drifted cohort: the incumbent
+        is the trusted reference everywhere — exactly the stance a
+        tier change wants).  Returns the registered version label;
+        refuses while a shadow or probation is already in flight (one
+        candidate at a time is the loop's whole safety story)."""
+        if self.state != "serving":
+            raise RuntimeError(
+                f"cannot propose a candidate while {self.state!r}; "
+                "wait for the loop to settle"
+            )
+        try:
+            mv = self.registry.register(None, note=note)
+        except Exception as exc:
+            self.registry_errors += 1
+            self._note(
+                "registry_failed",
+                op="register",
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            raise
+        self._start_shadow(
+            mv, candidate, frozenset(),
+            shadow_config or self.shadow_config,
+        )
+        self._note("shadow_started", version=mv.name, proposed=note)
+        return mv.name
+
+    def propose_int8(
+        self,
+        *,
+        max_latency_factor: float | None = 1.5,
+        shadow_config: ShadowConfig | None = None,
+    ) -> str:
+        """THE quantization promotion path: quantize the serving
+        incumbent to the int8 tier (har_tpu.quantize.quantize_serving —
+        weights int8 on device, dequant traced into the jitted
+        program), shadow the int8 scorer against the live f32 traffic,
+        and gate on agreement PLUS a latency factor (an int8 tier that
+        is slower than the f32 incumbent has no reason to exist) —
+        then hot-swap at a dispatch boundary with probation and
+        automatic rollback exactly like a retrain candidate.  Adoption
+        is on measurement, not faith: a quantization that moves live
+        decisions past the agreement floor is rejected with evidence
+        in the registry, and a post-swap regression rolls back."""
+        from har_tpu.quantize import quantize_serving
+
+        candidate = quantize_serving(self.server.model)
+        cfg = shadow_config or dataclasses.replace(
+            self.shadow_config, max_latency_factor=max_latency_factor
+        )
+        return self.propose_candidate(
+            candidate, note="candidate:int8", shadow_config=cfg
+        )
 
     def _step_shadowing(self) -> None:
         # live incumbent baseline for the optional latency gate: the

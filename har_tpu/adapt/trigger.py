@@ -58,7 +58,11 @@ class ReplayBuffer:
         buf = self._buf.get(session_id)
         if buf is None:
             buf = self._buf[session_id] = deque(maxlen=self.per_session)
-        buf.append(np.asarray(window, np.float32))
+        # COPY, never a view: the dispatch tap hands this buffer views
+        # of the engine's pooled staging slabs (the fused hot loop
+        # recycles a slab as soon as its ticket retires) — storing the
+        # view would let a later dispatch overwrite retained replay data
+        buf.append(np.array(window, np.float32, copy=True))
 
     def add_batch(
         self, session_ids: Sequence[Hashable], windows: np.ndarray

@@ -360,7 +360,7 @@ class CallGraph:
             return set()
         if isinstance(expr, ast.Call):
             out = set()
-            for target in self._resolve_callee(fi, expr):
+            for target in self._resolve_callee(fi, expr, depth + 1):
                 if isinstance(target, ClassInfo):
                     out.add(target.key)
                 elif isinstance(target, FuncInfo):
@@ -450,8 +450,17 @@ class CallGraph:
 
     # --------------------------------------------------------- call edges
 
-    def _resolve_callee(self, fi: FuncInfo, call: ast.Call) -> list:
-        """FuncInfo/ClassInfo targets of one call expression."""
+    def _resolve_callee(
+        self, fi: FuncInfo, call: ast.Call, depth: int = 0
+    ) -> list:
+        """FuncInfo/ClassInfo targets of one call expression.
+
+        ``depth`` continues the calling type query's depth budget: a
+        receiver-type resolution spawned from inside ``expr_types``
+        must NOT restart at zero, or two modules whose type lattices
+        reference each other (e.g. the serve.dispatch ↔ quantize int8
+        tier) recurse past the interpreter limit instead of truncating
+        at ``_MAX_DEPTH`` like every other deep chain."""
         f = call.func
         if isinstance(f, ast.Name):
             # lexical scoping: own nested defs first, then each
@@ -481,7 +490,7 @@ class CallGraph:
             if isinstance(got, ClassInfo):
                 return self.lookup_method(got, f.attr, virtual=False)
         out = []
-        for ckey in self.expr_types(fi, recv):
+        for ckey in self.expr_types(fi, recv, depth + 1):
             out.extend(self.lookup_method(self.classes[ckey], f.attr))
         uniq, keys = [], set()
         for t in out:

@@ -25,14 +25,15 @@ set is the project call graph's reachability closure
     inference (``scorer = self._get_scorer()``), cross-module imports,
     and closures nested in reached functions (``_attempt_launch``
     handed to ``retry_call``).  Traversal stops at functions named
-    ``fetch`` — the one allowed sink, scanned separately;
+    ``fetch``/``fetch_fused`` — the allowed sinks, scanned separately;
   - every ``@jax.jit``-decorated (or ``jax.jit(fn)``-wrapped) function
     body — a host materialization inside a traced body is either a
     tracer error waiting to happen or a silent constant-fold.  (The
     closure of jit bodies through the call graph — and shard_map/scan
     bodies — is HL006's jit-purity surface, which reuses this module's
     sync detectors; direct jit bodies stay here for continuity);
-  - every function named ``fetch`` — the ONE allowed sink.  A fetch is
+  - every function named ``fetch`` or ``fetch_fused`` (the fused
+    hot-loop retire) — the allowed sinks.  A fetch is
     where the host is SUPPOSED to block, but each host-sync line there
     must carry the reviewed ``# harlint: fetch-ok`` annotation, so a
     new sync cannot hide in a fetch body unexamined.
@@ -62,7 +63,7 @@ from har_tpu.analyze.core import (
 )
 
 LAUNCH_ROOTS = {"launch", "_launch_batch"}
-FETCH_SURFACE = {"fetch"}
+FETCH_SURFACE = {"fetch", "fetch_fused"}
 
 _HARD_SYNCS = {"item", "device_get", "block_until_ready"}
 _NP_NAMES = {"np", "numpy"}

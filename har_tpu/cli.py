@@ -219,10 +219,27 @@ def _parser() -> argparse.ArgumentParser:
     sv.add_argument("--pipeline-depth", type=int, default=1,
                     help="dispatch batches in flight on-device before "
                          "the host blocks on a retire: 1 = synchronous "
-                         "engine, 2 = double-buffered (the host "
-                         "assembles/ingests while the device scores; "
-                         "events, smoothing and journal acks stay in "
-                         "the exact synchronous order)")
+                         "engine, 2 = double-buffered, >=3 = the "
+                         "ticket ring (the device stays busy across a "
+                         "slow host round; events, smoothing and "
+                         "journal acks stay in the exact synchronous "
+                         "order at any depth)")
+    sv.add_argument("--fused", action="store_true",
+                    help="fused on-device hot loop: scale + score + "
+                         "argmax + top-prob in ONE jitted program per "
+                         "padded shape, retire fetching only (labels, "
+                         "top_probs).  Needs a jitted model "
+                         "(--checkpoint or --tier int8 demo) and "
+                         "vote/none smoothing (EMA needs full "
+                         "probabilities and serves unfused); labels "
+                         "are unchanged, off-label event probabilities "
+                         "become the compact surrogate (docs/serving.md)")
+    sv.add_argument("--tier", default="f32", choices=["f32", "int8"],
+                    help="serving tier: int8 = weight-only quantized "
+                         "serving (har_tpu.quantize.quantize_serving; "
+                         "weights ship int8 to the device, dequant is "
+                         "a traced op).  Needs a jitted model — the "
+                         "analytic demo model has no device program")
     sv.add_argument("--mesh", type=int, default=0,
                     help="shard each dispatch batch over this many "
                          "devices (jax.devices(); batches pad to "
@@ -760,8 +777,31 @@ def main(argv=None) -> int:
                 )
         else:
             # training-free analytic model: the scheduler-overhead
-            # baseline (a checkpoint adds device dispatch on top)
-            model = AnalyticDemoModel()
+            # baseline (a checkpoint adds device dispatch on top).
+            # --tier int8 / --fused need a device program, so they get
+            # the jitted demo MLP instead.
+            if args.tier == "int8" or args.fused:
+                from har_tpu.serve import JitDemoModel
+
+                model = JitDemoModel(window=window, channels=channels)
+            else:
+                model = AnalyticDemoModel()
+        if args.tier == "int8":
+            from har_tpu.quantize import quantize_serving
+
+            try:
+                model = quantize_serving(model)
+            except ValueError as exc:
+                raise SystemExit(
+                    f"--tier int8: {exc} — serve a jitted model "
+                    "(--checkpoint with a neural family)"
+                )
+        if args.fused and args.smoothing == "ema":
+            raise SystemExit(
+                "--fused needs a fused-eligible smoothing mode "
+                "(--smoothing vote|none): EMA smoothing consumes the "
+                "full probability vector the fused retire never fetches"
+            )
         fault_hook = None
         if args.inject_stall_every:
             fault_hook = DispatchFaults(
@@ -880,6 +920,7 @@ def main(argv=None) -> int:
                     pipeline_depth=(
                         1 if args.autoscale else args.pipeline_depth
                     ),
+                    fused=args.fused,
                 ),
                 fault_hook=fault_hook,
                 journal=args.journal,
@@ -1055,6 +1096,7 @@ def main(argv=None) -> int:
                     target_batch=args.target_batch,
                     max_delay_ms=args.max_delay_ms,
                     pipeline_depth=args.pipeline_depth,
+                    fused=args.fused,
                 ),
                 config=ClusterConfig(
                     lease_s=0.5, probe_base_ms=20.0, probe_cap_ms=200.0
@@ -1191,6 +1233,7 @@ def main(argv=None) -> int:
                     target_batch=args.target_batch,
                     max_delay_ms=args.max_delay_ms,
                     pipeline_depth=args.pipeline_depth,
+                    fused=args.fused,
                 ),
                 fault_hook=fault_hook,
                 journal=args.journal,

@@ -237,6 +237,23 @@ class ExportedPredictor:
             return self.exported.call(self.weights, x)[0]
         return self.exported.call(x)[0]
 
+    @property
+    def int8_weights(self) -> bool:
+        """True for the weight-input (int8 npz) artifact form — what
+        ``make_scorer(..., tier="int8")`` checks before deciding the
+        artifact is already quantized."""
+        return bool(self.meta.get("weight_inputs"))
+
+    def serving_inner(self):
+        """The ``(_predict, params)`` adapter the async dispatch plane
+        consumes (``serve.dispatch._split_predict``): ``params`` are the
+        artifact's device-resident weight inputs (int8 for a quantized
+        export, empty for the constants-baked form) and ``_predict``
+        dispatches the deserialized StableHLO program asynchronously —
+        an exported artifact serves through DeviceScorer/ShardedScorer
+        launch/retire tickets exactly like a live checkpoint."""
+        return _ExportedServingInner(self)
+
     def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """(logits, probability) for a (n, *example_shape) batch."""
         x = np.asarray(x, np.float32)
@@ -257,6 +274,25 @@ class ExportedPredictor:
         x = data.features if hasattr(data, "features") else data
         logits, probs = self.predict(x)
         return Predictions.from_raw(logits, probs)
+
+
+class _ExportedServingInner:
+    """``(_predict, params)`` over a deserialized StableHLO program —
+    see ``ExportedPredictor.serving_inner``.  The artifact call is not
+    re-traceable inside a surrounding jit on every supported jax
+    version, so the fused hot loop is declined (``supports_fused``);
+    the ticket pipeline still overlaps the async dispatch."""
+
+    supports_fused = False
+
+    def __init__(self, art: ExportedPredictor):
+        exported = art.exported
+        if art.weights is not None:
+            self.params = art.weights
+            self._predict = lambda w, x: exported.call(w, x)[0]
+        else:
+            self.params = ()
+            self._predict = lambda _w, x: exported.call(x)[0]
 
 
 def load_exported(path: str) -> ExportedPredictor:

@@ -165,6 +165,18 @@ class QuantizedModel:
         }
 
 
+def _q8(w: np.ndarray) -> _Stored:
+    """Symmetric per-output-channel int8 storage of one >=2-dim weight
+    (last axis = output features in flax's Dense/Conv layout) — THE
+    quantization arithmetic shared by the export path
+    (``quantize_model``) and the serving tier (``quantize_serving``),
+    so the two cannot round differently."""
+    scale = np.abs(w).max(axis=tuple(range(w.ndim - 1))) / 127.0
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return _Stored("q8", q, scale)
+
+
 def quantize_model(model) -> QuantizedModel:
     """Weight-only int8 quantization of a fitted neural model.
 
@@ -184,10 +196,7 @@ def quantize_model(model) -> QuantizedModel:
     for path, leaf in leaves_with_path:
         w = np.asarray(leaf)
         if _leaf_name(path) == "kernel" and w.ndim >= 2:
-            scale = np.abs(w).max(axis=tuple(range(w.ndim - 1))) / 127.0
-            scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
-            q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
-            stored.append(_Stored("q8", q, scale))
+            stored.append(_q8(w))
         else:
             stored.append(_Stored("f", w, None))
     return QuantizedModel(
@@ -196,4 +205,157 @@ def quantize_model(model) -> QuantizedModel:
         stored=stored,
         scaler=getattr(model, "scaler", None),
         num_classes=int(model.num_classes),
+    )
+
+
+class _Int8Inner:
+    """The ``(_predict, params)`` pair the dispatch plane serves —
+    weight leaves device-resident in their STORED dtype (int8 kernels +
+    f32 rest, the same weight-input form ``export_parts`` ships), with
+    the dequant a traced op inside the jitted logits program.  XLA
+    fuses the ``int8 → f32 × scale`` convert into the consuming matmul;
+    the weights never exist as f32 in device memory at rest."""
+
+    supports_fused = True  # plain jit chain: the fused program traces it
+
+    def __init__(self, base_predict, treedef, stored):
+        import jax
+        import jax.numpy as jnp
+
+        scales = [
+            None if s.kind != "q8" else jnp.asarray(s.scale)
+            for s in stored
+        ]
+
+        def logits(leaves, x):
+            rebuilt = [
+                w.astype(jnp.float32) * sc if sc is not None else w
+                for w, sc in zip(leaves, scales)
+            ]
+            return base_predict(
+                jax.tree_util.tree_unflatten(treedef, rebuilt), x
+            )
+
+        # device-resident once at build: every dispatch reuses the int8
+        # buffers instead of re-uploading the weight set per call
+        self.params = [jax.device_put(s.value) for s in stored]
+        self._predict = jax.jit(logits)
+
+
+@dataclasses.dataclass
+class Int8ServingModel:
+    """The int8 SERVING tier: a DeviceScorer-compatible wrapper
+    (``scaler`` + ``inner`` exposing ``_predict``/``params``) whose
+    weights live int8 on device, built by ``quantize_serving``.
+
+    Drops into ``serve.dispatch.make_scorer(model, tier="int8")`` — and
+    therefore into pipelining, mesh sharding, the fused hot loop and
+    the adaptation engine's shadow/swap machinery — exactly like a f32
+    model: ``_split_predict`` unwraps ``scaler``/``inner`` the same way
+    it unwraps ``NeuralClassifierModel``.  ``transform`` is the
+    synchronous reference path (ShadowEvaluator scores candidates
+    through it), same op order as the async launch+fetch chain.
+    """
+
+    inner: _Int8Inner
+    scaler: object | None
+    num_classes: int
+    stored: list
+    tunnel_rtt_ms: float = 0.0
+    int8_weights: bool = True
+
+    def transform(self, x):
+        import jax
+
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x, np.float32)
+        if self.scaler is not None:
+            x = self.scaler.transform(x)
+        # softmax on the DEVICE logits before fetching: one transfer
+        # each way (ShadowEvaluator scores every mirrored batch through
+        # here during int8 promotion — a host round trip of the logits
+        # just to re-upload them for softmax would be pure waste)
+        dev_logits = self.inner._predict(
+            self.inner.params, jax.device_put(x)
+        )
+        probs = np.asarray(jax.nn.softmax(dev_logits, axis=-1))
+        return Predictions.from_raw(np.asarray(dev_logits), probs)
+
+    def size_report(self) -> dict:
+        """Same accounting as QuantizedModel.size_report."""
+        q_bytes = f_bytes = 0
+        n_q = 0
+        for s in self.stored:
+            orig = s.value.size * 4
+            f_bytes += orig
+            if s.kind == "q8":
+                n_q += 1
+                q_bytes += s.value.size + s.scale.size * 4
+            else:
+                q_bytes += orig
+        return {
+            "quantized_kernels": n_q,
+            "float_bytes": f_bytes,
+            "quantized_bytes": q_bytes,
+            "ratio": round(q_bytes / f_bytes, 4) if f_bytes else None,
+        }
+
+
+def quantize_serving(model) -> Int8ServingModel:
+    """Weight-only int8 quantization of any DEVICE-servable model — the
+    serving-tier entry point behind ``make_scorer(..., tier="int8")``
+    and ``AdaptationEngine.propose_int8``.
+
+    Unlike ``quantize_model`` (which rebuilds a flax ``module.apply``
+    chain and therefore covers the NeuralModel families only), this
+    wraps whatever jitted ``(_predict, params)`` pair the dispatch
+    plane would serve — a trained checkpoint, the jitted demo MLP, any
+    scorer-compatible model — and quantizes every >=2-dim float leaf
+    (kernels; biases/norms stay f32) with the shared ``_q8``
+    arithmetic.  Raises ValueError for host-only models: there is no
+    device program to quantize.
+    """
+    import jax
+
+    from har_tpu.serve.dispatch import _split_predict
+
+    pre, inner = _split_predict(model)
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+        inner.params
+    )
+    stored: list[_Stored] = []
+    for _path, leaf in leaves_with_path:
+        w = np.asarray(leaf)
+        if w.ndim >= 2 and np.issubdtype(w.dtype, np.floating):
+            stored.append(_q8(w))
+        else:
+            stored.append(_Stored("f", w, None))
+    if not any(s.kind == "q8" for s in stored):
+        # nothing quantizable: an exported StableHLO artifact (weights
+        # baked into the program, or already int8) or a kernel-less
+        # model — refuse loudly instead of minting a no-op "int8" tier
+        # (and instead of re-jitting an exported call, which is not
+        # re-traceable under a surrounding jit)
+        raise ValueError(
+            "nothing to quantize: the model exposes no >=2-dim float "
+            f"weight leaves ({type(model).__name__}) — quantize before "
+            "export (har export --quantize int8), or serve the f32 tier"
+        )
+    num_classes = getattr(model, "num_classes", None)
+    if num_classes is None:
+        # fall back to the logits width of the last QUANTIZED kernel
+        # (the output head) — the last tree leaf of any kind could be
+        # a trailing bias/norm leaf with a hidden width
+        num_classes = int(
+            next(
+                s for s in reversed(stored) if s.kind == "q8"
+            ).value.shape[-1]
+        )
+    return Int8ServingModel(
+        inner=_Int8Inner(inner._predict, treedef, stored),
+        scaler=pre,
+        num_classes=int(num_classes),
+        stored=stored,
+        tunnel_rtt_ms=float(getattr(model, "tunnel_rtt_ms", 0.0) or 0.0),
     )

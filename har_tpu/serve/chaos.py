@@ -35,8 +35,10 @@ The two launch/retire points exist because pipelining moved the ack
 boundary: a ticket in flight at crash time is un-acked BY CONSTRUCTION,
 so both points must recover exactly like pre_dispatch — the popped
 windows re-derive from replayed pushes and are re-scored.  The matrix
-runs at pipeline_depth 1 AND 2 (test-pinned): depth must never change
-what a crash can lose.
+runs at pipeline_depth 1 AND 2 in full, the ticket-centric points
+additionally at ring depths 3 and 4, and the randomized property test
+draws depth from {1, 2, 3, 4} (all test-pinned): depth must never
+change what a crash can lose.
 
 The verdict of every point is the same three-part contract
 (test-pinned in tests/test_recovery.py, sampled by the release gate's
@@ -387,7 +389,10 @@ def _verdict(point, ref_events, pre_events, post_events, restored,
 def run_random_kill(seed: int) -> dict:
     """Seed-randomized kill-point draw for the property test: point,
     occurrence, flush batching, snapshot cadence AND pipeline depth all
-    vary — the recovery contract must hold for every combination."""
+    vary — the recovery contract must hold for every combination.  The
+    depth draw spans the full ticket ring {1, 2, 3, 4}: at depth >= 3
+    several tickets are genuinely in flight at the kill instant, and
+    every one of them must recover as ordinary un-acked pending."""
     rng = np.random.default_rng((seed, 0xDEAD))
     point = KILL_POINTS[int(rng.integers(len(KILL_POINTS)))]
     at = _DEFAULT_AT[point] + int(rng.integers(0, 3))
@@ -398,7 +403,7 @@ def run_random_kill(seed: int) -> dict:
         seed=seed,
         flush_every=int(rng.choice([1, 4, 16, 64])),
         snapshot_every=int(rng.choice([0, 10, 30])),
-        pipeline_depth=int(rng.choice([1, 2])),
+        pipeline_depth=int(rng.choice([1, 2, 3, 4])),
     )
     out["seed"] = seed
     if not out["ok"] and "never fired" in (out["why"] or ""):

@@ -55,10 +55,25 @@ windows from the replayed pushes.
 from __future__ import annotations
 
 import time
+import weakref
 
 import numpy as np
 
 from har_tpu.serving import pad_pow2, pad_shard
+
+# fused-program fallback cache for inner objects that refuse instance
+# attributes.  The PRIMARY cache is an attribute ON the inner model
+# itself (``_har_fused_cache``): the fused jit belongs to the model —
+# like ``_predict`` — so a rebuilt FleetServer, bench re-run or swap
+# back reuses the compiled program, and the cache dies WITH the model
+# (the value→model reference is an ordinary gc-collectable cycle).  A
+# weak-key table cannot deliver that lifetime here: real checkpoint
+# inners (``NeuralModel._predict`` is a jit of a lambda over ``self``)
+# would be pinned by their own cached closure and never evict.  One
+# cached jit serves every placement (pjit specializes per input
+# sharding); entries hold (pre, jit) pairs compared by scaler identity
+# (scalers carry ndarrays — unhashable).
+_FUSED_PROGRAMS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 class DispatchTicket:
@@ -75,10 +90,11 @@ class DispatchTicket:
     __slots__ = (
         "batch", "k", "pad_k", "windows", "handle", "scorer", "version",
         "t0", "t_inflight0", "t_carried0", "idle_ms", "attempts",
-        "failed", "last_error",
+        "failed", "last_error", "fused", "slab",
     )
 
-    def __init__(self, batch, windows, scorer, version, t0):
+    def __init__(self, batch, windows, scorer, version, t0, *,
+                 fused: bool = False, slab=None):
         self.batch = batch
         self.k = len(batch)
         self.pad_k = len(windows)
@@ -89,6 +105,13 @@ class DispatchTicket:
         self.t0 = t0
         self.t_inflight0 = t0
         self.t_carried0 = None  # set when the ticket survives its poll
+        # fused hot-loop ticket: the handle is the small (labels,
+        # top_probs) device pair, and ``windows`` is a pooled staging
+        # slab the engine returns to its free pool at retire (the slab
+        # stays valid for the whole flight — retries and the dispatch
+        # tap read it — and is only recycled after the tap has run)
+        self.fused = fused
+        self.slab = slab
         # deliberate carry idle (inter-poll span) accumulated before
         # retire: excluded from dispatch_ms, so the SLO ladder never
         # reads the pipeline's own buffering as a slow tunnel
@@ -163,6 +186,22 @@ class StagingArena:
         # harlint: host-ok
         return self._buf[np.asarray(slots, np.intp)]
 
+    def gather_into(self, slots, out: np.ndarray) -> np.ndarray:
+        """Gather ``slots`` into the first ``len(slots)`` rows of a
+        PREALLOCATED ``out`` slab and pad the tail by repeating the last
+        gathered row — the zero-allocation batch-assembly path of the
+        fused dispatch hot loop.  ``out`` must already be sized to the
+        scorer's padded shape; the exact-fit case (``len(slots) ==
+        len(out)``) skips the tail fill entirely, so a full batch pays
+        exactly one copy (the gather itself) and nothing else."""
+        k = len(slots)
+        # host-side index-array build, same as gather (no device fetch)
+        # harlint: host-ok
+        np.take(self._buf, np.asarray(slots, np.intp), axis=0, out=out[:k])
+        if k < len(out):
+            out[k:] = out[k - 1]
+        return out
+
     def state(self) -> dict:
         """Snapshot-provider payload: sizing observability only — the
         staged windows themselves ride the snapshot's existing
@@ -173,6 +212,44 @@ class StagingArena:
             "in_use": self.in_use,
             "grows": self.grows,
         }
+
+
+def compact_probs(
+    labels: np.ndarray, top: np.ndarray, n_classes: int
+) -> np.ndarray:
+    """Decision-confidence surrogate distribution for the fused tier.
+
+    The fused program retires only ``(labels, top_probs)`` — the full
+    probability matrix never leaves the device.  Downstream consumers
+    (vote/passthrough smoothing, events, journal acks, the shadow tap)
+    still speak ``(k, C)`` distributions, so this rebuilds one on host:
+    ``out[i, labels[i]] = top[i]`` and the remaining mass spread evenly
+    over the other classes.  Two guarantees the retire path relies on:
+
+      - ``argmax(out[i]) == labels[i]`` STRICTLY — the off-label mass is
+        capped just below the top probability, so a journal replay that
+        re-derives the raw label by argmax can never flip it on an
+        exact ``top == 1/C`` tie;
+      - ``out[i].sum()`` is 1 up to fp rounding, and ``out[i, labels[i]]``
+        is exactly the device's top probability — the decision
+        confidence every consumer reads is the real one.
+
+    The off-label values are a surrogate (the fused tier's contract is
+    LABEL equality with the unfused path, documented in serving.md);
+    anything needing the true full distribution serves unfused.
+    """
+    k = len(labels)
+    labels = np.asarray(labels, np.intp)
+    top = np.asarray(top, np.float64)
+    if n_classes <= 1:
+        return np.ones((k, 1), np.float64)
+    off = np.minimum(
+        (1.0 - top) / (n_classes - 1),
+        top * (1.0 - 2.0**-40),
+    )
+    out = np.repeat(off[:, None], n_classes, axis=1)
+    out[np.arange(k), labels] = top
+    return out
 
 
 # --------------------------------------------------------------- scorers
@@ -190,6 +267,7 @@ class HostScorer:
     kind = "host"
     devices = 1
     device_labels = ("host",)
+    supports_fused = False  # no device program to fuse into
 
     def __init__(self, model):
         self.model = model
@@ -208,7 +286,8 @@ class HostScorer:
     def fetch(self, handle, k: int) -> np.ndarray:
         return np.asarray(handle[:k], np.float64)  # harlint: fetch-ok
 
-    def measure(self, batch: int, iters: int = 16) -> dict:
+    def measure(self, batch: int, iters: int = 16, *,
+                fused: bool = False) -> dict:
         raise ValueError(
             "device timing needs a jitted predict "
             f"(got host-side {type(self.model).__name__}); "
@@ -222,16 +301,25 @@ def _split_predict(model):
     logits program behind it.  Only the ``scaler + inner`` chain
     (NeuralClassifierModel over NeuralModel) is unwrapped: that chain's
     ``transform`` is exactly scaler → jitted logits → softmax, which the
-    async path replicates bit-identically.  Wrappers that post-process
-    the logits on host (temperature scaling, exported artifacts) are NOT
-    unwrapped — they serve through HostScorer, whose launch IS their
-    ``transform``.  Raises ValueError when no such chain exists (trees,
-    MLlib replicas, numpy stubs)."""
+    async path replicates bit-identically.  Exported StableHLO
+    artifacts (ExportedPredictor) unwrap through their
+    ``serving_inner()`` adapter — the deserialized program dispatches
+    through the same async ticket path.  Wrappers that post-process
+    the logits on host (temperature scaling) are NOT unwrapped — they
+    serve through HostScorer, whose launch IS their ``transform``.
+    Raises ValueError when no such chain exists (trees, MLlib
+    replicas, numpy stubs)."""
     pre = None
     inner = model
     for _ in range(4):
         if hasattr(inner, "_predict") and hasattr(inner, "params"):
             return pre, inner
+        if hasattr(inner, "serving_inner"):
+            # exported StableHLO artifact (export.ExportedPredictor):
+            # its adapter exposes the same (_predict, params) pair over
+            # the deserialized program — the int8 weight-input form
+            # ships its weights to the device once, at adapter build
+            return pre, inner.serving_inner()
         nxt = getattr(inner, "inner", None)
         if nxt is None:
             break
@@ -265,6 +353,14 @@ class DeviceScorer:
         self.devices = 1
         self.device_labels = (str(jax.devices()[0].id),)
         self.compiled_shapes: set[int] = set()
+        # the fused hot-loop program (built lazily at the first fused
+        # launch): scale + logits + softmax + argmax + top-prob in ONE
+        # jitted program per padded shape, retire fetching only the
+        # small (labels, top_probs) pair.  Artifact-backed inners opt
+        # out (an exported StableHLO call is not re-traceable inside a
+        # surrounding jit on every jax version this repo supports).
+        self.supports_fused = getattr(self._inner, "supports_fused", True)
+        self._fused = None
         # emulated remote-tunnel round trip (a MODEL attribute, so the
         # engine stays knob-free): on a dry-run CPU mesh the local
         # "device" finishes in microseconds, while the documented
@@ -317,38 +413,156 @@ class DeviceScorer:
         )
         return np.asarray(probs[:k], np.float64)  # harlint: fetch-ok
 
-    def program_count(self) -> int | None:
-        """Compiled-program count of the underlying jit (the compile-
-        budget pin reads this when the jit exposes its cache size)."""
-        fn = self._inner._predict
-        try:
-            return int(fn._cache_size())
-        except (AttributeError, TypeError):
-            return None
+    # ------------------------------------------------- fused hot loop
 
-    def measure(self, batch: int, iters: int = 16) -> dict:
+    def _fused_fn(self):
+        """THE fused device program: scale → logits → softmax → argmax
+        + top-prob, one jit, one compile per padded shape.  The staged
+        batch is DONATED where the backend can reuse buffers (donation
+        is a no-op on the CPU dev mesh, which would only warn about the
+        unusable donation — so it is requested on accelerator backends
+        only); retire then fetches the small ``(labels, top_probs)``
+        pair instead of the full ``(pad_k, C)`` logits matrix.  The
+        scaler runs ON DEVICE here in f32 (the unfused path standardizes
+        host-side): elementwise and deterministic, so labels — the
+        fused tier's contract — are unchanged."""
+        if self._fused is None:
+            jax = self._jax
+            jnp = jax.numpy
+            inner = self._inner
+            pre = self._pre
+            entries = getattr(inner, "_har_fused_cache", None)
+            if entries is None:
+                entries = []
+                try:
+                    # cache ON the model: same lifetime as _predict —
+                    # dropped incumbents take their compiled fused
+                    # program with them (see _FUSED_PROGRAMS note)
+                    inner._har_fused_cache = entries
+                except (AttributeError, TypeError):
+                    entries = _FUSED_PROGRAMS.setdefault(inner, [])
+            for entry_pre, fn in entries:
+                if entry_pre is pre:
+                    self._fused = fn
+                    return fn
+            mean = None if pre is None else jnp.asarray(pre.mean)
+            std = None if pre is None else jnp.asarray(pre.std)
+            predict = inner._predict
+
+            def fused(params, x):
+                x = x.astype(jnp.float32)
+                if mean is not None:
+                    x = (x - mean) / std
+                logits = predict(params, x)
+                probs = jax.nn.softmax(
+                    logits.astype(jnp.float32), axis=-1
+                )
+                labels = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+                return labels, jnp.max(probs, axis=-1)
+
+            donate = () if jax.default_backend() == "cpu" else (1,)
+            self._fused = jax.jit(fused, donate_argnums=donate)
+            entries.append((pre, self._fused))
+        return self._fused
+
+    def launch_fused(self, windows: np.ndarray):
+        """Fused launch: place the staged slab (already f32, already
+        padded — the engine's slab pool guarantees both) and dispatch
+        the one fused program, un-fetched.  No host-side scaler, no
+        dtype cast, no per-dispatch allocation on this path."""
+        self.compiled_shapes.add(len(windows))
+        handle = self._fused_fn()(self._inner.params, self._place(windows))
+        if self.tunnel_rtt_ms:
+            return (handle, time.perf_counter())
+        return handle
+
+    def fetch_fused(self, handle, k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Retire side of the fused program: block on — and transfer —
+        only ``k`` int32 labels and ``k`` f32 top-probabilities
+        (``8 × pad_k`` bytes instead of the unfused ``4 × pad_k × C``
+        logits fetch; the saving is counted in
+        ``FleetStats.fetch_bytes_saved``)."""
+        if self.tunnel_rtt_ms:
+            handle, t_launch = handle
+            wait = self.tunnel_rtt_ms / 1e3 - (
+                time.perf_counter() - t_launch
+            )
+            if wait > 0:
+                time.sleep(wait)
+        labels, top = handle
+        labels = np.asarray(labels)  # harlint: fetch-ok (THE fetch)
+        top = np.asarray(top)  # harlint: fetch-ok
+        return (
+            labels[:k].astype(np.int64),
+            np.asarray(top[:k], np.float64),  # harlint: fetch-ok
+        )
+
+    def program_count(self) -> int | None:
+        """Compiled-program count across the jits this scorer actually
+        dispatches — the bare logits predict AND the fused hot-loop
+        program when one has been built (a fused engine compiles its
+        shapes on the fused jit and never calls ``_predict``, so
+        counting only the latter would leave the compile-budget pin
+        blind for the fused tier)."""
+        total, found = 0, False
+        for fn in (self._inner._predict, self._fused):
+            if fn is None:
+                continue
+            try:
+                total += int(fn._cache_size())
+                found = True
+            except (AttributeError, TypeError):
+                pass
+        return total if found else None
+
+    def measure(self, batch: int, iters: int = 16, *,
+                fused: bool = False) -> dict:
         """Device p50 for one padded program AT THE SHAPE AND PLACEMENT
         the dispatch path actually emits — device-resident (sharded,
-        for ShardedScorer) input, ``block_until_ready``, no fetch."""
+        for ShardedScorer) input, ``block_until_ready``, no fetch.
+
+        ``fused=True`` times the FUSED hot-loop program (scale + logits
+        + softmax + argmax + top-prob, the one a fused engine actually
+        dispatches) instead of the bare logits call, so
+        ``StreamEvent.device_ms`` and ``dispatch_p99_attribution`` stay
+        honest when the engine serves fused.  A fresh input is placed
+        per timed call: the fused program donates its input where the
+        backend supports donation, and timing a donated-away buffer
+        would be a use-after-free."""
         import time
 
-        x = self._place(
-            np.zeros(
-                (int(batch), self.model_window, self.model_channels),
-                np.float32,
+        def place():
+            return self._place(
+                np.zeros(
+                    (int(batch), self.model_window, self.model_channels),
+                    np.float32,
+                )
             )
-        )
-        fn = self._inner._predict
-        params = self._inner.params
-        fn(params, x).block_until_ready()  # warm
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            fn(params, x).block_until_ready()
-            times.append((time.perf_counter() - t0) * 1e3)
+
+        if fused:
+            fn = self._fused_fn()
+            params = self._inner.params
+            fn(params, place())[0].block_until_ready()  # warm
+            times = []
+            for _ in range(iters):
+                x = place()
+                t0 = time.perf_counter()
+                fn(params, x)[0].block_until_ready()
+                times.append((time.perf_counter() - t0) * 1e3)
+        else:
+            x = place()
+            fn = self._inner._predict
+            params = self._inner.params
+            fn(params, x).block_until_ready()  # warm
+            times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fn(params, x).block_until_ready()
+                times.append((time.perf_counter() - t0) * 1e3)
         return {
             "batch": int(batch),
             "iters": int(iters),
+            "fused": bool(fused),
             "p50_ms": round(float(np.percentile(times, 50)), 3),
             "min_ms": round(min(times), 3),
         }
@@ -398,12 +612,32 @@ class ShardedScorer(DeviceScorer):
         return self._jax.device_put(x, self._sharding)
 
 
-def make_scorer(model, mesh=None, *, window: int = 200, channels: int = 3):
+def make_scorer(model, mesh=None, *, tier: str = "f32",
+                window: int = 200, channels: int = 3):
     """The one scorer-selection policy: a >1-device mesh gets the
     sharded path, a jittable model gets the async single-device path,
     everything else falls back to the synchronous HostScorer (which is
     operation-identical to the PR-2 engine).  Model swaps rebuild the
-    scorer — the engine calls this again with the new model."""
+    scorer — the engine calls this again with the new model.
+
+    ``tier="int8"`` serves the weight-only int8 quantization of the
+    model (har_tpu.quantize.quantize_serving) behind the SAME ticket /
+    fused-program interface: the int8 leaves ship to the device as
+    program inputs (the artifact form — dequant is a traced op, weights
+    stay int8 end-to-end) and every downstream path — pipelining,
+    sharding, the fused hot loop, shadow promotion — is tier-blind.  A
+    model that is already int8-backed (``Int8ServingModel``, an int8
+    StableHLO export) passes through unchanged; a host-only model
+    raises ValueError (there is no device program to quantize)."""
+    if tier == "int8":
+        from har_tpu.quantize import Int8ServingModel, quantize_serving
+
+        if not isinstance(model, Int8ServingModel) and not bool(
+            getattr(model, "int8_weights", False)
+        ):
+            model = quantize_serving(model)
+    elif tier != "f32":
+        raise ValueError(f"unknown serving tier {tier!r}")
     scorer = None
     if mesh is not None:
         from har_tpu.parallel.mesh import data_shard_count

@@ -59,6 +59,7 @@ from har_tpu.serve.dispatch import (
     DispatchTicket,
     HostScorer,
     StagingArena,
+    compact_probs,
     make_scorer,
 )
 from har_tpu.serve.journal import (
@@ -140,13 +141,32 @@ class FleetConfig:
     # never the NaN/Inf one (serving.finite_rows)
     max_abs_sample: float | None = 1e6
     # dispatch pipelining: batches in flight on-device before the host
-    # blocks on a retire.  1 = the synchronous engine (launch then
-    # retire back-to-back, operation-identical to PR-2); 2 = classic
-    # double buffering — while batch N scores on-device, the host
-    # assembles and launches N+1.  Retire order stays FIFO, so events,
-    # smoothing and journal acks are emitted in the exact synchronous
-    # order at any depth (test-pinned bit-identical at N=64).
+    # blocks on a retire — a ring of up to ``depth`` launched
+    # DispatchTickets.  1 = the synchronous engine (launch then retire
+    # back-to-back, operation-identical to PR-2); 2 = classic double
+    # buffering; >= 3 keeps the device busy across a SLOW host round
+    # (up to depth-1 tickets carry between polls, so one long delivery
+    # round no longer drains the pipe).  Retire order stays strictly
+    # FIFO, so events, smoothing and journal acks are emitted in the
+    # exact synchronous order at any depth (test-pinned bit-identical
+    # at N=64; chaos matrix green at depths 1-4).
     pipeline_depth: int = 1
+    # fused on-device hot loop (har_tpu.serve.dispatch): collapse the
+    # host-scaler → device_put → jitted-logits → host-fetch → argmax
+    # chain into ONE jitted program per padded shape — scale, score,
+    # argmax and top-prob all on device, batches staged through
+    # preallocated pooled slabs (zero per-dispatch allocation), retire
+    # fetching only the small (labels, top_probs) pair.  Applies when
+    # the scorer is device-backed AND smoothing is fused-ELIGIBLE
+    # (vote/none — decisions need only labels; EMA needs the full
+    # probability vector and always serves unfused).  Event
+    # probabilities on the fused path are the compact decision-
+    # confidence surrogate (dispatch.compact_probs): labels, raw
+    # labels, drift and the decision confidence are unchanged — the
+    # fused contract is LABEL equality with the unfused path
+    # (test-pinned at N=64 under FakeClock+DispatchFaults), which is
+    # why it is opt-in rather than the default.
+    fused: bool = False
 
     def __post_init__(self):
         if self.max_sessions <= 0 or self.target_batch <= 0:
@@ -303,6 +323,14 @@ class FleetServer:
             self.window, self.channels,
             capacity=max(2 * self.config.target_batch, 64),
         )
+        # fused hot-loop staging: preallocated slabs keyed by padded
+        # batch shape, recycled at retire — a fused launch gathers
+        # straight into a pooled slab (one copy, zero per-dispatch
+        # allocation) instead of gather + pad.  At most pipeline_depth
+        # slabs per shape are ever live; process-local by design (the
+        # staged windows themselves still ride the snapshot's pending
+        # array, like the arena)
+        self._slab_pool: dict[int, list[np.ndarray]] = {}
         # dispatch backend: built lazily from (model, mesh) — a >1-device
         # mesh shards the batch, a jitted model launches async, anything
         # else scores synchronously through model.transform
@@ -926,7 +954,12 @@ class FleetServer:
         # standalone StreamingClassifier applies, so equivalence holds
         # on poisoned streams too): one NaN row must never ride a
         # window into a 256-session micro-batch
-        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        if (
+            not isinstance(samples, np.ndarray)
+            or samples.ndim != 2
+            or samples.dtype != np.float32
+        ):
+            samples = np.atleast_2d(np.asarray(samples, np.float32))
         if samples.shape[-1] != self.channels:
             # validate BEFORE journaling or advancing the watermark: a
             # malformed push must raise to its caller, never write a
@@ -945,9 +978,15 @@ class FleetServer:
         # recovered ring/monitor state is bit-identical by construction.
         # ``rn`` records the RAW delivered length (rejected rows
         # included) so the recovered watermark stays in transport
-        # coordinates.
-        if len(samples) or n_bad:
-            self._jappend(
+        # coordinates.  (Journal presence checked HERE, not only in
+        # _jappend: the record dict and the tobytes copy are per-push
+        # hot-path allocations a journal-less fleet must not pay.)
+        if (
+            self._journal is not None
+            and not self._replaying
+            and (len(samples) or n_bad)
+        ):
+            self._journal.append(
                 {
                     "t": "push",
                     "sid": session_id,
@@ -1325,6 +1364,46 @@ class FleetServer:
             )
         return self._scorer
 
+    def _fused_active(self, scorer) -> bool:
+        """Is the fused hot loop in effect for the next dispatch?
+        Requires the opt-in knob, a device-backed scorer that can build
+        the fused program, a fused-ELIGIBLE smoothing mode (vote/none —
+        EMA needs the full probability vector the fused retire never
+        fetches), and a model that declares its class count (the
+        compact decision distribution needs the width)."""
+        return bool(
+            self.config.fused
+            and self.smoothing != "ema"
+            and getattr(scorer, "supports_fused", False)
+            and getattr(scorer.model, "num_classes", None)
+        )
+
+    def _acquire_slab(self, pad_k: int) -> np.ndarray:
+        pool = self._slab_pool.get(pad_k)
+        if pool:
+            return pool.pop()
+        return np.empty(
+            (pad_k, self.window, self.channels), np.float32
+        )
+
+    def _recycle_slab(self, ticket: DispatchTicket) -> None:
+        """Return a fused ticket's staging slab to the pool — called
+        once per retired ticket, AFTER the dispatch tap has run (tap
+        consumers receive views of the slab; anything holding windows
+        past the tap must copy, which ReplayBuffer does).
+
+        Retire-order recycling is also a CORRECTNESS constraint, not
+        just bookkeeping: on the CPU backend ``jax.device_put`` ALIASES
+        a contiguous f32 numpy buffer (zero-copy), so the in-flight
+        device array and the slab share memory — the slab may only be
+        rewritten once its ticket's fetch has blocked on the result,
+        which is exactly what retire guarantees."""
+        if ticket.slab is not None:
+            self._slab_pool.setdefault(ticket.pad_k, []).append(
+                ticket.slab
+            )
+            ticket.slab = None
+
     @property
     def scorer(self):
         """The active dispatch backend (HostScorer / DeviceScorer /
@@ -1363,12 +1442,26 @@ class FleetServer:
         scorer = self._get_scorer()
         # batch assembly is ONE gather out of the contiguous arena, and
         # the pad policy is the scorer's: pow2 single-device, devices ×
-        # pow2 sharded — either way a log2-bounded program ladder
-        windows = scorer.pad(
-            self._arena.gather([p.slot for p in batch])
-        )
+        # pow2 sharded — either way a log2-bounded program ladder.  The
+        # fused hot loop gathers straight into a pooled slab at the
+        # final padded size (zero per-dispatch allocation; the
+        # exact-fit case skips even the tail fill); the unfused path
+        # keeps gather + pad, whose exact-fit case returns the gathered
+        # array unchanged (no second copy — test-pinned).
+        fused = self._fused_active(scorer)
+        slab = None
+        if fused:
+            slab = self._acquire_slab(scorer.pad_size(len(batch)))
+            windows = self._arena.gather_into(
+                [p.slot for p in batch], slab
+            )
+        else:
+            windows = scorer.pad(
+                self._arena.gather([p.slot for p in batch])
+            )
         ticket = DispatchTicket(
-            batch, windows, scorer, self.model_version, self._clock()
+            batch, windows, scorer, self.model_version, self._clock(),
+            fused=fused, slab=slab,
         )
         for label in scorer.device_labels:
             self.stats.note_device_windows(
@@ -1381,6 +1474,8 @@ class FleetServer:
         def _attempt_launch():
             if self._fault_hook is not None:
                 self._fault_hook(ticket.windows)
+            if ticket.fused:
+                return scorer.launch_fused(ticket.windows)
             return scorer.launch(ticket.windows)
 
         def _note_retry(attempt, exc):
@@ -1413,10 +1508,24 @@ class FleetServer:
         cfg = self.config
         batch, k = ticket.batch, ticket.k
         self._chaos("pre_retire")
+
+        def _fetch(handle):
+            """One retire fetch, tier-blind: the fused path retrieves
+            the small (labels, top_probs) pair and rebuilds the compact
+            decision distribution on host; the unfused path fetches the
+            full probabilities.  Everything downstream — smoothing,
+            events, acks, the tap — consumes the same (k, C) shape."""
+            if ticket.fused:
+                labels, top = ticket.scorer.fetch_fused(handle, k)
+                return compact_probs(
+                    labels, top, int(ticket.scorer.model.num_classes)
+                )
+            return ticket.scorer.fetch(handle, k)
+
         probs = None
         if not ticket.failed:
             try:
-                probs = ticket.scorer.fetch(ticket.handle, k)
+                probs = _fetch(ticket.handle)
             except Exception as exc:
                 ticket.last_error = exc
                 ticket.attempts += 1
@@ -1430,9 +1539,11 @@ class FleetServer:
                 self.stats.dispatch_retries += 1
                 if self._fault_hook is not None:
                     self._fault_hook(ticket.windows)
-                return ticket.scorer.fetch(
-                    ticket.scorer.launch(ticket.windows), k
-                )
+                if ticket.fused:
+                    return _fetch(
+                        ticket.scorer.launch_fused(ticket.windows)
+                    )
+                return _fetch(ticket.scorer.launch(ticket.windows))
 
             def _note_retry(attempt, exc):
                 ticket.last_error = exc
@@ -1475,6 +1586,7 @@ class FleetServer:
             self.stats.drop(n_failed, "dispatch_failed")
             self.stats.dispatch_failures += 1
             self._note_slo(breached=True)
+            self._recycle_slab(ticket)
             return []
         # deliberate carry idle excluded: a ticket parked across polls
         # by design must not read as a slow dispatch (it would breach
@@ -1487,6 +1599,25 @@ class FleetServer:
         self.stats.dispatches += 1
         self.stats.note_batch(ticket.pad_k)
         self.stats.dispatch.record(dispatch_ms)
+        # fetch-byte attribution: the unfused retire materializes the
+        # full padded logits matrix on host (pad_k × C × 4 bytes); the
+        # fused retire moves only pad_k × (int32 label + f32 top) = 8
+        # bytes per padded row — the saving the 2× windows/s claim is
+        # evidenced with (device_ms attribution rides calibration).
+        # HostScorer retires count nothing: the whole score ran in host
+        # memory, and fetch_bytes means bytes that crossed the device
+        # boundary, not bytes that merely existed.
+        if ticket.scorer.kind != "host":
+            n_classes = probs.shape[1]
+            full_bytes = ticket.pad_k * n_classes * 4
+            if ticket.fused:
+                self.stats.fused_dispatches += 1
+                self.stats.fetch_bytes += ticket.pad_k * 8
+                self.stats.fetch_bytes_saved += max(
+                    0, full_bytes - ticket.pad_k * 8
+                )
+            else:
+                self.stats.fetch_bytes += full_bytes
         # the ladder is driven by PRIOR evidence: the batch that records
         # a breach is still emitted at the pre-breach degradation level
         # (its windows were scored under the old regime), the next one
@@ -1500,6 +1631,7 @@ class FleetServer:
         dev_share = None if dev is None else round(dev["p50_ms"] / k, 4)
         lat_share = dispatch_ms / k
 
+        journal_live = self._journal is not None and not self._replaying
         t_smooth0 = self._clock()
         self._chaos("post_score_pre_ack")
         # rows whose window was dropped mid-flight (a remove_session
@@ -1554,17 +1686,20 @@ class FleetServer:
             # re-steps the smoother to the exact pre-crash state
             # without re-scoring (and `shed` so a frozen smoother stays
             # frozen); durable at the end-of-poll flush, BEFORE the
-            # consumer can observe the event
-            self._jappend(
-                {
-                    "t": "ack",
-                    "sid": sess.sid,
-                    "ti": p.t_index,
-                    "ver": ticket.version,
-                    "shed": shed,
-                },
-                np.asarray(pr, np.float64).tobytes(),
-            )
+            # consumer can observe the event.  (Journal presence checked
+            # HERE like push's record: the dict + tobytes copy are
+            # per-EVENT allocations a journal-less fleet must not pay.)
+            if journal_live:
+                self._journal.append(
+                    {
+                        "t": "ack",
+                        "sid": sess.sid,
+                        "ti": p.t_index,
+                        "ver": ticket.version,
+                        "shed": shed,
+                    },
+                    np.asarray(pr, np.float64).tobytes(),
+                )
             events.append(FleetEvent(sess.sid, ev, degraded=shed))
         self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
         if self._dispatch_tap is not None:
@@ -1590,6 +1725,7 @@ class FleetServer:
                     )
             finally:
                 self._in_dispatch = False
+        self._recycle_slab(ticket)
         return events
 
     @staticmethod
@@ -1664,6 +1800,12 @@ class FleetServer:
             batch_sizes = sorted(
                 {scorer.pad_size(1), *self.stats.batch_sizes}
             )
+        # a fused engine dispatches the FUSED program (scale + logits +
+        # softmax + argmax + top-prob), so that is what calibration
+        # times at the emitted shapes — otherwise device_ms would
+        # under-report the fused tier's on-device work and the p99
+        # attribution would blame the tunnel for chip time
+        fused = self._fused_active(scorer)
         for b in batch_sizes:
             b = scorer.pad_size(int(b))
             if isinstance(scorer, HostScorer):
@@ -1678,7 +1820,9 @@ class FleetServer:
                     iters=iters,
                 )
             else:
-                self._device_ms[b] = scorer.measure(b, iters=iters)
+                self._device_ms[b] = scorer.measure(
+                    b, iters=iters, fused=fused
+                )
         return dict(self._device_ms)
 
     # ------------------------------------------------------ reporting
@@ -1692,6 +1836,11 @@ class FleetServer:
         # has built the scorer (building it here could cold-start a jax
         # backend from a pure stats read)
         snap["pipeline_depth"] = self.config.pipeline_depth
+        snap["fused"] = (
+            False
+            if self._scorer is None
+            else self._fused_active(self._scorer)
+        )
         snap["dispatch_backend"] = (
             None if self._scorer is None else self._scorer.kind
         )

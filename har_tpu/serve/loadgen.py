@@ -169,6 +169,10 @@ def run_pipeline_cell(
     n_runs: int = 3,
     hidden: int = 256,
     seed: int = 3,
+    fused: bool = False,
+    tier: str = "f32",
+    smoothing: str = "ema",
+    collect_labels: bool = False,
 ) -> dict:
     """One cell of the pipelined-dispatch grid: drive the standard
     synthetic fleet load through a FleetServer at the given pipeline
@@ -181,6 +185,13 @@ def run_pipeline_cell(
     mesh), and sharing this function is what keeps the in-process and
     subprocess cells comparable.  Raises ValueError when ``devices``
     exceeds the visible device count.
+
+    ``fused=True`` serves through the fused on-device hot loop (needs a
+    fused-eligible ``smoothing`` — vote/none); ``tier="int8"`` serves
+    the weight-only int8 quantization of the demo model
+    (har_tpu.quantize.quantize_serving).  ``collect_labels=True`` adds
+    the final run's ``(session, t_index, label)`` stream to the result
+    — what the grid's int8-agreement key is computed from.
     """
     import jax
 
@@ -192,6 +203,12 @@ def run_pipeline_cell(
         )
     mesh = create_mesh(dp=devices, tp=1) if devices > 1 else None
     model = JitDemoModel(hidden=hidden, tunnel_rtt_ms=tunnel_rtt_ms)
+    if tier == "int8":
+        from har_tpu.quantize import quantize_serving
+
+        model = quantize_serving(model)
+    elif tier != "f32":
+        raise ValueError(f"unknown tier {tier!r}")
     recordings, _ = synthetic_sessions(
         n_sessions, windows_per_session=windows_per_session, seed=seed
     )
@@ -203,32 +220,49 @@ def run_pipeline_cell(
             model,
             window=200,
             hop=200,
-            smoothing="ema",
+            smoothing=smoothing,
             config=FleetConfig(
                 max_sessions=n_sessions,
                 pipeline_depth=pipeline_depth,
                 target_batch=target_batch,
+                fused=fused,
             ),
             mesh=mesh,
         )
         for i in range(n_sessions):
             server.add_session(i)
-        _, report = drive_fleet(server, recordings, seed=seed)
-        return server, report
+        events, report = drive_fleet(server, recordings, seed=seed)
+        return server, report, events
 
     one_run()  # warmup: compile the padded programs
-    wps, server = [], None
+    wps, server, events = [], None, None
     for _ in range(int(n_runs)):
-        server, report = one_run()
+        server, report, events = one_run()
         acct = server.stats.accounting()
         wps.append(
             acct["scored"] / report.duration_s if report.duration_s else 0.0
         )
     snap = server.stats_snapshot()
-    return {
+    scored = snap["accounting"]["scored"]
+    # device-ms attribution: calibrate the program the cell actually
+    # dispatched (the FUSED program when fused — satellite contract) at
+    # the emitted padded shapes, so the artifact's speedup claim rides
+    # with per-shape device-time evidence, not just wall clocks
+    try:
+        device_ms = {
+            str(b): d["p50_ms"]
+            for b, d in sorted(server.calibrate_device(iters=4).items())
+        }
+    except ValueError:  # host-only model: no device program to time
+        device_ms = None
+    out = {
         "pipeline_depth": int(pipeline_depth),
         "devices": int(devices),
         "target_batch": int(target_batch),
+        "device_ms": device_ms,
+        "fused": bool(fused),
+        "tier": tier,
+        "smoothing": smoothing,
         "windows_per_sec_median": round(float(np.median(wps)), 1),
         "windows_per_sec_std": round(float(np.std(wps)), 1),
         "event_p99_ms_median": snap["stages"]["event_ms"].get("p99_ms"),
@@ -237,9 +271,56 @@ def run_pipeline_cell(
         "device_windows": snap["device_windows"],
         "dispatch_backend": snap["dispatch_backend"],
         "dispatches": snap["dispatches"],
+        "fused_dispatches": snap["fused_dispatches"],
+        "fetch_bytes_per_window": (
+            round(snap["fetch_bytes"] / scored, 1) if scored else None
+        ),
+        "fetch_bytes_saved": snap["fetch_bytes_saved"],
         "dropped_windows": snap["accounting"]["dropped"],
         "accounting_balanced": snap["accounting"]["balanced"],
     }
+    if collect_labels:
+        out["labels"] = [
+            [fe.session_id, fe.event.t_index, int(fe.event.label)]
+            for fe in events
+        ]
+    return out
+
+
+def run_fused_grid_cells(tb_base: int, common: dict) -> tuple[dict, object]:
+    """The fused depth-3 cells of the pipeline grid — f32 and int8
+    through the same fused hot loop — plus the int8 LIVE-label
+    agreement between them.  Shared by ``bench.py``'s
+    ``fleet_pipeline_grid`` lane and ``scripts/pipeline_grid_bench.py``
+    so the committed artifact and the round bench cannot compute the
+    agreement statistic differently.
+
+    tb doubles vs the grid's base cells: the depth-3 ring then
+    pipelines full dispatches while exposing half the serial tunnel
+    RTTs — a different dispatch-plane configuration by design, exactly
+    like the mesh cell's devices-scaled batch.  Returns
+    ``({"3x1_fused": ..., "3x1_fused_int8": ...}, int8_agreement)``
+    with the label streams consumed (popped) into the agreement."""
+    cells = {
+        "3x1_fused": run_pipeline_cell(
+            3, 1, target_batch=tb_base * 2, fused=True,
+            smoothing="vote", collect_labels=True, **common
+        ),
+        "3x1_fused_int8": run_pipeline_cell(
+            3, 1, target_batch=tb_base * 2, fused=True, tier="int8",
+            smoothing="vote", collect_labels=True, **common
+        ),
+    }
+    f32_labels = cells["3x1_fused"].pop("labels", [])
+    int8_labels = cells["3x1_fused_int8"].pop("labels", [])
+    agreement = None
+    if f32_labels and len(f32_labels) == len(int8_labels):
+        agreement = round(
+            sum(a == b for a, b in zip(f32_labels, int8_labels))
+            / len(f32_labels),
+            4,
+        )
+    return cells, agreement
 
 
 def run_pipeline_cell_subprocess(

@@ -120,25 +120,36 @@ def fleet_pipeline_smoke(
     *,
     windows_per_session: int = 2,
     target_batch: int = 32,
-    pipeline_depth: int = 2,
+    pipeline_depth: int = 3,
     max_devices: int = 8,
     tunnel_rtt_ms: float = 5.0,
+    fused: bool = True,
     seed: int = 0,
 ) -> dict:
     """The release gate's pipelined-dispatch check: the SAME load run
-    once synchronous (depth 1, single device) and once pipelined
-    (depth 2, batch-sharded over the dry-run mesh when >1 device is
-    visible), with the decision streams compared per session.
+    once synchronous (depth 1, single device, unfused — the PR-2/5
+    reference) and once through the full hot path (depth-3 ticket
+    ring, batch-sharded over the dry-run mesh when >1 device is
+    visible, FUSED device program), with the decision streams compared
+    per session.
 
     Verdict contract:
       - every session's (t_index, label, raw_label, drift) sequence is
-        IDENTICAL across the two runs; probabilities match exactly on a
-        single device and to 1e-6 across a mesh (GSPMD partitioning
-        re-tiles the matmul — same reduction-order drift the tp-vs-
-        single training pin documents);
+        IDENTICAL across the two runs, and the decision CONFIDENCE
+        (probability[label]) matches to 1e-6.  Smoothing is "none"
+        (passthrough — fused-eligible) PRECISELY so this check has
+        teeth: the unfused event carries the model's true probability
+        at the label while the fused event carries the device's
+        fetched top-prob, so a fused program returning wrong
+        confidences fails the gate (under vote smoothing both sides
+        would be label-derived and the comparison vacuous).  Off-label
+        probabilities are the documented compact surrogate — full-
+        vector equality is the unfused tier's contract, not this
+        one's;
       - zero dropped windows and a balanced conservation law in both;
-      - the pipelined run actually pipelined: overlap_pct is measured
-        (None would mean the launch/retire split never overlapped).
+      - the pipelined run actually pipelined (overlap_pct measured)
+        and actually fused (every dispatch through the fused program,
+        fetch bytes saved > 0 — stamped per window into the gate log).
 
     Uses ``JitDemoModel`` (jitted, training-free) with a small emulated
     tunnel RTT so the overlap is observable on hosts whose local
@@ -157,13 +168,14 @@ def fleet_pipeline_smoke(
         sessions, windows_per_session=windows_per_session, seed=seed
     )
 
-    def one_run(depth, run_mesh):
+    def one_run(depth, run_mesh, run_fused):
         server = FleetServer(
-            model, window=200, hop=200, smoothing="ema",
+            model, window=200, hop=200, smoothing="none",
             config=FleetConfig(
                 max_sessions=sessions,
                 target_batch=target_batch,
                 pipeline_depth=depth,
+                fused=run_fused,
             ),
             mesh=run_mesh,
         )
@@ -175,8 +187,8 @@ def fleet_pipeline_smoke(
             by_sid[ev.session_id].append(ev.event)
         return server, report, by_sid
 
-    s1, r1, ref = one_run(1, None)
-    s2, r2, got = one_run(pipeline_depth, mesh)
+    s1, r1, ref = one_run(1, None, False)
+    s2, r2, got = one_run(pipeline_depth, mesh, fused)
 
     equivalent = True
     for i in range(sessions):
@@ -186,7 +198,9 @@ def fleet_pipeline_smoke(
             and x.label == y.label
             and x.raw_label == y.raw_label
             and x.drift == y.drift
-            and np.allclose(x.probability, y.probability, atol=1e-6)
+            and abs(
+                x.probability[x.label] - y.probability[y.label]
+            ) <= 1e-6
             for x, y in zip(a, b)
         ):
             equivalent = False
@@ -200,20 +214,30 @@ def fleet_pipeline_smoke(
         for s in (snap1, snap2)
     )
     overlap = snap2["overlap_pct"]
+    fused_ok = (not fused) or (
+        snap2["fused_dispatches"] == snap2["dispatches"] > 0
+        and snap2["fetch_bytes_saved"] > 0
+    )
+    scored = snap2["accounting"]["scored"]
     wps1 = (
         round(snap1["accounting"]["scored"] / r1.duration_s, 1)
         if r1.duration_s
         else None
     )
     wps2 = (
-        round(snap2["accounting"]["scored"] / r2.duration_s, 1)
-        if r2.duration_s
-        else None
+        round(scored / r2.duration_s, 1) if r2.duration_s else None
     )
     return {
         "sessions": sessions,
         "devices": 1 if mesh is None else n_dev,
         "pipeline_depth": pipeline_depth,
+        "depth": pipeline_depth,
+        "fused": bool(fused),
+        "fused_dispatches": snap2["fused_dispatches"],
+        "fetch_bytes_per_window": (
+            round(snap2["fetch_bytes"] / scored, 1) if scored else None
+        ),
+        "fetch_bytes_saved": snap2["fetch_bytes_saved"],
         "overlap_pct": overlap,
         "p99_ms": snap2["stages"]["event_ms"].get("p99_ms"),
         "dropped": snap2["accounting"]["dropped"],
@@ -221,7 +245,9 @@ def fleet_pipeline_smoke(
         "windows_per_sec_depth1": wps1,
         "windows_per_sec": wps2,
         "equivalent": equivalent,
-        "ok": bool(equivalent and clean and overlap is not None),
+        "ok": bool(
+            equivalent and clean and overlap is not None and fused_ok
+        ),
     }
 
 

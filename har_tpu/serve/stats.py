@@ -173,6 +173,15 @@ class FleetStats:
         self.inflight_ms = 0.0
         self.inflight_depth: dict[int, int] = {}
         self.device_windows: dict[str, int] = {}
+        # fused hot loop (har_tpu.serve.dispatch, PR 10): dispatches
+        # retired through the one fused device program, bytes actually
+        # transferred device→host at retire, and bytes the fused
+        # (labels, top_probs) fetch saved vs the full logits matrix —
+        # the "fetch bytes dropped" evidence the 2× windows/s claim is
+        # attributed with
+        self.fused_dispatches = 0
+        self.fetch_bytes = 0
+        self.fetch_bytes_saved = 0
         # cluster control plane (har_tpu.serve.cluster): dead-worker
         # failovers this worker absorbed sessions from, sessions adopted
         # onto this worker via journal hand-off, and the total wall time
@@ -318,6 +327,9 @@ class FleetStats:
             "utilization": round(self.utilization, 4),
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
+            "fused_dispatches": self.fused_dispatches,
+            "fetch_bytes": self.fetch_bytes,
+            "fetch_bytes_saved": self.fetch_bytes_saved,
             "overlap_pct": self.overlap_pct(),
             "overlap_host_ms": round(self.overlap_host_ms, 3),
             "inflight_ms": round(self.inflight_ms, 3),
@@ -346,6 +358,7 @@ class FleetStats:
         "shadow_batches", "shadow_windows", "shadow_errors",
         "worker_failovers", "migrations",
         "resizes", "scale_ups", "scale_downs",
+        "fused_dispatches", "fetch_bytes", "fetch_bytes_saved",
         "unknown_state_keys",
     )
     _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")

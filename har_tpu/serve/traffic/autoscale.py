@@ -68,7 +68,11 @@ class AutoscaleConfig:
     min_target_batch: int = 16
     max_target_batch: int = 256
     min_depth: int = 1
-    max_depth: int = 2
+    # the dispatch plane's ticket ring runs depth >= 3 (PR 10): a
+    # third in-flight ticket keeps the device busy across a slow host
+    # round, so the default ladder now walks one rung past classic
+    # double-buffering before it reaches for the mesh
+    max_depth: int = 3
     mesh_ladder: tuple = (1,)
     queue_high: float = 1.5
     util_low: float = 0.5

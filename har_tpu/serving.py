@@ -97,7 +97,28 @@ def finite_rows(
     finite bound) replaces the separate isfinite + range passes.  The
     equivalence with the two-pass guard is test-pinned on poisoned
     streams.
+
+    Fast path first: the CHUNK-level scalar abs-max answers the common
+    all-clean case in one reduction with no per-row bookkeeping at all
+    (a NaN/Inf/out-of-range entry makes the scalar fail its bound
+    check, falling through to the row-classifying path) — at fleet
+    ingest rates the guard runs per delivery chunk for thousands of
+    sessions per round, and the row machinery was measurably on the
+    serving hot path.
     """
+    if samples.size == 0:
+        return samples, 0
+    # no errstate on the fast path: abs/max propagate NaN silently and
+    # the scalar comparison below is plain Python — only the per-row
+    # classification needs the invalid-compare guard
+    chunk_max = float(np.abs(samples).max())
+    clean = (
+        chunk_max <= max_abs  # NaN/Inf compare False: fall through
+        if max_abs is not None
+        else np.isfinite(chunk_max)
+    )
+    if clean:
+        return samples, 0
     with np.errstate(invalid="ignore"):
         m = np.abs(samples).max(axis=-1)
         if max_abs is not None:
@@ -255,7 +276,16 @@ class _WindowAssembler:
         the equivalence suite pins by construction (chunking never
         changes events).
         """
-        samples = np.atleast_2d(np.asarray(samples, np.float32))
+        if (
+            not isinstance(samples, np.ndarray)
+            or samples.ndim != 2
+            or samples.dtype != np.float32
+        ):
+            # already-clean (n, C) f32 input (the fleet engine's push
+            # normalized it) skips the per-chunk conversion churn — at
+            # 20 Hz × thousands of sessions these two calls were
+            # measurably on the ingest hot path
+            samples = np.atleast_2d(np.asarray(samples, np.float32))
         if samples.shape[-1] != self.channels:
             raise ValueError(
                 f"expected (n, {self.channels}) samples, got "
@@ -371,7 +401,15 @@ class _Smoother:
     def step(self, probs: np.ndarray) -> tuple[int, int, np.ndarray]:
         """Absorb one window's ``(C,)`` probabilities (in emission
         order); return ``(label, raw_label, decision_probs)``."""
-        raw_label = int(probs.argmax())
+        return self._step_raw(int(probs.argmax()), probs)
+
+    def _step_raw(
+        self, raw_label: int, probs: np.ndarray
+    ) -> tuple[int, int, np.ndarray]:
+        """``step`` with the raw argmax precomputed — ``update_many``
+        vectorizes the argmax over a session's whole block (one
+        reduction instead of one per row) and feeds the recurrence
+        through here; the decision logic is byte-for-byte ``step``'s."""
         if self.smoothing == "ema":
             self._ema = (
                 probs
@@ -382,20 +420,34 @@ class _Smoother:
             smoothed = self._ema
             label = int(smoothed.argmax())
         elif self.smoothing == "vote":
-            self._votes.append(raw_label)
-            counts = np.bincount(
-                np.asarray(self._votes), minlength=probs.shape[0]
-            )
-            best = counts.max()
+            votes = self._votes
+            votes.append(raw_label)
+            # integer vote counting in plain Python: the deque holds at
+            # most vote_depth small ints, and per-window np.bincount/
+            # max/array churn was measurably on the fleet retire hot
+            # path.  Integer arithmetic is exact, so the counts — and
+            # the float64 division below — are bit-identical to the
+            # previous numpy formulation (test-pinned vs step-by-step).
+            # Width mirrors bincount(minlength=C): a stale vote from
+            # before a swap to a NARROWER model still counts instead of
+            # crashing the retire loop with an IndexError.
+            width = probs.shape[0]
+            for v in votes:
+                if v >= width:
+                    width = v + 1
+            counts = [0] * width
+            for v in votes:
+                counts[v] += 1
+            best = max(counts)
             # ties break toward the newest label that achieves the max
             label = next(
-                v for v in reversed(self._votes) if counts[v] == best
+                v for v in reversed(votes) if counts[v] == best
             )
             # the event's probability must describe the DECISION, so in
             # vote mode it is the trailing vote distribution (the raw
             # window's own distribution stays reachable via raw_label);
             # probability[label] is then the vote confidence
-            smoothed = counts.astype(np.float64) / counts.sum()
+            smoothed = np.asarray(counts, np.float64) / len(votes)
         else:
             smoothed = probs
             label = raw_label
@@ -418,7 +470,12 @@ class _Smoother:
             return [
                 (int(r), int(r), p) for r, p in zip(raws, probs)
             ]
-        return [self.step(p) for p in probs]
+        # stateful modes: the raw argmax is still one vectorized
+        # reduction over the block; only the recurrence runs per row
+        raws = probs.argmax(axis=1)
+        return [
+            self._step_raw(int(r), p) for r, p in zip(raws, probs)
+        ]
 
 
 class StreamingClassifier:

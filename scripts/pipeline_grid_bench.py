@@ -5,9 +5,12 @@ The bench's ``fleet_pipeline_grid`` lane (bench.py) runs the same
 measurement inside the budgeted round-end draw; this script is the
 standalone path that produces a committed artifact on any host — the
 grid compares the ENGINE's dispatch-plane configurations (synchronous
-1x1 vs double-buffered 2x1 vs double-buffered + mesh-sharded 2x8) on
-the same 1,000-session load, with the emulated tunnel RTT stated, so
-the speedup is reproducible without a TPU attached.
+1x1 vs double-buffered 2x1 vs the fused depth-3 ticket ring in f32 and
+int8 vs fused + mesh-sharded 3x8) on the same 1,000-session load, with
+the emulated tunnel RTT stated, so the speedup is reproducible without
+a TPU attached.  The fused cells also stamp ``fetch_bytes_per_window``
++ per-shape ``device_ms`` (the fused program's calibration) and the
+int8 cell its live label agreement vs the f32 fused cell.
 
     python scripts/pipeline_grid_bench.py          # writes the artifact
     python scripts/pipeline_grid_bench.py --smoke  # tiny sizes, no write
@@ -39,6 +42,7 @@ def measure(n_sessions: int, n_runs: int, tb_base: int) -> dict:
     # behind bench.py's fleet_pipeline_grid lane, so the lane and this
     # committed artifact cannot silently diverge
     from har_tpu.serve.loadgen import (
+        run_fused_grid_cells,
         run_pipeline_cell,
         run_pipeline_cell_subprocess,
     )
@@ -52,20 +56,33 @@ def measure(n_sessions: int, n_runs: int, tb_base: int) -> dict:
     grid = {
         "1x1": run_pipeline_cell(1, 1, target_batch=tb_base, **common),
         "2x1": run_pipeline_cell(2, 1, target_batch=tb_base, **common),
-        f"2x{mesh_devices}": run_pipeline_cell_subprocess(
-            2, mesh_devices,
-            dict(common, target_batch=tb_base * mesh_devices),
-        ),
     }
+    # r15 fused hot loop: depth-3 ticket ring + the one fused device
+    # program, f32 and int8, with the int8 live-label agreement — THE
+    # shared helper bench.py's lane also uses (the artifact and the
+    # round bench cannot compute the statistic differently)
+    fused_cells, int8_agreement = run_fused_grid_cells(tb_base, common)
+    grid.update(fused_cells)
+    grid[f"3x{mesh_devices}_fused"] = run_pipeline_cell_subprocess(
+        3, mesh_devices,
+        dict(common, target_batch=tb_base * mesh_devices,
+             fused=True, smoothing="vote"),
+    )
     for label, cell in grid.items():
         print(
             f"{label}: {cell['windows_per_sec_median']} w/s median "
             f"(std {cell['windows_per_sec_std']}), overlap "
-            f"{cell['overlap_pct']}, backend {cell['dispatch_backend']}",
+            f"{cell['overlap_pct']}, backend {cell['dispatch_backend']}"
+            f", fused {cell['fused_dispatches']}/{cell['dispatches']}",
             file=sys.stderr,
         )
-    mesh_cell = f"2x{mesh_devices}"
+    mesh_cell = f"3x{mesh_devices}_fused"
     base = grid["1x1"]["windows_per_sec_median"]
+    fused_best = max(
+        grid[c]["windows_per_sec_median"]
+        for c in grid
+        if c.endswith("_fused")
+    )
     return {
         "lane": "fleet_pipeline_grid",
         "model": "jit_demo_mlp_h256",
@@ -80,6 +97,10 @@ def measure(n_sessions: int, n_runs: int, tb_base: int) -> dict:
             if base
             else None
         ),
+        "fused_speedup_vs_sync_single": (
+            round(fused_best / base, 2) if base else None
+        ),
+        "int8_agreement": int8_agreement,
     }
 
 

@@ -1077,3 +1077,174 @@ def test_adapt_smoke_verdict(tmp_path):
     reg = ModelRegistry(str(tmp_path / "reg"))
     assert reg.current().version == 2
     assert [h["event"] for h in reg.history()] == ["promote", "promote"]
+
+
+# ---------------------------------------------- int8 promotion (PR 10)
+
+
+def _int8_fleet(depth, n=16, fused=True):
+    from har_tpu.serve import JitDemoModel, synthetic_sessions
+
+    model = JitDemoModel()
+    recs, _ = synthetic_sessions(n, windows_per_session=8, seed=17)
+    server = FleetServer(
+        model, window=200, hop=200, smoothing="vote",
+        config=FleetConfig(
+            max_sessions=n, target_batch=16, pipeline_depth=depth,
+            fused=fused,
+        ),
+    )
+    for i in range(n):
+        server.add_session(i)
+    return model, server, recs
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4])
+def test_int8_promotion_shadow_agreement_at_every_ring_depth(
+    depth, tmp_path
+):
+    """THE int8 shadow-agreement pin: propose_int8 quantizes the
+    serving incumbent, shadows it against live f32 traffic through the
+    fused + depth-N dispatch plane, passes the agreement + latency
+    gates on evidence, hot-swaps at a dispatch boundary with zero
+    drops, and survives probation — at every ticket-ring depth 1-4."""
+    from har_tpu.quantize import Int8ServingModel
+    from har_tpu.serve import drive_fleet
+
+    model, server, recs = _int8_fleet(depth)
+    engine = AdaptationEngine(
+        server, ModelRegistry(str(tmp_path / "reg")),
+        lambda job: None,
+        config=AdaptationConfig(probation_dispatches=2,
+                                probation_min_agreement=0.9),
+        shadow_config=ShadowConfig(sample_every=1, min_windows=8),
+    )
+    ver = engine.propose_int8(shadow_config=ShadowConfig(
+        sample_every=1, min_windows=8, min_agreement=0.95,
+        max_latency_factor=50.0,
+    ))
+    assert engine.state == "shadowing"
+    assert isinstance(engine._candidate[1], Int8ServingModel)
+    halves = [(r[: len(r) // 2], r[len(r) // 2:]) for r in recs]
+    drive_fleet(server, [h[0] for h in halves], seed=17,
+                on_poll=lambda s, r: engine.step())
+    drive_fleet(server, [h[1] for h in halves], seed=18,
+                on_poll=lambda s, r: engine.step())
+    engine.step()
+    assert engine.state == "serving"
+    assert server.model_version == ver
+    assert server.stats.model_swaps == 1
+    assert server.stats.rollbacks == 0
+    assert isinstance(server.model, Int8ServingModel)
+    events = [e for e in engine.log if e["event"] == "swapped"]
+    assert events and events[0]["shadow"]["agreement"] >= 0.95
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+    assert server.stats.dropped_total == 0
+    # the per-version attribution saw both tiers serve
+    assert set(server.stats.scored_by_version) >= {"v0000001", ver}
+
+
+def test_int8_promotion_rejected_on_agreement_evidence(tmp_path):
+    """An int8 gate that demands impossible agreement rejects the
+    candidate on evidence: the f32 incumbent keeps serving and the
+    candidate stays registered unpromoted — adoption on measurement,
+    not faith."""
+    from har_tpu.serve import drive_fleet
+
+    model, server, recs = _int8_fleet(depth=2)
+    engine = AdaptationEngine(
+        server, ModelRegistry(str(tmp_path / "reg")),
+        lambda job: None,
+        config=AdaptationConfig(max_shadow_dispatches=4),
+        shadow_config=ShadowConfig(sample_every=1, min_windows=8),
+    )
+    ver = engine.propose_int8(shadow_config=ShadowConfig(
+        sample_every=1, min_windows=10_000,  # unmeetable evidence floor
+    ))
+    drive_fleet(server, recs, seed=17,
+                on_poll=lambda s, r: engine.step())
+    engine.step()
+    assert engine.state == "serving"
+    assert server.model_version == "v0000001"  # incumbent unchanged
+    assert server.stats.model_swaps == 0
+    assert engine.rejected_candidates == 1
+    current = engine.registry.current()
+    assert current is not None and current.name == "v0000001"
+    names = {mv.name for mv in engine.registry.versions()}
+    assert ver in names  # auditable, unpromoted
+
+
+def test_propose_refused_outside_serving(tmp_path):
+    model, server, recs = _int8_fleet(depth=1)
+    engine = AdaptationEngine(
+        server, ModelRegistry(str(tmp_path / "reg")),
+        lambda job: None,
+        shadow_config=ShadowConfig(sample_every=1, min_windows=4),
+    )
+    engine.propose_int8()
+    with pytest.raises(RuntimeError, match="shadowing"):
+        engine.propose_int8()
+
+
+def test_shadow_latency_warmup_excludes_compile_batch():
+    """The candidate's first mirrored batch pays jit compilation —
+    deployment cadence, not serving speed — so latency_warmup=1
+    (default) drops it from the latency-gate sample while agreement
+    still counts it."""
+    clock = FakeClock()
+    ticks = iter([0.0, 5.0, 5.0, 5.1, 5.1, 5.15])  # 5 s compile, then fast
+
+    class _TickClock:
+        def __call__(self):
+            try:
+                return next(ticks)
+            except StopIteration:
+                return 6.0
+
+    cand = _StubModel()
+    ev = ShadowEvaluator(
+        cand, ShadowConfig(sample_every=1, min_windows=1,
+                           max_latency_factor=2.0),
+        clock=_TickClock(),
+    )
+    x = np.zeros((4, 10, 3), np.float32)
+    probs = np.tile(np.asarray([0.5, 0.3, 0.2]), (4, 1))  # argmax 0, matching the stub
+    ev(list("abcd"), x, probs)  # warmup batch: 5000 ms
+    ev(list("abcd"), x, probs)  # steady batch: ~0 ms
+    rep = ev.report()
+    assert rep["batches_scored"] == 2
+    assert rep["windows_scored"] == 8  # both batches count as evidence
+    assert rep["candidate_mean_batch_ms"] < 1000  # compile excluded
+    ev.set_incumbent_ms(50.0)
+    assert ev.gates()["passed"]
+    # warmup=0 restores the raw sample
+    ticks2 = iter([0.0, 5.0])
+    ev2 = ShadowEvaluator(
+        cand, ShadowConfig(sample_every=1, min_windows=1,
+                           latency_warmup=0, max_latency_factor=2.0),
+    )
+    ev2._clock = lambda: next(ticks2, 6.0)
+    ev2(list("abcd"), x, probs)
+    assert ev2.report()["candidate_mean_batch_ms"] >= 5000
+
+
+def test_latency_gate_needs_post_warmup_evidence():
+    """Review fix pin: a configured max_latency_factor may never pass
+    on an EMPTY latency sample — when warmup excluded the only scored
+    batch, gates() holds the candidate until a measured batch lands
+    (a slow candidate must not promote unmeasured)."""
+    cand = _StubModel()
+    ev = ShadowEvaluator(
+        cand, ShadowConfig(sample_every=1, min_windows=1,
+                           max_latency_factor=2.0),
+    )
+    x = np.zeros((4, 10, 3), np.float32)
+    probs = np.tile(np.asarray([0.5, 0.3, 0.2]), (4, 1))
+    ev(list("abcd"), x, probs)  # the only batch: warmup-excluded
+    ev.set_incumbent_ms(50.0)
+    gates = ev.gates()
+    assert not gates["passed"]
+    assert any("latency evidence" in r for r in gates["reasons"])
+    ev(list("abcd"), x, probs)  # a measured batch arrives
+    assert ev.gates()["passed"]

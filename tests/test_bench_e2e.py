@@ -96,16 +96,18 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         assert fleet["dropped_windows"] == 0
         assert "chip_state_probe" in fleet
         assert extra["fleet_event_p99_ms"] == fleet["event_p99_ms_median"]
-    # r10 pipelined-dispatch grid: depth × devices cells over the same
-    # load (1x1 synchronous baseline, 2x1 double-buffered, 2xN mesh-
-    # sharded when >1 device is visible) with the emulated-tunnel RTT
-    # stated, zero drops and balanced accounting per cell; the flat
-    # speedup/overlap keys mirror the mesh cell — or a deadline-skip
-    # marker; never silently absent
+    # r10/r15 pipelined-dispatch grid: depth × devices cells over the
+    # same load (1x1 synchronous baseline, 2x1 double-buffered,
+    # 3x1_fused + 3x1_fused_int8 through the fused hot loop, 3xN
+    # fused + mesh-sharded when >1 device is visible) with the
+    # emulated-tunnel RTT stated, zero drops and balanced accounting
+    # per cell; the flat speedup/overlap/fused/int8 keys mirror the
+    # lane — or a deadline-skip marker; never silently absent
     grid_lane = extra["lanes"]["fleet_pipeline_grid"]
     if "skipped" not in grid_lane:
         grid = grid_lane["grid"]
         assert "1x1" in grid and "2x1" in grid
+        assert "3x1_fused" in grid and "3x1_fused_int8" in grid
         assert grid_lane["emulated_tunnel_rtt_ms"] > 0
         for cell in grid.values():
             if "error" in cell:  # mesh subprocess may fail; loudly
@@ -114,6 +116,19 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             assert cell["accounting_balanced"] is True
             assert cell["windows_per_sec_median"] > 0
         assert grid["1x1"]["pipeline_depth"] == 1
+        assert grid["1x1"]["fused"] is False
+        for name in ("3x1_fused", "3x1_fused_int8"):
+            cell = grid[name]
+            assert cell["pipeline_depth"] == 3
+            assert cell["fused"] is True
+            assert cell["fused_dispatches"] == cell["dispatches"] > 0
+            assert cell["fetch_bytes_saved"] > 0
+        assert grid["3x1_fused_int8"]["tier"] == "int8"
+        assert (
+            extra["int8_agreement"] == grid_lane["int8_agreement"]
+        )
+        if grid_lane["int8_agreement"] is not None:
+            assert grid_lane["int8_agreement"] >= 0.95
         mesh_cell = grid[grid_lane["mesh_cell"]]
         if mesh_cell["devices"] > 1:
             assert mesh_cell["dispatch_backend"] == "sharded"
@@ -125,6 +140,10 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         assert (
             extra["fleet_pipeline_speedup"]
             == grid_lane["speedup_vs_sync_single"]
+        )
+        assert (
+            extra["fleet_fused_speedup"]
+            == grid_lane["fused_speedup_vs_sync_single"]
         )
         assert "chip_state_probe" in grid_lane
     # r8 adaptive-serving lane: the fleet numbers across a forced

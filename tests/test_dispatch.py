@@ -629,3 +629,459 @@ def test_staged_resizes_compose_at_one_boundary():
     assert server.config.pipeline_depth == 2
     assert server.stats.resizes == 1  # one composed boundary resize
     assert server.stats.scale_ups == 1
+
+
+# ------------------------------------------------- fused hot loop (PR 10)
+
+
+def _labels(events):
+    out = {}
+    for fe in events:
+        ev = fe.event
+        out.setdefault(fe.session_id, []).append(
+            (ev.t_index, ev.label, ev.raw_label, ev.drift,
+             round(float(ev.probability[ev.label]), 12))
+        )
+    return out
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4])
+def test_fused_label_equal_to_unfused_n64(depth):
+    """THE fused acceptance pin: the fused + depth-N path emits the
+    same (t_index, label, raw_label, drift) stream — and the same
+    decision confidence — as the PR-5 unfused synchronous path at N=64
+    under FakeClock + DispatchFaults, at every ring depth 1-4.  Event
+    probabilities off the decision label are the compact surrogate by
+    design (dispatch.compact_probs), so the pin is label equality, not
+    probability bit-identity."""
+    from har_tpu.serve import FakeClock
+
+    n = 64
+    recs = _recordings(n, n_samples=450, seed=31)
+    model = JitDemoModel(window=100)
+
+    def run(fused, d):
+        clock = FakeClock()
+        server = FleetServer(
+            model, window=100, hop=50, smoothing="vote",
+            config=FleetConfig(
+                max_sessions=n, target_batch=32, max_delay_ms=0.0,
+                retries=1, pipeline_depth=d, fused=fused,
+            ),
+            fault_hook=DispatchFaults(
+                stall_every=3, stall_ms=1.0, fail_every=5,
+                fake_clock=clock,
+            ),
+            clock=clock,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events = []
+        cursors = [0] * n
+        rng = np.random.default_rng(7)
+        while any(c < len(recs[i]) for i, c in enumerate(cursors)):
+            for i in range(n):
+                if cursors[i] >= len(recs[i]):
+                    continue
+                step = int(rng.integers(20, 120))
+                server.push(i, recs[i][cursors[i]: cursors[i] + step])
+                cursors[i] += step
+            events.extend(server.poll(force=True))
+            clock.advance(0.01)
+        events.extend(server.flush())
+        return server, events
+
+    s0, ev0 = run(False, 1)
+    s1, ev1 = run(True, depth)
+    l0, l1 = _labels(ev0), _labels(ev1)
+    assert l0.keys() == l1.keys()
+    for sid in l0:
+        assert l0[sid] == l1[sid]
+    # the fused run really ran fused, and really fetched less
+    assert s1.stats.fused_dispatches == s1.stats.dispatches > 0
+    assert s1.stats.fetch_bytes_saved > 0
+    assert s1.stats.fetch_bytes < s0.stats.fetch_bytes
+    assert s0.stats.fused_dispatches == 0
+    for s in (s0, s1):
+        acct = s.stats.accounting()
+        assert acct["balanced"] and acct["pending"] == 0
+    if depth >= 2:
+        assert max(s1.stats.inflight_depth) >= 2
+
+
+def test_fused_requires_eligible_smoothing_and_device_scorer():
+    """fused=True is a REQUEST: EMA smoothing (needs the full
+    probability vector) and host-only models serve unfused, silently
+    and correctly — the knob never changes what EMA events contain."""
+    model = JitDemoModel(window=20)
+    server = FleetServer(
+        model, window=20, hop=20, smoothing="ema",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0, fused=True),
+    )
+    server.add_session(0)
+    server.push(0, np.zeros((20 * 4, 3), np.float32))
+    server.poll(force=True)
+    assert server.stats.fused_dispatches == 0
+    assert server.stats.dispatches == 1
+    # host stub: fused ineligible regardless of smoothing
+    host = FleetServer(
+        _StubModel(), window=20, hop=20, smoothing="none",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0, fused=True),
+    )
+    host.add_session(0)
+    host.push(0, np.zeros((20 * 4, 3), np.float32))
+    host.poll(force=True)
+    assert host.stats.fused_dispatches == 0
+    assert host.stats.dispatches == 1
+
+
+def test_compact_probs_contract():
+    """argmax(out[i]) is STRICTLY the device label (even on the exact
+    top == 1/C tie), the decision confidence is exactly the device's
+    top probability, and rows sum to 1 up to fp rounding."""
+    from har_tpu.serve.dispatch import compact_probs
+
+    labels = np.asarray([2, 0, 5, 3])
+    top = np.asarray([0.9, 1.0 / 6.0, 0.400001, 1.0])
+    out = compact_probs(labels, top, 6)
+    assert out.shape == (4, 6)
+    np.testing.assert_array_equal(out.argmax(axis=1), labels)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-9)
+    np.testing.assert_array_equal(out[np.arange(4), labels], top)
+    # single-class degenerate
+    one = compact_probs(np.zeros(3, np.intp), np.ones(3), 1)
+    np.testing.assert_array_equal(one, np.ones((3, 1)))
+
+
+def test_arena_gather_into_exact_fit_and_padding():
+    """gather_into writes straight into the preallocated slab: tail
+    rows repeat the last gathered row (pad_pow2 semantics), and the
+    exact-fit case touches only the gathered rows."""
+    arena = StagingArena(4, 2, capacity=8)
+    rng = np.random.default_rng(1)
+    wins = rng.normal(size=(6, 4, 2)).astype(np.float32)
+    slots = [arena.put(w) for w in wins]
+    slab = np.empty((8, 4, 2), np.float32)
+    out = arena.gather_into(slots, slab)
+    assert out is slab
+    np.testing.assert_array_equal(slab[:6], wins)
+    np.testing.assert_array_equal(slab[6], wins[-1])
+    np.testing.assert_array_equal(slab[7], wins[-1])
+    # exact fit: the tail fill is skipped entirely
+    exact = np.full((6, 4, 2), np.nan, np.float32)
+    arena.gather_into(slots, exact)
+    np.testing.assert_array_equal(exact, wins)
+
+
+def test_pad_exact_fit_skips_the_copy_and_compile_count_unchanged():
+    """Satellite pin: both pad policies return the input UNCHANGED
+    (same object — no copy) when the batch already sits on the padded
+    ladder, and a fleet emitting only exact-fit batches compiles the
+    same single program either way."""
+    x = np.zeros((32, 2), np.float32)
+    assert pad_pow2(x) is x
+    assert pad_shard(x, 8) is x
+    assert pad_shard(x, 1) is x
+    model = JitDemoModel(window=10)
+    server = FleetServer(
+        model, window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=8, max_delay_ms=0.0),
+    )
+    server.add_session(0)
+    for _ in range(3):  # three exact 8-window batches
+        server.push(0, np.zeros((10 * 8, 3), np.float32))
+        server.poll(force=True)
+    assert set(server.stats.batch_sizes) == {8}
+    assert server.scorer.compiled_shapes == {8}
+
+
+def test_fused_slab_pool_bounded_and_recycled():
+    """The fused staging slabs are pooled per padded shape: at most
+    pipeline_depth slabs per shape ever exist, recycled at retire —
+    steady-state fused serving allocates nothing per dispatch."""
+    model = JitDemoModel(window=10)
+    server = FleetServer(
+        model, window=10, hop=10, smoothing="none",
+        config=FleetConfig(
+            target_batch=4, max_delay_ms=0.0, pipeline_depth=3,
+            fused=True,
+        ),
+    )
+    server.add_session(0)
+    for _ in range(6):
+        server.push(0, np.zeros((10 * 8, 3), np.float32))
+        server.poll(force=True)
+    server.flush()
+    assert server.stats.fused_dispatches == server.stats.dispatches >= 12
+    pool = server._slab_pool
+    assert set(pool) == {4}
+    assert 1 <= len(pool[4]) <= 3
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_fused_survives_dispatch_faults_and_session_removal():
+    """Fused retry path: transient launch failures re-dispatch the
+    SAME slab; a session removed while its fused ticket flies drops
+    cleanly (no event, no double free, slab recycled)."""
+    from har_tpu.serve import FakeClock
+
+    model = JitDemoModel(window=10)
+    clock = FakeClock()
+    server = FleetServer(
+        model, window=10, hop=10, smoothing="none",
+        config=FleetConfig(
+            target_batch=4, max_delay_ms=0.0, pipeline_depth=2,
+            retries=1, fused=True,
+        ),
+        fault_hook=DispatchFaults(fail_every=3, fake_clock=clock),
+        clock=clock,
+    )
+    server.add_session(0)
+    server.add_session(1)
+    for _ in range(4):
+        server.push(0, np.zeros((10 * 4, 3), np.float32))
+        server.push(1, np.ones((10 * 4, 3), np.float32))
+        server.poll()  # unforced: tickets carry
+    # remove session 1 while a ticket may be in flight
+    server.remove_session(1)
+    server.flush()
+    acct = server.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+    assert server.stats.dispatch_retries > 0
+    assert not server._slab_pool or all(
+        len(v) <= 2 for v in server._slab_pool.values()
+    )
+
+
+def test_calibrate_device_measures_fused_program():
+    """Satellite pin: a fused engine calibrates the FUSED program at
+    the emitted shapes (the measurement carries fused=True), so
+    device_ms attribution reflects what actually dispatches; the host
+    stub ValueError is unchanged."""
+    model = JitDemoModel(window=10)
+    server = FleetServer(
+        model, window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0, fused=True),
+    )
+    server.add_session(0)
+    server.push(0, np.zeros((10 * 4, 3), np.float32))
+    server.poll(force=True)
+    cal = server.calibrate_device(iters=2)
+    assert all(d["fused"] for d in cal.values())
+    assert 4 in cal
+    # events after calibration carry the fused program's device share
+    server.push(0, np.zeros((10 * 4, 3), np.float32))
+    events = server.flush()
+    assert events and events[0].event.device_ms is not None
+    # unfused engine measures the bare logits program
+    server2 = FleetServer(
+        model, window=10, hop=10, smoothing="none",
+        config=FleetConfig(target_batch=4, max_delay_ms=0.0),
+    )
+    server2.add_session(0)
+    server2.push(0, np.zeros((10 * 4, 3), np.float32))
+    server2.poll(force=True)
+    cal2 = server2.calibrate_device(iters=2)
+    assert all(not d["fused"] for d in cal2.values())
+    with pytest.raises(ValueError):
+        FleetServer(_StubModel(), window=10, hop=10).calibrate_device()
+
+
+# ------------------------------------------------------ int8 tier (PR 10)
+
+
+def test_make_scorer_int8_tier():
+    """tier="int8" quantizes the model behind the same scorer
+    interface (weights int8 on device as program inputs), an already-
+    int8 model passes through, a host model raises, and an unknown
+    tier is refused."""
+    from har_tpu.quantize import Int8ServingModel, quantize_serving
+
+    model = JitDemoModel()
+    scorer = make_scorer(model, None, tier="int8")
+    assert isinstance(scorer, DeviceScorer)
+    assert isinstance(scorer.model, Int8ServingModel)
+    assert scorer.model.size_report()["ratio"] < 0.3
+    # int8 leaves really are the device params
+    kinds = {s.kind for s in scorer.model.stored}
+    assert "q8" in kinds
+    # already-quantized passthrough
+    q = quantize_serving(model)
+    assert make_scorer(q, None, tier="int8").model is q
+    with pytest.raises(ValueError):
+        make_scorer(_StubModel(), None, tier="int8")
+    with pytest.raises(ValueError, match="tier"):
+        make_scorer(model, None, tier="fp4")
+
+
+def test_int8_tier_agreement_with_f32_fleet():
+    """The int8 tier through the full fused+deep fleet path agrees
+    with the f32 PR-5 path on live labels (weight rounding may flip a
+    rare boundary window — the shadow gate exists for exactly that, so
+    the pin is a high agreement floor, not bitwise equality)."""
+    from har_tpu.quantize import quantize_serving
+
+    model = JitDemoModel()
+    n = 32
+    recordings, _ = synthetic_sessions(n, windows_per_session=3, seed=13)
+
+    def run(m, fused, depth):
+        server = FleetServer(
+            m, window=200, hop=200, smoothing="vote",
+            config=FleetConfig(
+                max_sessions=n, target_batch=16, pipeline_depth=depth,
+                fused=fused,
+            ),
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, _ = drive_fleet(server, recordings, seed=13)
+        return server, events
+
+    s_f32, ev_f32 = run(model, False, 1)
+    s_int8, ev_int8 = run(quantize_serving(model), True, 3)
+    assert s_int8.stats.fused_dispatches == s_int8.stats.dispatches > 0
+    a = [(fe.session_id, fe.event.t_index, fe.event.label)
+         for fe in ev_f32]
+    b = [(fe.session_id, fe.event.t_index, fe.event.label)
+         for fe in ev_int8]
+    assert len(a) == len(b)
+    agreement = float(np.mean([x == y for x, y in zip(a, b)]))
+    assert agreement >= 0.97
+    acct = s_int8.stats.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+# ------------------------------------------- depth 3→1 downsize (PR 10)
+
+
+def test_resize_depth_3_to_1_downsize_while_two_tickets_fly():
+    """Satellite pin: a depth 3→1 downsize staged while TWO carried
+    tickets are still in flight — both retire on their old geometry,
+    the pipe re-bounds immediately, and the event stream is
+    bit-identical to a never-resized depth-3 run."""
+    n = 8
+    recs = _recordings(n, n_samples=900, seed=23)
+
+    def run(resize_at):
+        from har_tpu.serve import FakeClock
+
+        clock = FakeClock()
+        server = FleetServer(
+            _StubModel(), window=100, hop=50, smoothing="ema",
+            config=FleetConfig(
+                max_sessions=n, target_batch=4, max_delay_ms=0.0,
+                pipeline_depth=3,
+            ),
+            clock=clock,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, snaps = [], []
+        cursors = [0] * n
+        rng = np.random.default_rng(5)
+        rnd = 0
+        saw_two_inflight = False
+        while any(c < len(recs[i]) for i, c in enumerate(cursors)):
+            for i in range(n):
+                if cursors[i] >= len(recs[i]):
+                    continue
+                step = int(rng.integers(30, 90))
+                server.push(i, recs[i][cursors[i]: cursors[i] + step])
+                cursors[i] += step
+            if resize_at is not None and rnd == resize_at:
+                # two carried tickets fly at depth 3 between polls
+                saw_two_inflight = len(server._inflight) >= 2
+                server.resize(pipeline_depth=1, target_batch=4)
+            events.extend(server.poll())  # unforced: tickets carry
+            snaps.append(server.stats.accounting())
+            clock.advance(0.01)
+            rnd += 1
+        events.extend(server.flush())
+        snaps.append(server.stats.accounting())
+        return server, events, snaps, saw_two_inflight
+
+    sA, evA, snapsA, two = run(resize_at=6)
+    sB, evB, snapsB, _ = run(resize_at=None)
+    assert two, "harness: no two tickets were in flight at the resize"
+    assert all(s["balanced"] for s in snapsA + snapsB)
+    assert sA.stats.dropped_total == sB.stats.dropped_total == 0
+    dA, dB = _decisions(evA), _decisions(evB)
+    assert dA.keys() == dB.keys()
+    for sid in dA:
+        assert dA[sid] == dB[sid]
+    assert sA.config.pipeline_depth == 1
+    assert sA.stats.resizes == 1 and sA.stats.scale_downs == 1
+    final = sA.stats.accounting()
+    assert final["balanced"] and final["pending"] == 0
+
+
+def test_vote_smoother_survives_stale_wider_votes():
+    """Review fix pin: a vote deque can hold labels from before a swap
+    to a NARROWER model — the integer counting must mirror
+    np.bincount(minlength=C)'s auto-extension (stale vote still
+    counted, no IndexError in the retire loop)."""
+    sm = _Smoother("vote", 0.4, 5)
+    l1, r1, d1 = sm.step(np.asarray([0.1, 0.1, 0.1, 0.7]))
+    assert (l1, r1) == (3, 3)
+    # post-swap: 2-class probabilities, vote 3 still in the deque
+    l2, r2, d2 = sm.step(np.asarray([0.6, 0.4]))
+    assert r2 == 0
+    assert len(d2) == 4  # bincount-compatible width: stale label kept
+    np.testing.assert_allclose(d2, [0.5, 0.0, 0.0, 0.5])
+    assert l2 == 0  # tie breaks toward the newest label achieving max
+
+
+def test_fused_program_cache_dies_with_model():
+    """Review fix pin: the fused-program cache lives ON the inner model
+    (same lifetime as _predict), so a swapped-out incumbent takes its
+    compiled program with it — including models whose ``_predict``
+    closes over ``self`` (the NeuralModel pattern, which a weak-key
+    table value would pin alive forever)."""
+    import gc
+    import weakref
+
+    import jax
+    import jax.numpy as jnp
+
+    class _SelfRefModel:
+        # _predict closes over self, exactly like NeuralModel's lambda
+        num_classes = 3
+
+        def __init__(self):
+            self.params = {"w": jnp.ones((30, 3), jnp.float32)}
+            self._predict = jax.jit(
+                lambda p, x: x.reshape(x.shape[0], -1) @ self.params["w"]
+            )
+
+    model = _SelfRefModel()
+    scorer = make_scorer(model, None, window=10)
+    x = np.zeros((4, 10, 3), np.float32)
+    labels, top = scorer.fetch_fused(scorer.launch_fused(x), 4)
+    assert labels.shape == (4,) and top.shape == (4,)
+    assert getattr(model, "_har_fused_cache", None), (
+        "fused program not cached on the model"
+    )
+    # a second scorer over the same model reuses the cached program
+    scorer2 = make_scorer(model, None, window=10)
+    assert scorer2._fused_fn() is scorer._fused_fn()
+    ref = weakref.ref(model)
+    del model, scorer, scorer2
+    gc.collect()
+    assert ref() is None, "fused cache kept the swapped-out model alive"
+
+
+def test_program_count_covers_the_fused_jit():
+    """Review fix pin: a fused engine compiles its shapes on the fused
+    jit — program_count must count them (the compile-budget pin would
+    otherwise be blind for the fused tier)."""
+    model = JitDemoModel(window=10)
+    scorer = make_scorer(model, None, window=10)
+    base = scorer.program_count()
+    for k in (4, 8):
+        x = np.zeros((k, 10, 3), np.float32)
+        scorer.fetch_fused(scorer.launch_fused(x), k)
+    got = scorer.program_count()
+    assert got is not None and base is not None
+    assert got >= base + 2  # the two fused shapes joined the count

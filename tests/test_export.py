@@ -267,3 +267,40 @@ def test_evaluate_int8_artifact(raw_model, tmp_path):
     )
     assert rep["accuracy"] == pytest.approx(float(qlive["accuracy"]),
                                             abs=1e-9)
+
+
+def test_exported_artifact_serves_through_device_scorer(raw_model, tmp_path):
+    """PR-10 wiring: an exported StableHLO artifact routes through the
+    ASYNC dispatch plane (serving_inner → DeviceScorer), not the
+    synchronous HostScorer fallback — launch/fetch probabilities match
+    the artifact's own transform, and a fleet serving the artifact
+    emits the same labels as one serving the live model."""
+    from har_tpu.serve import FleetConfig, FleetServer
+    from har_tpu.serve.dispatch import DeviceScorer, make_scorer
+
+    model, raw = raw_model
+    path = export_model(model, str(tmp_path / "art"))
+    art = load_exported(path)
+    scorer = make_scorer(art, None)
+    assert isinstance(scorer, DeviceScorer)
+    assert scorer.supports_fused is False  # artifact call: not re-jittable
+    x = np.asarray(raw.windows[:8], np.float32)
+    got = scorer.fetch(scorer.launch(x), 8)
+    want = np.asarray(art.transform(x).probability[:8], np.float64)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    def serve(m):
+        server = FleetServer(
+            m, window=200, hop=200, smoothing="none",
+            config=FleetConfig(target_batch=8, max_delay_ms=0.0),
+        )
+        server.add_session(0)
+        server.push(0, x.reshape(-1, 3))
+        return server, server.flush()
+
+    s_art, ev_art = serve(art)
+    s_live, ev_live = serve(model)
+    assert s_art.scorer.kind == "device"
+    assert [e.event.label for e in ev_art] == [
+        e.event.label for e in ev_live
+    ]

@@ -949,6 +949,61 @@ def test_hl001_acceptance_real_sync_two_calls_below_launch():
     assert "reached from launch root" in findings[0].message
 
 
+def test_hl001_acceptance_planted_item_in_fused_body_fails_gate():
+    """The fused-hot-loop acceptance mutation (PR 10): the REAL fused
+    device program (the jit body built in DeviceScorer._fused_fn)
+    lints clean with ZERO suppressions of its own, and a planted
+    ``.item()`` inside the fused body produces an HL001 finding — a
+    host sync smuggled into the one-program hot loop fails the gate."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serving.py",
+        "har_tpu/utils/backoff.py",
+        "har_tpu/parallel/mesh.py",
+        "har_tpu/parallel/sharding.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(dict(sources), [HotPathRule()]) == []
+    # the fused body carries no suppression annotations at all
+    src = sources["har_tpu/serve/dispatch.py"]
+    body = src.split("def fused(params, x):")[1].split("donate = ")[0]
+    assert "harlint:" not in body, (
+        "the fused program must pass HL001/HL006 with zero suppressions"
+    )
+    anchor = (
+        "                labels = jnp.argmax(probs, axis=-1)"
+        ".astype(jnp.int32)\n"
+    )
+    assert anchor in src, "dispatch.py fused-body anchor changed"
+    mutated = src.replace(
+        anchor,
+        anchor + "                _peek = labels[0].item()\n",
+    )
+    sources["har_tpu/serve/dispatch.py"] = mutated
+    findings = lint_sources(sources, [HotPathRule()])
+    assert findings, "planted .item() in the fused body went unflagged"
+    assert any(
+        ".item()" in f.message and "fused" in f.symbol for f in findings
+    ), [(f.symbol, f.message) for f in findings]
+
+
+def test_hl006_real_fused_program_is_pure():
+    """The fused program is a jit root HL006 walks: the real source
+    must pass the purity rule with zero new suppressions (mutating
+    closed-over state inside it would be flagged)."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/dispatch.py",
+        "har_tpu/serving.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    from har_tpu.analyze.jitpurity import JitPurityRule
+
+    assert lint_sources(sources, [JitPurityRule()]) == []
+
+
 # --------------------------------------------------------------- HL006
 
 

@@ -168,3 +168,63 @@ def test_quantized_exported_outputs_match_live(trained, tmp_path):
         rtol=1e-5,
         atol=1e-5,
     )
+
+
+# ------------------------------------------------ serving tier (PR 10)
+
+
+def test_quantize_serving_wraps_any_jitted_model(trained):
+    """quantize_serving builds the DeviceScorer-compatible int8 tier
+    from a trained checkpoint model: int8 kernels as device params,
+    scaler preserved, transform labels agreeing with f32 on held-out
+    data, and the same shared _q8 arithmetic as quantize_model."""
+    from har_tpu.quantize import Int8ServingModel, quantize_serving
+
+    model, raw = trained
+    q = quantize_serving(model)
+    assert isinstance(q, Int8ServingModel)
+    assert q.scaler is model.scaler
+    assert q.num_classes == model.num_classes
+    rep = q.size_report()
+    assert rep["quantized_kernels"] >= 2
+    assert rep["ratio"] < 0.5
+    kinds = {s.value.dtype.kind for s in q.stored if s.kind == "q8"}
+    assert kinds == {"i"}
+    x = raw.windows[:128]
+    f32 = model.transform(x).probability.argmax(axis=-1)
+    int8 = q.transform(x).probability.argmax(axis=-1)
+    assert (f32 == int8).mean() >= 0.97
+    # _split_predict unwraps it like a NeuralClassifierModel chain
+    from har_tpu.serve.dispatch import _split_predict
+
+    pre, inner = _split_predict(q)
+    assert pre is model.scaler
+    assert inner is q.inner
+
+
+def test_quantize_serving_refuses_host_models():
+    from har_tpu.quantize import quantize_serving
+
+    class _Host:
+        def transform(self, x):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError):
+        quantize_serving(_Host())
+
+
+def test_quantize_serving_refuses_exported_artifacts(trained, tmp_path):
+    """Review fix pin: tier="int8" on an f32 StableHLO artifact must
+    refuse loudly (weights are baked into the serialized program —
+    there is nothing to quantize, and the exported call is not
+    re-traceable under a fresh jit), never mint a no-op int8 tier."""
+    from har_tpu.export import export_model, load_exported
+    from har_tpu.quantize import quantize_serving
+    from har_tpu.serve.dispatch import make_scorer
+
+    model, _ = trained
+    art = load_exported(export_model(model, str(tmp_path / "art")))
+    with pytest.raises(ValueError, match="nothing to quantize"):
+        quantize_serving(art)
+    with pytest.raises(ValueError, match="nothing to quantize"):
+        make_scorer(art, None, tier="int8")

@@ -311,11 +311,32 @@ def test_kill_point_matrix_holds_at_pipeline_depth_2(point):
     assert out["accounting"]["pending"] == 0
 
 
+@pytest.mark.parametrize("depth", [3, 4])
+@pytest.mark.parametrize(
+    "point", ["mid_launch", "pre_retire", "post_score_pre_ack",
+              "mid_resize"]
+)
+def test_kill_point_ticket_ring_depths_3_and_4(point, depth):
+    """The depth-N ticket ring's chaos pin: the ticket-centric stage
+    boundaries (several tickets genuinely in flight at the kill
+    instant at depth >= 3, plus the capacity boundary) recover
+    bit-identically at ring depths 3 and 4 — every in-flight ticket is
+    un-acked by construction no matter how deep the ring runs.  The
+    full matrix stays pinned at depths 1 and 2 above; the randomized
+    property test draws the remaining (point × depth) combinations."""
+    out = run_kill_point(point, sessions=6, seed=4, pipeline_depth=depth)
+    assert out["ok"], out
+    assert out["windows_lost"] == 0
+    assert out["accounting"]["balanced"]
+    assert out["accounting"]["pending"] == 0
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_randomized_kill_point_property(seed):
     """Seed-randomized draw over (kill point, occurrence, flush
-    batching, snapshot cadence, pipeline depth, fleet size): the
-    recovery contract is a property, not a fixture."""
+    batching, snapshot cadence, pipeline depth — the full {1, 2, 3, 4}
+    ticket ring, fleet size): the recovery contract is a property, not
+    a fixture."""
     out = run_random_kill(seed)
     assert out["ok"], out
     assert out["windows_lost"] == 0

@@ -62,10 +62,13 @@ def test_gate_log_carries_fleet_slo_verdict():
 
 def test_gate_log_carries_fleet_pipeline_verdict():
     """The pipelined-dispatch counterpart of the fleet verdict: the
-    gate log must carry a green depth-1-vs-depth-2 pipeline check with
-    the {overlap_pct, devices, p99_ms} keys it stamps — the same load
-    once synchronous, once pipelined over the dry-run mesh, decision
-    streams identical, overlap measured."""
+    gate log must carry a green fused hot-path pipeline check with the
+    {depth, fused, fetch_bytes_per_window, overlap_pct} stamp (plus
+    devices/p99_ms) — the same load once synchronous, once through the
+    depth-3 ticket ring over the dry-run mesh with the fused device
+    program, decision streams identical, overlap measured, and the
+    fetch-byte evidence that retire moved (labels, top_probs) instead
+    of the full logits matrix."""
     log = json.loads(
         (REPO / "artifacts" / "test_gate.json").read_text()
     )
@@ -74,14 +77,22 @@ def test_gate_log_carries_fleet_pipeline_verdict():
         "artifacts/test_gate.json lacks the fleet_pipeline verdict — "
         "run scripts/release_gate.py"
     )
-    for key in ("overlap_pct", "devices", "p99_ms"):
+    for key in (
+        "depth", "fused", "fetch_bytes_per_window", "overlap_pct",
+        "devices", "p99_ms",
+    ):
         assert key in pipe
     assert pipe["ok"] is True
     assert pipe["equivalent"] is True
     assert pipe["dropped"] == 0
     assert pipe["overlap_pct"] is not None
     assert pipe["devices"] >= 1
-    assert pipe["pipeline_depth"] >= 2
+    assert pipe["pipeline_depth"] >= 3
+    assert pipe["depth"] == pipe["pipeline_depth"]
+    assert pipe["fused"] is True
+    assert pipe["fused_dispatches"] > 0
+    assert pipe["fetch_bytes_saved"] > 0
+    assert pipe["fetch_bytes_per_window"] is not None
 
 
 def test_gate_log_carries_adapt_smoke_verdict():
@@ -153,7 +164,7 @@ def test_gate_log_carries_harlint_verdict():
     }
     assert set(h["per_rule"]) == set(h["rules_run"])
     assert all(v == 0 for v in h["per_rule"].values())
-    assert 0 < h["lint_ms"] <= h["budget_ms"] == 5000
+    assert 0 < h["lint_ms"] <= h["budget_ms"] == 8000
 
 
 def test_gate_log_carries_cluster_failover_verdict():

@@ -388,6 +388,36 @@ def test_controller_cooldown_blocks_thrash():
     assert server.config.target_batch == 64
 
 
+def test_controller_default_ladder_walks_depth_ring_to_3():
+    """The depth-N ticket ring joined the default ladder (PR 10):
+    with no explicit config the controller walks target_batch to the
+    cap, then pipeline depth 1→2→3 (the ring rung double-buffering
+    never had), and retraces 3→2→1 on the way down."""
+    server = _server(target_batch=256)
+    controller = CapacityController(
+        server,
+        config=AutoscaleConfig(
+            min_target_batch=256, max_target_batch=256,
+            up_after=1, down_after=1, cooldown_s=0.0,
+        ),
+        clock=lambda: 0.0,
+    )
+    assert controller.config.max_depth == 3  # the new default rung
+    server.stats.queue_depth = 10_000_000
+    ups = [controller.step() for _ in range(3)]
+    assert [(a or {}).get("knob") for a in ups] == [
+        "pipeline_depth", "pipeline_depth", None,
+    ]
+    assert server.config.pipeline_depth == 3
+    server.stats.queue_depth = 0
+    server.stats.utilization = 0.05
+    downs = [controller.step() for _ in range(3)]
+    assert [(a or {}).get("knob") for a in downs] == [
+        "pipeline_depth", "pipeline_depth", None,
+    ]
+    assert server.config.pipeline_depth == 1
+
+
 def test_controller_ladder_up_then_down_retraces():
     """The capacity ladder: target_batch ×2 to the cap, then pipeline
     depth, then nothing (single-rung mesh ladder) — and scale-down
