@@ -311,6 +311,14 @@ def _parser() -> argparse.ArgumentParser:
                     help="model-registry root for --adapt (versioned "
                          "lineage + promotions log); default is a "
                          "temp dir discarded after the run")
+    sv.add_argument("--profile-host", action="store_true",
+                    help="per-poll host-time breakdown (ingest / "
+                         "due-select / gather / retire / journal stage "
+                         "histograms, har_tpu.serve.stats.HostProfile) "
+                         "stamped into the summary JSON — the "
+                         "observability hook the sessions-per-worker "
+                         "ceiling curve and host-plane regression "
+                         "checks read")
     sv.add_argument("--calibrate-device", action="store_true",
                     help="measure device p50 per dispatched batch "
                          "shape (checkpoint models only) so the stats "
@@ -913,14 +921,17 @@ def main(argv=None) -> int:
                 hop=args.hop,
                 smoothing=args.smoothing,
                 class_names=class_names,
-                config=FleetConfig(
-                    max_sessions=max(2 * args.sessions, 64),
+                config=FleetConfig.for_sessions(
+                    # churn can hold leavers through their settle while
+                    # arrivals admit: headroom over the peak
+                    max(2 * args.sessions, 64),
                     target_batch=initial_tb,
                     max_delay_ms=args.max_delay_ms,
                     pipeline_depth=(
                         1 if args.autoscale else args.pipeline_depth
                     ),
                     fused=args.fused,
+                    profile_host=args.profile_host,
                 ),
                 fault_hook=fault_hook,
                 journal=args.journal,
@@ -1008,6 +1019,7 @@ def main(argv=None) -> int:
                         "pipeline_depth_final": (
                             server.config.pipeline_depth
                         ),
+                        "host_profile": snap.get("host_profile"),
                         "journal": args.journal,
                     }
                 )
@@ -1091,12 +1103,13 @@ def main(argv=None) -> int:
                 channels=channels,
                 smoothing=args.smoothing,
                 class_names=class_names,
-                fleet_config=FleetConfig(
-                    max_sessions=args.sessions,
+                fleet_config=FleetConfig.for_sessions(
+                    args.sessions,
                     target_batch=args.target_batch,
                     max_delay_ms=args.max_delay_ms,
                     pipeline_depth=args.pipeline_depth,
                     fused=args.fused,
+                    profile_host=args.profile_host,
                 ),
                 config=ClusterConfig(
                     lease_s=0.5, probe_base_ms=20.0, probe_cap_ms=200.0
@@ -1228,12 +1241,13 @@ def main(argv=None) -> int:
                 hop=args.hop,
                 smoothing=args.smoothing,
                 class_names=class_names,
-                config=FleetConfig(
-                    max_sessions=args.sessions,
+                config=FleetConfig.for_sessions(
+                    args.sessions,
                     target_batch=args.target_batch,
                     max_delay_ms=args.max_delay_ms,
                     pipeline_depth=args.pipeline_depth,
                     fused=args.fused,
+                    profile_host=args.profile_host,
                 ),
                 fault_hook=fault_hook,
                 journal=args.journal,
@@ -1385,6 +1399,11 @@ def main(argv=None) -> int:
                         "resumed": bool(args.resume),
                         "recoveries": snap["recoveries"],
                         "lost_in_crash": acct["lost_in_crash"],
+                        # per-poll host-time breakdown (--profile-host:
+                        # ingest / due-select / gather / retire /
+                        # journal stage histograms) — the host-plane
+                        # observability hook the ceiling curve reads
+                        "host_profile": snap.get("host_profile"),
                         "load": dataclasses.asdict(report),
                         "stats": snap,
                     }

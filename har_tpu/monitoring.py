@@ -191,6 +191,84 @@ class DriftMonitor:
         m._generation = int(state.get("generation", 0))
         return m
 
+    @staticmethod
+    def update_many(monitors, block) -> list["DriftReport | None"]:
+        """Batched EWMA step: one ``(m, n, C)`` block of same-length
+        chunks, one monitor per row — the fleet engine's SoA ingest
+        path (``FleetServer.push_many``) updates a whole delivery
+        round's monitors in five vectorized reductions instead of m
+        Python ``update`` calls.
+
+        Bit-identity by construction: every recurrence below is the
+        elementwise float64 expression ``update`` evaluates per
+        monitor (same ``keep`` power, same total-variance identity,
+        same verdict thresholds), just broadcast over the row axis —
+        so a monitored session's drift verdicts are identical whether
+        its chunk rode the batched path or the sequential one
+        (test-pinned).  Rows whose monitor is None get None back;
+        monitors must share ``halflife`` only per distinct chunk
+        length (``keep`` is scalar per call because the block rows are
+        equal length; heterogeneous halflives are gathered per row).
+        """
+        idx = [i for i, mon in enumerate(monitors) if mon is not None]
+        out: list[DriftReport | None] = [None] * len(monitors)
+        if not idx:
+            return out
+        mons = [monitors[i] for i in idx]
+        x = np.asarray(block, np.float64)[idx]
+        n = x.shape[1]
+        # math.pow per row, not np.power: ``update`` computes keep with
+        # the C-library pow, and the two can differ in the last ulp —
+        # the batched step must be BIT-identical to the sequential one
+        # (journal replay re-runs updates sequentially; an ulp of EWMA
+        # drift there could flip a borderline verdict post-recovery)
+        keep = np.asarray(
+            [math.pow(0.5, n / m.halflife) for m in mons], np.float64
+        )[:, None]
+        cm = x.mean(axis=1)
+        cv = x.var(axis=1)
+        mean = np.stack([m._mean for m in mons])
+        var = np.stack([m._var for m in mons])
+        var = keep * (var + (mean - cm) ** 2 * (1 - keep)) + (
+            1 - keep
+        ) * cv
+        mean = keep * mean + (1 - keep) * cm
+        ref_mean = np.stack([m.ref_mean for m in mons])
+        ref_std = np.stack([m.ref_std for m in mons])
+        z = np.abs(mean - ref_mean) / ref_std
+        ratio = np.log(np.sqrt(np.maximum(var, 1e-12)) / ref_std)
+        over_rows = (
+            (z > np.asarray([m.z_threshold for m in mons])[:, None]).any(
+                axis=1
+            )
+            | (
+                np.abs(ratio)
+                > np.asarray([m.scale_threshold for m in mons])[:, None]
+            ).any(axis=1)
+        )
+        for j, mon in enumerate(mons):
+            mon._mean = mean[j]
+            mon._var = var[j]
+            mon._n += n
+            over = bool(over_rows[j])
+            mon._over = mon._over + 1 if over else 0
+            if mon._over >= mon.patience:
+                if not mon._drifting:
+                    mon._onset = mon._n
+                mon._drifting = True
+            elif not over:
+                mon._drifting = False
+                mon._onset = None
+            out[idx[j]] = DriftReport(
+                drifting=mon._drifting,
+                location_z=z[j],
+                scale_log_ratio=ratio[j],
+                n_samples=mon._n,
+                onset=mon._onset,
+                generation=mon._generation,
+            )
+        return out
+
     def update(self, samples) -> DriftReport:
         """Absorb ``(n, C)`` samples; return the current verdict."""
         x = np.atleast_2d(np.asarray(samples, np.float64))

@@ -3,7 +3,12 @@
 
 Public surface:
   FleetServer / FleetConfig / FleetEvent  — the engine (engine.py)
-  FleetStats                              — observability (stats.py)
+  FleetStats / HostProfile                — observability (stats.py)
+  SessionArena                            — structure-of-arrays session
+                                            estate (arena.py): rings,
+                                            heads/fills, smoother state
+                                            and counters as contiguous
+                                            slot-indexed arrays
   DispatchTicket / StagingArena / make_scorer — pipelined dispatch
                                             plane (dispatch.py)
   DispatchFaults / DeliveryFaults / FakeClock — fault injection
@@ -40,6 +45,7 @@ from har_tpu.serve.chaos import (
     run_kill_point,
     run_random_kill,
 )
+from har_tpu.serve.arena import SessionArena
 from har_tpu.serve.dispatch import (
     DispatchTicket,
     StagingArena,
@@ -65,9 +71,12 @@ from har_tpu.serve.journal import (
 )
 from har_tpu.serve.loadgen import (
     AnalyticDemoModel,
+    HostPlaneStubModel,
     JitDemoModel,
     LoadReport,
     drive_fleet,
+    host_plane_benchmark,
+    host_plane_summary,
     synthetic_sessions,
 )
 from har_tpu.serve.recover import (
@@ -80,7 +89,7 @@ from har_tpu.serve.slo import (
     fleet_pipeline_smoke,
     fleet_slo_smoke,
 )
-from har_tpu.serve.stats import FleetStats, StageHistogram
+from har_tpu.serve.stats import FleetStats, HostProfile, StageHistogram
 from har_tpu.serve.traffic import (
     AutoscaleConfig,
     CapacityController,
@@ -113,6 +122,8 @@ __all__ = [
     "FleetJournal",
     "FleetServer",
     "FleetStats",
+    "HostPlaneStubModel",
+    "HostProfile",
     "InjectedDispatchFailure",
     "JitDemoModel",
     "JournalConfig",
@@ -121,10 +132,13 @@ __all__ = [
     "KillPlan",
     "LoadReport",
     "RecoveryError",
+    "SessionArena",
     "SimulatedCrash",
     "StageHistogram",
     "StagingArena",
     "drive_fleet",
+    "host_plane_benchmark",
+    "host_plane_summary",
     "drive_trace",
     "events_equal",
     "fleet_pipeline_smoke",

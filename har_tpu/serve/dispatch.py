@@ -149,9 +149,15 @@ class StagingArena:
     def in_use(self) -> int:
         return len(self._buf) - len(self._free)
 
-    def _grow(self) -> None:
+    def _grow(self, need: int = 0) -> None:
+        """Double the block — or jump straight past ``need`` total
+        slots in ONE reallocation: a 10k-session delivery round staging
+        its whole window cohort must not pay log2 copies of the buffer
+        on the way up (the SoA host plane's arena-sizing contract)."""
         cap = len(self._buf)
         new_cap = cap * 2
+        while new_cap < need:
+            new_cap *= 2
         buf = np.empty((new_cap, self.window, self.channels), np.float32)
         buf[:cap] = self._buf
         self._buf = buf
@@ -168,12 +174,32 @@ class StagingArena:
 
     def put_block(self, windows: np.ndarray) -> list[int]:
         """Stage a ``(m, window, channels)`` block in one vectorized
-        copy (the assembler's catch-up-burst path); returns the slots."""
+        copy (the assembler's catch-up-burst path and the batched
+        ``push_many`` round staging); returns the slots."""
         m = len(windows)
-        while len(self._free) < m:
-            self._grow()
+        if len(self._free) < m:
+            self._grow(self.in_use + m)
         slots = [self._free.pop() for _ in range(m)]
         self._buf[slots] = windows
+        return slots
+
+    def put_block_pair(
+        self, head: np.ndarray, tail: np.ndarray
+    ) -> list[int]:
+        """Stage a block of windows whose rows are each split in two
+        contiguous parts — ``head[i] ++ tail[i]`` — writing BOTH parts
+        straight into the staging storage (no intermediate
+        concatenation).  The batched ingest path's mid-chunk window
+        snapshots arrive exactly like this: the ring tail up to the
+        boundary plus the chunk head that completes the window."""
+        m = len(head)
+        if len(self._free) < m:
+            self._grow(self.in_use + m)
+        slots = [self._free.pop() for _ in range(m)]
+        split = head.shape[1]
+        if split:
+            self._buf[slots, :split] = head
+        self._buf[slots, split:] = tail
         return slots
 
     def free(self, slot: int) -> None:

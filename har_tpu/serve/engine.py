@@ -55,6 +55,11 @@ from typing import Callable, Hashable, Sequence
 
 import numpy as np
 
+from har_tpu.serve.arena import (
+    SessionArena,
+    _ArenaAssembler,
+    _SlotSmoother,
+)
 from har_tpu.serve.dispatch import (
     DispatchTicket,
     HostScorer,
@@ -62,18 +67,17 @@ from har_tpu.serve.dispatch import (
     compact_probs,
     make_scorer,
 )
+from har_tpu.monitoring import DriftMonitor
 from har_tpu.serve.journal import (
     FleetJournal,
     JournalConfig,
     monitor_from_state,
     monitor_state,
 )
-from har_tpu.serve.stats import FleetStats
+from har_tpu.serve.stats import FleetStats, HostProfile
 from har_tpu.utils.backoff import Backoff, retry_call
 from har_tpu.serving import (
     StreamEvent,
-    _Smoother,
-    _WindowAssembler,
     finite_rows,
     measure_device_latency,
 )
@@ -167,6 +171,25 @@ class FleetConfig:
     # (test-pinned at N=64 under FakeClock+DispatchFaults), which is
     # why it is opt-in rather than the default.
     fused: bool = False
+    # per-poll host-time breakdown (ingest / due-select / gather /
+    # retire / journal) recorded into stage histograms and stamped
+    # into ``stats_snapshot()["host_profile"]`` — the observability
+    # hook the sessions-per-worker ceiling curve and future host-plane
+    # regressions read (``har serve --profile-host``).  Off by default:
+    # the clock reads it adds are per dispatch/poll, cheap but not
+    # free, and the profile measures THIS process (never journaled).
+    profile_host: bool = False
+
+    @classmethod
+    def for_sessions(cls, n_sessions: int, **overrides) -> "FleetConfig":
+        """A config sized for ``n_sessions`` concurrent sessions:
+        ``max_sessions`` auto-raises to at least that many unless an
+        explicit override says otherwise — the CLI path (`har serve
+        --sessions N`) builds its config here, so a 10k-session run no
+        longer dies at admission against the 4096 default (test-pinned;
+        an explicit ``max_sessions=`` override still wins)."""
+        overrides.setdefault("max_sessions", max(int(n_sessions), 1))
+        return cls(**overrides)
 
     def __post_init__(self):
         if self.max_sessions <= 0 or self.target_batch <= 0:
@@ -219,35 +242,59 @@ class _Pending:
         self.launched = False
 
 
+def _arena_counter(name: str, doc: str):
+    """A _FleetSession counter living in the session arena's int
+    arrays: attribute reads/writes go through the slot, so the
+    sequential code paths keep their ``sess.n_scored += 1`` shape
+    while the batched ingest/retire paths update whole delivery
+    rounds with one scatter-add."""
+
+    def fget(self):
+        return int(getattr(self.arena, name)[self.slot])
+
+    def fset(self, value):
+        getattr(self.arena, name)[self.slot] = value
+
+    return property(fget, fset, doc=doc)
+
+
 class _FleetSession:
-    """Per-session state: ring buffer + smoother + bounded queue."""
+    """Per-session handle: slot into the SoA arena + façades + queue.
 
-    __slots__ = ("sid", "asm", "smoother", "pending", "n_live",
-                 "n_enqueued", "n_scored", "n_dropped", "raw_seen",
-                 "handoffs")
+    The heavy per-session state (ring, smoother arrays, counters) lives
+    in the server's ``SessionArena``; this object carries the slot, the
+    shared-code façades (``asm``/``smoother``) and the per-session view
+    of the pending queue.  The counter properties read through to the
+    arena so every pre-SoA code path (sheds, replay, export, cluster
+    hand-off) works unchanged."""
 
-    def __init__(self, sid, asm, smoother):
+    __slots__ = ("sid", "asm", "smoother", "pending", "arena", "slot")
+
+    def __init__(self, sid, asm, smoother, arena, slot):
         self.sid = sid
         self.asm = asm
         self.smoother = smoother
+        self.arena = arena
+        self.slot = slot
         # shares _Pending objects with the server's global FIFO; drops
         # flag in place, scoring pops from the left
         self.pending: deque[_Pending] = deque()
-        self.n_live = 0
-        self.n_enqueued = 0
-        self.n_scored = 0
-        self.n_dropped = 0
-        # samples delivered by the transport INCLUDING rows the ingest
-        # guard rejected — the watermark must speak the transport's raw
-        # stream coordinates, or one rejected NaN row would shift every
-        # post-crash re-delivery by one sample
-        self.raw_seen = 0
-        # cluster hand-off generation: bumped every time this session is
-        # ADOPTED onto a worker (har_tpu.serve.cluster).  A crash mid-
-        # hand-off can leave the session on both the source and the
-        # target journal; the copy with the higher generation is the
-        # adopted one and wins the dual-ownership resolution.
-        self.handoffs = 0
+
+    n_live = _arena_counter("n_live", "live (queued or in-flight) windows")
+    n_enqueued = _arena_counter("n_enqueued", "windows enqueued")
+    n_scored = _arena_counter("n_scored", "windows scored")
+    n_dropped = _arena_counter("n_dropped", "windows dropped")
+    # samples delivered by the transport INCLUDING rows the ingest
+    # guard rejected — the watermark must speak the transport's raw
+    # stream coordinates, or one rejected NaN row would shift every
+    # post-crash re-delivery by one sample
+    raw_seen = _arena_counter("raw_seen", "raw transport watermark")
+    # cluster hand-off generation: bumped every time this session is
+    # ADOPTED onto a worker (har_tpu.serve.cluster).  A crash mid-
+    # hand-off can leave the session on both the source and the
+    # target journal; the copy with the higher generation is the
+    # adopted one and wins the dual-ownership resolution.
+    handoffs = _arena_counter("handoffs", "cluster hand-off generation")
 
 
 class FleetServer:
@@ -309,6 +356,23 @@ class FleetServer:
         self._fault_hook = fault_hook
         self._clock = clock or time.monotonic
         self._sessions: dict[Hashable, _FleetSession] = {}
+        # the structure-of-arrays session estate (har_tpu.serve.arena):
+        # ring buffers, ring heads/fills, smoother state and per-session
+        # counters live in ONE contiguous arena; a session is a slot
+        # index, admission allocates and removal/hand-off recycles.
+        # Sized small and grown geometrically: a 64-session fleet must
+        # not pay a max_sessions-sized allocation up front.
+        self._session_arena = SessionArena(
+            self.window, self.channels, self.vote_depth,
+            capacity=min(self.config.max_sessions, 1024),
+        )
+        self._ema_kernel = self._session_arena.ema_block_for(
+            self.ema_alpha
+        )
+        # per-poll host-time breakdown (FleetConfig.profile_host)
+        self.host_profile = (
+            HostProfile() if self.config.profile_host else None
+        )
         self._queue: deque[_Pending] = deque()  # global FIFO
         self._n_live = 0
         # live windows still IN the queue (not yet launched on-device):
@@ -384,6 +448,12 @@ class FleetServer:
         # ``pending`` array (format unchanged — pre-arena journals
         # restore cleanly, test-pinned)
         self.snapshot_providers["staging_arena"] = self._arena.state
+        # SoA estate sizing (observability only: per-session state
+        # serializes back to the per-session snapshot layout, so the
+        # on-disk format predates — and outlives — the arena)
+        self.snapshot_providers["session_arena"] = (
+            self._session_arena.state
+        )
         if journal is not None:
             self.attach_journal(journal, journal_config)
 
@@ -615,6 +685,34 @@ class FleetServer:
 
     # ------------------------------------------------------- sessions
 
+    def _new_session(self, session_id: Hashable, monitor) -> _FleetSession:
+        """Allocate an arena slot and build the session handle with its
+        shared-code façades (har_tpu.serve.arena) — the one constructor
+        behind admission and cluster adoption, so slot recycling cannot
+        diverge between the two."""
+        arena = self._session_arena
+        before = arena.grows
+        slot = arena.alloc()
+        if arena.grows != before:
+            # growth reallocated the ring block: re-point every live
+            # assembler's ring view at the new storage (rare, amortized
+            # — the scalars read through properties and need no fix-up)
+            for s in self._sessions.values():
+                s.asm._ring = arena.rings[s.slot]
+        return _FleetSession(
+            session_id,
+            _ArenaAssembler(
+                arena, slot, self.window, self.hop, self.channels,
+                monitor=monitor,
+            ),
+            _SlotSmoother(
+                arena, slot, self.smoothing, self.ema_alpha,
+                self.vote_depth,
+            ),
+            arena,
+            slot,
+        )
+
     def add_session(self, session_id: Hashable, *, monitor=None) -> None:
         """Admit a session (optionally with its own DriftMonitor, whose
         verdicts then flow into the multiplexed event stream).  Raises
@@ -627,12 +725,8 @@ class FleetServer:
                 f"fleet full ({self.config.max_sessions} sessions); "
                 "remove a session or raise FleetConfig.max_sessions"
             )
-        self._sessions[session_id] = _FleetSession(
-            session_id,
-            _WindowAssembler(
-                self.window, self.hop, self.channels, monitor=monitor
-            ),
-            _Smoother(self.smoothing, self.ema_alpha, self.vote_depth),
+        self._sessions[session_id] = self._new_session(
+            session_id, monitor
         )
         self.stats.sessions = len(self._sessions)
         # the add record carries the monitor's full state so a session
@@ -670,6 +764,12 @@ class FleetServer:
         # replay re-derives the dropped windows from the same queue
         # state, so the record carries only the eviction itself
         self._jappend({"t": "remove", "sid": session_id})
+        # recycle the arena slot (scrubbed at the next alloc).  Safe
+        # while flagged windows of this session still ride an in-flight
+        # ticket: every retire/shed path skips dropped entries before
+        # touching session state, so a recycled slot is never read
+        # through a dead session's handle.
+        self._session_arena.release(sess.slot)
 
     def disconnect_session(self, session_id: Hashable) -> list[FleetEvent]:
         """Graceful disconnect — the load plane's churn counterpart of
@@ -811,9 +911,12 @@ class FleetServer:
             "n_dropped": sess.n_dropped,
             "handoffs": sess.handoffs,
             "votes": list(sm._votes),
+            # np.array, not asarray: the smoother's EMA is a VIEW into
+            # the session arena, and the hand-off recycles this slot —
+            # the export must own its bytes (asarray would alias)
             "ema": (
                 None if sm._ema is None
-                else np.asarray(sm._ema, np.float64)
+                else np.array(sm._ema, np.float64)
             ),
             "monitor": monitor_state(sess.asm.monitor),
         }
@@ -839,15 +942,11 @@ class FleetServer:
                 "cannot adopt — raise FleetConfig.max_sessions"
             )
         monitor = monitor_from_state(export.get("monitor"))
-        sess = _FleetSession(
-            sid,
-            _WindowAssembler(
-                self.window, self.hop, self.channels, monitor=monitor
-            ),
-            _Smoother(self.smoothing, self.ema_alpha, self.vote_depth),
-        )
+        sess = self._new_session(sid, monitor)
         ring = np.asarray(export["ring"], np.float32)
         if ring.shape != sess.asm._ring.shape:
+            # refused adoption must not leak the freshly claimed slot
+            self._session_arena.release(sess.slot)
             raise ValueError(
                 f"exported ring shape {ring.shape} does not match this "
                 f"fleet's geometry {sess.asm._ring.shape} — sessions "
@@ -916,6 +1015,7 @@ class FleetServer:
                 "window(s)"
             )
         del self._sessions[session_id]
+        self._session_arena.release(sess.slot)
         self.stats.sessions = len(self._sessions)
 
     @property
@@ -1025,7 +1125,349 @@ class FleetServer:
             self._shed_stalest(overflow, "backpressure")
         self.stats.note_queue_depth(self._n_live)
         self._chaos("post_enqueue")
+        if self.host_profile is not None:
+            self.host_profile.ingest.record((self._clock() - now) * 1e3)
         return len(completed)
+
+    def push_many(self, session_ids, chunks) -> int:
+        """Batched ingest for one delivery round: semantically
+        ``for sid, c in zip(ids, chunks): push(sid, c)``, but the
+        common steady-state shape — clean same-length chunks crossing
+        at most ONE emission boundary, wherever in the chunk it falls
+        — runs as a handful of vectorized operations over the session
+        arena instead of thousands of per-session Python statements:
+        ONE ingest-guard reduction over the stacked round, batched
+        drift-monitor EWMA steps (``DriftMonitor.update_many``, split
+        at the boundary exactly like the sequential consume), ONE
+        ring-roll scatter per chunk length, and ONE two-part
+        staging-block write per boundary-offset subgroup for the
+        completed windows.  Rows that don't fit the shape (multi-window
+        catch-up bursts, non-finite samples, non-f32 arrays) fall
+        back to ``push`` row by row; wrong-channel chunks raise
+        BEFORE any state advances (push's validate-first rule,
+        round-wide — a mid-round raise must never strand half an
+        ingested round), and
+        journaled fleets always take the sequential path (the journal
+        record/chaos cadence is per push by contract) — so the batched
+        path changes WHERE the work happens, never what any session's
+        stream sees.  Per-session state transitions are identical by
+        construction (same ring bytes, same boundary arithmetic, same
+        monitor recurrence — test-pinned bit-identical at N=64);
+        cross-session queue order follows delivery order exactly
+        (windows enqueue in the ``session_ids`` order either way).
+        Returns the number of windows enqueued."""
+        ids = list(session_ids)
+        chunks = list(chunks)
+        if len(ids) != len(chunks):
+            raise ValueError("session_ids and chunks length mismatch")
+        if (
+            self._journal is not None
+            or self._replaying
+            or len(set(ids)) != len(ids)
+        ):
+            return sum(self.push(s, c) for s, c in zip(ids, chunks))
+        now = self._clock()
+        cfg = self.config
+        arena = self._session_arena
+        sessions = []
+        for sid in ids:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise AdmissionError(
+                    f"unknown session {sid!r}; add_session first"
+                )
+            sessions.append(sess)
+        # group the fast-eligible rows by chunk length; everything else
+        # replays through the sequential push in delivery order.
+        # Malformed chunks are validated HERE, before ANY arena
+        # mutation: a ValueError mid-round after fast rows had already
+        # rolled rings and staged windows would strand the fleet in a
+        # state no sequence of pushes can produce (push's own
+        # "validate BEFORE advancing" rule, applied round-wide).
+        groups: dict[int, list[int]] = {}
+        slow = set()
+        for j, c in enumerate(chunks):
+            if (
+                isinstance(c, np.ndarray)
+                and c.ndim == 2
+                and c.dtype == np.float32
+            ):
+                if c.shape[1] != self.channels:
+                    raise ValueError(
+                        f"expected (n, {self.channels}) samples, got "
+                        f"{c.shape}"
+                    )
+                if len(c):
+                    groups.setdefault(len(c), []).append(j)
+                else:
+                    slow.add(j)
+            else:
+                c = np.atleast_2d(np.asarray(c, np.float32))
+                if c.shape[-1] != self.channels:
+                    raise ValueError(
+                        f"expected (n, {self.channels}) samples, got "
+                        f"{c.shape}"
+                    )
+                chunks[j] = c  # normalized once; push re-checks cheaply
+                slow.add(j)
+        emitted_t: dict[int, int] = {}
+        emitted_tok: dict[int, object] = {}
+        emitted_drift: dict[int, bool] = {}
+        max_abs = cfg.max_abs_sample
+        for n, rows in groups.items():
+            block = np.stack([chunks[j] for j in rows])
+            # the whole group's ingest guard, fastest case first: ONE
+            # scalar reduction clears the all-clean round (finite_rows'
+            # chunk-level stance, applied to the whole fleet's round);
+            # only a misbehaving round pays the per-row maxima, and a
+            # row whose abs-max misbehaves (NaN/Inf compare False)
+            # re-runs through push, which applies the per-row guard
+            ab = np.abs(block)  # one pass; reused by the dirty branch
+            group_max = float(ab.max())
+            if (
+                group_max <= max_abs
+                if max_abs is not None
+                else np.isfinite(group_max)
+            ):
+                clean = None  # every row clean
+            else:
+                rowmax = ab.max(axis=(1, 2))
+                clean = (
+                    rowmax <= max_abs
+                    if max_abs is not None
+                    else np.isfinite(rowmax)
+                )
+            del ab
+            slots = np.fromiter(
+                (sessions[j].slot for j in rows), np.intp, len(rows)
+            )
+            # boundary arithmetic, vectorized: gap = samples until the
+            # next emission boundary.  Fast rows cross at most ONE
+            # boundary inside the chunk (``gap > n - hop`` — the
+            # following boundary lands past the end), wherever in the
+            # chunk it falls: real transports deliver at arbitrary
+            # phase, so the mid-chunk completion is the steady state,
+            # not the exception.  Multi-window chunks (catch-up
+            # bursts) replay through the sequential split loop.
+            gap = arena.next_emit[slots] - arena.n_seen[slots]
+            fast = (
+                gap > n - self.hop
+                if clean is None
+                else clean & (gap > n - self.hop)
+            )
+            if not fast.all():
+                for j in np.asarray(rows)[~fast]:
+                    slow.add(int(j))
+                rows = [j for j, f in zip(rows, fast) if f]
+                if not rows:
+                    continue
+                block = block[fast]
+                slots = slots[fast]
+                gap = gap[fast]
+            rows_arr = np.asarray(rows)
+            w = self.window
+            em_idx = np.flatnonzero(gap <= n)
+            no_em = (
+                rows
+                if not len(em_idx)
+                else rows_arr[gap > n].tolist()
+            )
+            # batched drift observers for rows that complete nothing:
+            # one whole-chunk EWMA step, exactly the chunk the
+            # sequential consume would have fed (emitting rows split
+            # their update at the boundary — handled per subgroup
+            # below, same cadence as the sequential path)
+            monitors = [sessions[j].asm.monitor for j in no_em]
+            if any(mon is not None for mon in monitors):
+                reports = DriftMonitor.update_many(
+                    monitors, block if not len(em_idx) else
+                    block[gap > n]
+                )
+                for j, rep in zip(no_em, reports):
+                    if rep is not None:
+                        sessions[j].asm.drift_report = rep
+            # emitting rows, subgrouped by the boundary offset k: every
+            # subgroup's window snapshots build in ONE two-part staging
+            # write — ``ring[k:] ++ chunk[:k]``, the last `window`
+            # samples at the boundary, identical bytes to the
+            # sequential ring roll's snapshot by construction
+            if len(em_idx):
+                ks = gap[em_idx]
+                order = np.argsort(ks, kind="stable")
+                em_sorted = em_idx[order]
+                ks_sorted = ks[order]
+                uniq, starts = np.unique(ks_sorted, return_index=True)
+                bounds = list(starts) + [len(em_sorted)]
+                single_k = len(uniq) == 1
+                for u, (a, b) in zip(uniq, zip(bounds, bounds[1:])):
+                    k = int(u)
+                    sub = em_sorted[a:b]
+                    sub_rows = rows_arr[sub].tolist()
+                    sub_slots = slots[sub]
+                    sub_mons = [
+                        sessions[j].asm.monitor for j in sub_rows
+                    ]
+                    monitored = any(
+                        mon is not None for mon in sub_mons
+                    )
+                    if monitored:
+                        # first sub-chunk, up to the boundary — the
+                        # report the emitted window's drift flag reads
+                        reports = DriftMonitor.update_many(
+                            sub_mons, block[sub, :k]
+                        )
+                        for j, rep in zip(sub_rows, reports):
+                            if rep is not None:
+                                sessions[j].asm.drift_report = rep
+                    # capture the emitted windows' drift flags NOW —
+                    # exactly the sequential cadence, where the emit
+                    # happens between the head and tail monitor
+                    # updates; reading after the tail update would
+                    # hand the window the NEXT sub-chunk's verdict
+                    sub_flags = []
+                    for j in sub_rows:
+                        rep = sessions[j].asm.drift_report
+                        sub_flags.append(
+                            rep is not None and bool(rep.drifting)
+                        )
+                    toks = self._arena.put_block_pair(
+                        arena.rings[sub_slots, k:], block[sub, :k]
+                    )
+                    t_idx = arena.next_emit[sub_slots].tolist()
+                    arena.next_emit[sub_slots] += self.hop
+                    arena.n_enqueued[sub_slots] += 1
+                    arena.n_live[sub_slots] += 1
+                    n_lives = arena.n_live[sub_slots].tolist()
+                    if monitored and k < n:
+                        # the tail past the boundary, after the flags
+                        reports = DriftMonitor.update_many(
+                            sub_mons, block[sub, k:]
+                        )
+                        for j, rep in zip(sub_rows, reports):
+                            if rep is not None:
+                                sessions[j].asm.drift_report = rep
+                    if (
+                        single_k
+                        and not monitored
+                        and not slow
+                        and len(groups) == 1
+                        and b - a == len(rows)
+                    ):
+                        # the fully-uniform steady round: finish in one
+                        # tight loop — but the group-level ring roll
+                        # and head counters must land first
+                        self._roll_rings(arena, slots, block, n, w)
+                        return self._finish_fast_round(
+                            sessions, sub_rows, toks, t_idx, n_lives,
+                            now,
+                        )
+                    for j, tok, ti, nl, flag in zip(
+                        sub_rows, toks, t_idx, n_lives, sub_flags
+                    ):
+                        emitted_t[j] = ti
+                        emitted_tok[j] = tok
+                        emitted_drift[j] = (nl, flag)
+            # ring roll for the whole group in two scatters (one when
+            # the chunk covers the window) — AFTER the snapshots above,
+            # which read the pre-roll ring tail
+            self._roll_rings(arena, slots, block, n, w)
+        # mixed-round finish: enqueue in DELIVERY order (slow rows run
+        # their whole push here, so the global FIFO interleaves
+        # exactly as sequential pushes would), with the sequential
+        # path's own per-row global counters and backpressure check —
+        # a slow push mid-loop must observe the true queue depth.
+        # Per-session n_live was batch-incremented above; the bound
+        # check reads the pre-gathered value, so only the rare
+        # over-bound session touches the arena again.
+        enqueued = 0
+        queue_append = self._queue.append
+        max_pending = cfg.max_pending_per_session
+        for j, sid in enumerate(ids):
+            if j in slow:
+                # per-row global counters above keep this push's own
+                # queue-depth gauge samples and backpressure check
+                # honest about the fast windows already appended
+                enqueued += self.push(sid, chunks[j])  # counts its own
+                continue
+            ti = emitted_t.get(j)
+            if ti is None:
+                continue
+            sess = sessions[j]
+            nl, drift = emitted_drift[j]
+            p = _Pending(sess, ti, emitted_tok[j], drift, now)
+            sess.pending.append(p)
+            queue_append(p)
+            enqueued += 1
+            self._n_live += 1
+            self._n_unlaunched += 1
+            self.stats.enqueued += 1
+            if nl > max_pending:
+                while sess.n_live > max_pending:
+                    if not self._drop_oldest_of(sess, "session_queue"):
+                        break
+            overflow = self._n_live - cfg.max_queue_windows
+            if overflow > 0:
+                self._shed_stalest(overflow, "backpressure")
+        self.stats.note_queue_depth(self._n_live)
+        if self.host_profile is not None:
+            self.host_profile.ingest.record((self._clock() - now) * 1e3)
+        return enqueued
+
+    @staticmethod
+    def _roll_rings(arena, slots, block, n: int, w: int) -> None:
+        """Group-level ring roll + head/watermark advance: two scatters
+        (one when the chunk covers the whole window) absorb the round's
+        chunks into every ring at once — the final ring is the last
+        ``w`` stream rows, exactly the sequential roll's result."""
+        if n >= w:
+            arena.rings[slots] = block[:, -w:]
+        else:
+            arena.rings[slots, : w - n] = arena.rings[slots, n:]
+            arena.rings[slots, w - n:] = block
+        arena.n_seen[slots] += n
+        arena.raw_seen[slots] += n
+
+    def _finish_fast_round(
+        self, sessions, em_rows, toks, t_idx, n_lives, now
+    ) -> int:
+        """Enqueue a fully-fast single-length delivery round (the
+        steady state at fleet scale): one tight loop building the
+        ``_Pending`` entries in delivery order, bounds identical to
+        ``push``'s — the mixed-round finish in ``push_many`` does the
+        same work through a per-row staging dict.  The global
+        counters and backpressure shed are applied ONCE after the
+        loop: with no slow push interleaved there is no mid-round
+        observer, and shedding the total overflow stalest-first lands
+        the exact end state per-row incremental sheds produce (same
+        count, same FIFO head)."""
+        cfg = self.config
+        queue_append = self._queue.append
+        max_pending = cfg.max_pending_per_session
+        for j, tok, ti, nl in zip(em_rows, toks, t_idx, n_lives):
+            sess = sessions[j]
+            rep = sess.asm.drift_report
+            p = _Pending(
+                sess, ti, tok,
+                False if rep is None else bool(rep.drifting),
+                now,
+            )
+            sess.pending.append(p)
+            queue_append(p)
+            if nl > max_pending:
+                while sess.n_live > max_pending:
+                    if not self._drop_oldest_of(sess, "session_queue"):
+                        break
+        n_emitted = len(em_rows)
+        self._n_live += n_emitted
+        self._n_unlaunched += n_emitted
+        self.stats.enqueued += n_emitted
+        overflow = self._n_live - cfg.max_queue_windows
+        if overflow > 0:
+            self._shed_stalest(overflow, "backpressure")
+        self.stats.note_queue_depth(self._n_live)
+        if self.host_profile is not None:
+            self.host_profile.ingest.record((self._clock() - now) * 1e3)
+        return n_emitted
 
     def _drop_oldest_of(self, sess: _FleetSession, reason: str) -> bool:
         # scan, don't pop: entries must keep their position for the
@@ -1187,7 +1629,11 @@ class FleetServer:
             # THE ack boundary: every event about to be returned has its
             # ack durable first, so a consumer can never see an event
             # that recovery would emit again (zero double-scored)
+            prof = self.host_profile
+            t_j0 = self._clock() if prof is not None else 0.0
             self._journal.flush()
+            if prof is not None:
+                prof.journal.record((self._clock() - t_j0) * 1e3)
         return events
 
     def flush(self) -> list[FleetEvent]:
@@ -1420,6 +1866,8 @@ class FleetServer:
         cfg = self.config
         if self._staged_swap is not None:
             self._apply_swap()  # the dispatch boundary (model)
+        prof = self.host_profile
+        t_prof0 = self._clock() if prof is not None else 0.0
         batch: list[_Pending] = []
         while self._queue and len(batch) < cfg.target_batch:
             p = self._queue.popleft()
@@ -1435,10 +1883,19 @@ class FleetServer:
         self.stats.utilization = len(batch) / cfg.target_batch
         self._chaos("mid_dispatch")
         t_assembled = self._clock()
-        for p in batch:
-            self.stats.queue_wait.record(
-                (t_assembled - p.t_enqueue) * 1e3
+        if prof is not None:
+            prof.due_select.record((t_assembled - t_prof0) * 1e3)
+        # one vectorized histogram record for the whole batch's queue
+        # wait (was one bisect + append per window)
+        self.stats.queue_wait.record_many(
+            (
+                t_assembled
+                - np.fromiter(
+                    (p.t_enqueue for p in batch), np.float64, len(batch)
+                )
             )
+            * 1e3
+        )
         scorer = self._get_scorer()
         # batch assembly is ONE gather out of the contiguous arena, and
         # the pad policy is the scorer's: pow2 single-device, devices ×
@@ -1459,6 +1916,8 @@ class FleetServer:
             windows = scorer.pad(
                 self._arena.gather([p.slot for p in batch])
             )
+        if prof is not None:
+            prof.gather.record((self._clock() - t_assembled) * 1e3)
         ticket = DispatchTicket(
             batch, windows, scorer, self.model_version, self._clock(),
             fused=fused, slab=slab,
@@ -1507,6 +1966,8 @@ class FleetServer:
         construction and its windows recover as pending."""
         cfg = self.config
         batch, k = ticket.batch, ticket.k
+        prof = self.host_profile
+        t_retire0 = self._clock() if prof is not None else 0.0
         self._chaos("pre_retire")
 
         def _fetch(handle):
@@ -1587,6 +2048,8 @@ class FleetServer:
             self.stats.dispatch_failures += 1
             self._note_slo(breached=True)
             self._recycle_slab(ticket)
+            if prof is not None:
+                prof.retire.record((self._clock() - t_retire0) * 1e3)
             return []
         # deliberate carry idle excluded: a ticket parked across polls
         # by design must not read as a slow dispatch (it would breach
@@ -1639,49 +2102,131 @@ class FleetServer:
         # never emitted — their drop was already counted and their
         # arena slot already freed
         live = [i for i, p in enumerate(batch) if not p.dropped]
-        # decisions, vectorized where the math allows: raw argmax for
-        # the whole batch in one reduction; stateful smoothing batched
-        # per session (update_many — the sequential recurrence, one call
-        # per session instead of one per row)
-        if shed:
-            raw_labels = probs.argmax(axis=1)
-            decided = {
-                i: (int(raw_labels[i]), int(raw_labels[i]), probs[i])
-                for i in live
-            }
-            self.stats.degraded_events += len(live)
-        else:
-            rows_by_sess: dict = {}
-            for i in live:
-                rows_by_sess.setdefault(batch[i].session.sid, []).append(i)
+        m = len(live)
+        # decisions, vectorized: raw argmax for the whole batch in one
+        # reduction; stateful smoothing as one BATCHED arena recurrence
+        # over the live rows when every live session appears once in
+        # the batch (the dominant shape at fleet scale — the
+        # micro-batcher mixes sessions, it rarely repeats one), the
+        # per-session sequential recurrence otherwise.  Both paths are
+        # the same elementwise math (har_tpu.serve.arena), so the
+        # decision columns are bit-identical either way — test-pinned
+        # at N=64 under FakeClock+DispatchFaults across smoothing
+        # modes, churn and ring depths 1-4.
+        raw_all = probs.argmax(axis=1) if m else None
+        labels = raws = None
+        dec_rows = None  # (m, C)-ish block; row i is event i's decision
+        slots_all = (
+            np.fromiter(
+                (batch[i].session.slot for i in live), np.intp, m
+            )
+            if m
+            else None
+        )
+        if not m:
             decided = {}
-            for rows in rows_by_sess.values():
-                outs = batch[rows[0]].session.smoother.update_many(
-                    probs[rows]
+        elif shed:
+            raws = labels = raw_all[live]
+            dec_rows = probs[live]  # fancy-index: already a fresh copy
+            decided = None
+            self.stats.degraded_events += m
+        else:
+            decided = None
+            distinct = len(np.unique(slots_all)) == m
+            if self.smoothing == "none":
+                raws = labels = raw_all[live]
+                dec_rows = probs[live]
+            elif self.smoothing == "ema" and distinct:
+                block = self._ema_kernel(slots_all, probs[live])
+                if block is not None:
+                    raws = raw_all[live]
+                    labels = block.argmax(axis=1)
+                    dec_rows = block
+            elif self.smoothing == "vote" and distinct:
+                out = self._session_arena.vote_block(
+                    slots_all, raw_all[live], probs.shape[1]
                 )
-                for i, out in zip(rows, outs):
-                    decided[i] = out
-        self.stats.note_scored(len(live), ticket.version)
+                if out is not None:
+                    raws = raw_all[live]
+                    labels, dec_rows = out
+            if dec_rows is None:
+                # sequential fallback (duplicate sessions in one batch,
+                # EMA width mismatch after a swap, stale wide votes):
+                # the per-session recurrence, grouped like PR-10 did
+                rows_by_sess: dict = {}
+                for i in live:
+                    rows_by_sess.setdefault(
+                        batch[i].session.sid, []
+                    ).append(i)
+                decided = {}
+                for rows in rows_by_sess.values():
+                    outs = batch[rows[0]].session.smoother.update_many(
+                        probs[rows]
+                    )
+                    for i, out in zip(rows, outs):
+                        decided[i] = out
+        self.stats.note_scored(m, ticket.version)
         events: list[FleetEvent] = []
-        for i in live:
-            p, pr = batch[i], probs[i]
-            label, raw_label, decision = decided[i]
+        if m:
+            # per-session accounting for the whole batch in two
+            # scatter-adds (np.add.at handles a session scored twice)
+            arena = self._session_arena
+            np.add.at(arena.n_scored, slots_all, 1)
+            np.add.at(arena.n_live, slots_all, -1)
+            self._n_live -= m
+        if labels is not None:
+            # one bulk conversion instead of 2 numpy-scalar casts per
+            # event in the loop below
+            labels = labels.tolist()
+            raws = raws.tolist()
+        # the per-event loop below is THE host-plane retire hot path:
+        # events are assembled from the per-dispatch columns computed
+        # above, with the two frozen dataclasses built by direct
+        # ``__dict__`` assignment — same instances, same fields, but
+        # without paying frozen ``__setattr__`` seven times per event
+        # (measured ~1 µs/event at fleet scale, the difference between
+        # a 10k-session round fitting its poll budget or not)
+        new = object.__new__
+        free_slot = self._arena.free
+        emit = events.append
+        waits: list[float] = []
+        note_wait = waits.append
+        for j, i in enumerate(live):
+            p = batch[i]
+            if decided is not None:
+                label, raw_label, decision = decided[i]
+                decision = decision.copy()
+            else:
+                label = labels[j]
+                raw_label = raws[j]
+                # dec_rows is a fresh per-dispatch block (a gather or
+                # the probs fancy-index copy): its rows are this
+                # event's own — no second per-event copy needed
+                decision = dec_rows[j]
             sess = p.session
-            ev = StreamEvent(
+            ev = new(StreamEvent)
+            # .update on the instance dict, NOT attribute assignment:
+            # rebinding __dict__ itself would route through the frozen
+            # dataclass __setattr__ and raise
+            ev.__dict__.update(
                 t_index=p.t_index,
                 label=label,
                 raw_label=raw_label,
-                probability=decision.copy(),
+                probability=decision,
                 latency_ms=lat_share,
                 drift=p.drift,
                 device_ms=dev_share,
             )
-            sess.n_live -= 1
-            sess.n_scored += 1
-            self._n_live -= 1
-            self._arena.free(p.slot)
-            self._unlink_scored(p)
-            self.stats.event.record((t_smooth0 - p.t_enqueue) * 1e3)
+            free_slot(p.slot)
+            # FIFO unlink, head-popped inline: the common case is p at
+            # the session queue's head; flagged-dropped heads fall back
+            # to the shared helper
+            pending = sess.pending
+            q = pending.popleft()
+            if q is not p:
+                pending.appendleft(q)
+                self._unlink_scored(p)
+            note_wait(t_smooth0 - p.t_enqueue)
             # the scored-event ack: carries the probabilities so replay
             # re-steps the smoother to the exact pre-crash state
             # without re-scoring (and `shed` so a frozen smoother stays
@@ -1698,9 +2243,16 @@ class FleetServer:
                         "ver": ticket.version,
                         "shed": shed,
                     },
-                    np.asarray(pr, np.float64).tobytes(),
+                    np.asarray(probs[i], np.float64).tobytes(),
                 )
-            events.append(FleetEvent(sess.sid, ev, degraded=shed))
+            fe = new(FleetEvent)
+            fe.__dict__.update(
+                session_id=sess.sid, event=ev, degraded=shed
+            )
+            emit(fe)
+        self.stats.event.record_many(
+            np.asarray(waits, np.float64) * 1e3
+        )
         self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
         if self._dispatch_tap is not None:
             # mirrored sample for shadow evaluation — after the events
@@ -1726,6 +2278,8 @@ class FleetServer:
             finally:
                 self._in_dispatch = False
         self._recycle_slab(ticket)
+        if prof is not None:
+            prof.retire.record((self._clock() - t_retire0) * 1e3)
         return events
 
     @staticmethod
@@ -1832,6 +2386,13 @@ class FleetServer:
         snap = self.stats.snapshot()
         snap["smoothing_shed"] = self._smoothing_shed
         snap["model_version"] = self.model_version
+        snap["session_arena"] = self._session_arena.state()
+        if self.host_profile is not None:
+            # per-poll host-time breakdown (FleetConfig.profile_host):
+            # ingest / due-select / gather / retire / journal stage
+            # histograms — what the sessions-per-worker ceiling curve
+            # and host-plane regression checks read
+            snap["host_profile"] = self.host_profile.snapshot()
         # dispatch-plane shape: reported only once the first dispatch
         # has built the scorer (building it here could cold-start a jax
         # backend from a pure stats read)

@@ -368,6 +368,265 @@ def run_pipeline_cell_subprocess(
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+class HostPlaneStubModel:
+    """Near-zero-cost row-deterministic scorer for the host-plane
+    scaling curve: per-channel window means through one fixed seeded
+    ``(3, C)`` projection + softmax — about a microsecond per window,
+    so the sessions-per-worker measurement is dominated by the Python
+    host plane it exists to size, not by model arithmetic (the
+    AnalyticDemoModel's feature pipeline costs ~13 µs/window, which
+    would flatten any host-plane ratio toward 1).  Row-independent
+    like every fleet-equivalence stub: batch composition can never
+    change a row's scores."""
+
+    num_classes = 6
+    class_names = tuple(f"class{i}" for i in range(6))
+
+    def __init__(self, seed: int = 1729, taps: int = 5):
+        rng = np.random.default_rng((seed, 0x50A))
+        self._taps = int(taps)
+        self._w = rng.normal(0, 1.0, size=(3 * self.taps, self.num_classes))
+
+    @property
+    def taps(self) -> int:
+        return self._taps
+
+    def transform(self, x):
+        from har_tpu.models.base import Predictions
+
+        x = np.asarray(x)
+        # a handful of evenly-spaced sample taps per window instead of
+        # a full strided mean: the scores are equally meaningless for a
+        # load benchmark, and the strided (k, T, C) mean alone costs
+        # ~4 µs/window — which would be 40% of the whole host-plane
+        # budget this harness exists to measure
+        step = max(1, x.shape[1] // self._taps)
+        f = x[:, :: step, :][:, : self._taps, :].reshape(len(x), -1)
+        raw = np.asarray(f, np.float64) @ self._w
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return Predictions.from_raw(raw, e / e.sum(axis=-1, keepdims=True))
+
+
+def host_plane_rounds(
+    recordings, hop: int, offsets
+) -> list[tuple[list, list]]:
+    """THE phase-staggered delivery schedule of the host-plane
+    measurement: per round, one hop-sized chunk per still-active
+    session, the first chunk shortened by the session's seeded offset
+    so hop boundaries stagger across the fleet (``drive_fleet``'s
+    stance).  ONE builder shared by ``host_plane_benchmark`` and the
+    release gate's ``host_plane_smoke`` — the gate's equivalence check
+    must exercise the exact cadence the benchmark measures."""
+    n = len(recordings)
+    rounds: list[tuple[list, list]] = []
+    cursors = [0] * n
+    while True:
+        ids, chunks = [], []
+        for i in range(n):
+            take = hop if cursors[i] else max(1, hop - int(offsets[i]))
+            part = recordings[i][cursors[i]: cursors[i] + take]
+            cursors[i] += take
+            if len(part):
+                ids.append(i)
+                chunks.append(part)
+        if not ids:
+            break
+        rounds.append((ids, chunks))
+    return rounds
+
+
+def host_plane_benchmark(
+    session_counts,
+    n_runs: int = 3,
+    *,
+    windows_per_session: int = 21,
+    window: int = 200,
+    hop: int = 20,
+    target_batch: int = 256,
+    seed: int = 3,
+) -> list[dict]:
+    """THE sessions-per-worker host-plane measurement shared by
+    ``bench.py``'s ``host_plane_scaling`` lane and
+    ``scripts/host_plane_bench.py`` (the committed-artifact path): per
+    session count, drive the paper's serving cadence — 20 Hz streams,
+    one hop-sized delivery per session per round, one decision per
+    second (window=200, hop=20, the ``StreamingClassifier`` defaults),
+    hop boundaries phase-staggered across the fleet exactly like
+    ``drive_fleet``'s schedule — through a FleetServer on the
+    near-free ``HostPlaneStubModel`` (no device program, no tunnel,
+    ~1 µs/window of model arithmetic), so every measured millisecond
+    is the Python host plane the SoA refactor targets.  Reports
+    windows/s, host-ms-per-poll (the per-round push+poll wall time —
+    one round = one second of stream time, so the per-round budget IS
+    the real-time bound) and event p99, median+std over ``n_runs``.
+    One implementation so the lane and the artifact cannot silently
+    diverge; it runs unchanged against the pre-SoA engine (the PR-10
+    baseline rows in the artifact were captured with exactly this
+    harness), using ``push_many`` batched ingest when the engine
+    provides it and per-session ``push`` otherwise.
+    """
+    from har_tpu.serve.engine import FleetConfig, FleetServer
+
+    model = HostPlaneStubModel()
+    rows = []
+    for n_sessions in session_counts:
+        n_sessions = int(n_sessions)
+        n_samples = window + hop * (max(int(windows_per_session), 1) - 1)
+        rng = np.random.default_rng((seed, 0xB0B))
+        recordings = [
+            np.asarray(r, np.float32)
+            for r in np.split(
+                rng.normal(
+                    0.0, 1.0, size=(n_sessions * n_samples, 3)
+                ).astype(np.float32),
+                n_sessions,
+            )
+        ]
+        # the delivery schedule is precomputed OUTSIDE the timed
+        # region: the harness measures the ENGINE's host plane (push +
+        # poll), not the synthetic transport's chunk slicing.  The
+        # seeded phase offsets stagger hop boundaries across the fleet
+        # (drive_fleet's stance): window completions spread over every
+        # round instead of synchronizing into one.
+        offsets = rng.integers(0, hop, size=n_sessions)
+        rounds = host_plane_rounds(recordings, hop, offsets)
+        wps, poll_ms, p99s, p50s = [], [], [], []
+        balanced = True
+        for run in range(int(n_runs) + 1):  # +1 warmup
+            server = FleetServer(
+                model, window=window, hop=hop, smoothing="ema",
+                config=FleetConfig(
+                    max_sessions=n_sessions, target_batch=target_batch
+                ),
+            )
+            for i in range(n_sessions):
+                server.add_session(i)
+            push_many = getattr(server, "push_many", None)
+            round_ms = []
+            t_start = time.perf_counter()
+            for ids, chunks in rounds:
+                t0 = time.perf_counter()
+                if push_many is not None:
+                    push_many(ids, chunks)
+                else:
+                    for sid, part in zip(ids, chunks):
+                        server.push(sid, part)
+                server.poll(force=True)
+                round_ms.append((time.perf_counter() - t0) * 1e3)
+            server.flush()
+            duration = time.perf_counter() - t_start
+            if run == 0:
+                continue  # warmup run: first-touch allocation + compile
+            acct = server.stats.accounting()
+            balanced = balanced and acct["balanced"] and acct["pending"] == 0
+            wps.append(acct["scored"] / duration if duration else 0.0)
+            poll_ms.append(float(np.median(round_ms)) if round_ms else 0.0)
+            ev = server.stats.event
+            p99s.append(ev.percentile(99) or 0.0)
+            p50s.append(ev.percentile(50) or 0.0)
+        rows.append(
+            {
+                "n_sessions": n_sessions,
+                "windows": n_sessions * windows_per_session,
+                "n_runs": int(n_runs),
+                "windows_per_sec_median": round(float(np.median(wps)), 1),
+                "windows_per_sec_std": round(float(np.std(wps)), 1),
+                "host_ms_per_poll_median": round(
+                    float(np.median(poll_ms)), 3
+                ),
+                "host_ms_per_poll_std": round(float(np.std(poll_ms)), 3),
+                "event_p50_ms_median": round(float(np.median(p50s)), 3),
+                "event_p99_ms_median": round(float(np.median(p99s)), 3),
+                "event_p99_ms_std": round(float(np.std(p99s)), 3),
+                "accounting_balanced": balanced,
+            }
+        )
+    return rows
+
+
+def host_plane_ceiling(rows: list[dict], p99_budget_ms: float) -> float | None:
+    """Sessions-per-worker ceiling at equal p99: the largest session
+    count whose median event p99 stays inside the budget, interpolated
+    linearly between grid points (p99 grows monotonically with N on
+    this workload — each poll round does O(N) host work).  None when
+    even the smallest measured count blows the budget."""
+    pts = sorted(
+        (r["n_sessions"], r["event_p99_ms_median"]) for r in rows
+    )
+    ceiling = None
+    for i, (n, p99) in enumerate(pts):
+        if p99 <= p99_budget_ms:
+            ceiling = float(n)
+            continue
+        if ceiling is not None and i > 0:
+            n0, p0 = pts[i - 1]
+            if p99 > p0:  # interpolate into the over-budget segment
+                frac = (p99_budget_ms - p0) / (p99 - p0)
+                ceiling = round(n0 + frac * (n - n0), 1)
+        break
+    return ceiling
+
+
+def host_plane_summary(
+    rows: list[dict],
+    n_runs: int,
+    *,
+    baseline_rows: list[dict] | None = None,
+    p99_budget_ms: float | None = None,
+) -> dict:
+    """The one summary shape both consumers of ``host_plane_benchmark``
+    publish.  The p99 budget defaults to the BASELINE's median p99 at
+    its smallest measured session count (the PR-10 operating point its
+    bench notes are stated at) — "equal p99" in the ceiling claim means
+    both generations are judged against that same budget."""
+    out = {
+        "model": "host_plane_stub",
+        "n_runs": int(n_runs),
+        "rows": rows,
+        "host_ms_per_poll": rows[-1]["host_ms_per_poll_median"],
+        "contract_ok": all(r["accounting_balanced"] for r in rows),
+    }
+    if baseline_rows:
+        if p99_budget_ms is None:
+            base0 = min(baseline_rows, key=lambda r: r["n_sessions"])
+            p99_budget_ms = base0["event_p99_ms_median"]
+        base_ceiling = host_plane_ceiling(baseline_rows, p99_budget_ms)
+        soa_ceiling = host_plane_ceiling(rows, p99_budget_ms)
+        out["p99_budget_ms"] = round(float(p99_budget_ms), 3)
+        out["baseline_rows"] = baseline_rows
+        out["baseline_sessions_ceiling"] = base_ceiling
+        out["host_sessions_ceiling"] = soa_ceiling
+        out["ceiling_ratio"] = (
+            round(soa_ceiling / base_ceiling, 2)
+            if base_ceiling and soa_ceiling
+            else None
+        )
+        # per-N host-time ratio at matching grid points — the
+        # budget-independent view of the same claim (the p99 ceiling
+        # interpolation is the headline; this is its cross-check)
+        base_by_n = {
+            r["n_sessions"]: r["host_ms_per_poll_median"]
+            for r in baseline_rows
+        }
+        out["ms_per_poll_speedups"] = {
+            str(r["n_sessions"]): round(
+                base_by_n[r["n_sessions"]]
+                / r["host_ms_per_poll_median"],
+                2,
+            )
+            for r in rows
+            if base_by_n.get(r["n_sessions"])
+            and r["host_ms_per_poll_median"]
+        }
+    else:
+        out["host_sessions_ceiling"] = (
+            host_plane_ceiling(rows, p99_budget_ms)
+            if p99_budget_ms is not None
+            else rows[-1]["n_sessions"]
+        )
+    return out
+
+
 def synthetic_sessions(
     n_sessions: int,
     *,
@@ -457,8 +716,16 @@ def drive_fleet(
     enqueued = 0
     t0 = time.perf_counter()
     rounds = 0
+    # batched ingest (the SoA host plane, har_tpu.serve.arena): the
+    # whole round's deliveries go through ONE push_many call — the
+    # engine vectorizes the steady-state rows and replays the rest
+    # through the sequential push, with identical per-session
+    # semantics either way (see FleetServer.push_many)
+    push_many = getattr(server, "push_many", None)
     while True:
         active = False
+        round_ids: list = []
+        round_payloads: list[np.ndarray] = []
         for i in range(n):
             rec = recordings[i]
             if cursors[i] >= len(rec) and not held[i]:
@@ -493,9 +760,16 @@ def drive_fleet(
                 )
                 if delivery_log is not None:
                     delivery_log.append((i, payload))
-                enqueued += server.push(ids[i], payload)
+                round_ids.append(ids[i])
+                round_payloads.append(payload)
                 delivered += len(payload)
                 deliveries += 1
+        if round_ids:
+            if push_many is not None:
+                enqueued += push_many(round_ids, round_payloads)
+            else:
+                for sid, payload in zip(round_ids, round_payloads):
+                    enqueued += server.push(sid, payload)
         rounds += 1
         if rounds % poll_every == 0:
             events.extend(server.poll())

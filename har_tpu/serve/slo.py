@@ -251,6 +251,89 @@ def fleet_pipeline_smoke(
     }
 
 
+def host_plane_smoke(
+    sessions: int = 256, *, check_sessions: int = 64, seed: int = 5
+) -> dict:
+    """The release gate's host-plane check (PR 12, the SoA session
+    estate): two halves, one verdict —
+
+      1. equivalence: the BATCHED ingest path (``push_many`` over the
+         session arena, mid-chunk boundaries included) must produce
+         per-session event streams bit-identical to the sequential
+         ``push`` path at N=64 — phase-staggered 20 Hz chunks, so
+         windows complete mid-chunk (the production shape);
+      2. capacity: one small ``host_plane_benchmark`` point stamps
+         ``{sessions, host_ms_per_poll, p99_ms}`` into the gate log —
+         the host-plane regression trace the sessions-per-worker
+         ceiling curve (artifacts/host_plane_scaling.json) is read
+         against.
+    """
+    import numpy as np
+
+    from har_tpu.serve.loadgen import (
+        HostPlaneStubModel,
+        host_plane_benchmark,
+        host_plane_rounds,
+    )
+
+    model = HostPlaneStubModel()
+    window, hop, n = 100, 20, int(check_sessions)
+    rng = np.random.default_rng((seed, 0xFACE))
+    recs = [
+        rng.normal(size=(window + hop * 12, 3)).astype(np.float32)
+        for _ in range(n)
+    ]
+    # THE shared phase-staggered schedule (one builder with the
+    # benchmark, so this check exercises the measured cadence)
+    rounds = host_plane_rounds(
+        recs, hop, rng.integers(0, hop, size=n)
+    )
+
+    def one_run(batched: bool):
+        server = FleetServer(
+            model, window=window, hop=hop, smoothing="ema",
+            config=FleetConfig(max_sessions=n),
+        )
+        for i in range(n):
+            server.add_session(i)
+        by_sid: dict[int, list] = {i: [] for i in range(n)}
+        for ids, chunks in rounds:
+            if batched:
+                server.push_many(ids, chunks)
+            else:
+                for sid, part in zip(ids, chunks):
+                    server.push(sid, part)
+            for fe in server.poll(force=True):
+                by_sid[fe.session_id].append(fe.event)
+        for fe in server.flush():
+            by_sid[fe.session_id].append(fe.event)
+        return server, by_sid
+
+    _, seq = one_run(False)
+    server, bat = one_run(True)
+    equivalent = all(
+        len(seq[i]) == len(bat[i])
+        and all(events_equal(a, b) for a, b in zip(seq[i], bat[i]))
+        for i in range(n)
+    ) and any(len(seq[i]) for i in range(n))
+    acct = server.stats.accounting()
+
+    row = host_plane_benchmark([int(sessions)], n_runs=2)[0]
+    return {
+        "sessions": int(sessions),
+        "host_ms_per_poll": row["host_ms_per_poll_median"],
+        "p99_ms": row["event_p99_ms_median"],
+        "windows_per_sec": row["windows_per_sec_median"],
+        "batched_equivalent": equivalent,
+        "ok": bool(
+            equivalent
+            and acct["balanced"]
+            and acct["pending"] == 0
+            and row["accounting_balanced"]
+        ),
+    }
+
+
 if __name__ == "__main__":
     import json
 

@@ -60,6 +60,30 @@ class StageHistogram:
         self.buckets[bisect.bisect_left(_BUCKET_BOUNDS_MS, ms)] += 1
         self._recent.append(ms)
 
+    def record_many(self, ms: np.ndarray) -> None:
+        """Record a whole batch of samples in four vectorized
+        reductions — the SoA host plane's per-dispatch path (one call
+        per dispatch instead of one bisect + append per window).
+        ``searchsorted(side="left")`` is ``bisect_left`` exactly, so
+        the bucket table is identical to per-sample ``record`` calls;
+        ``total_ms`` accumulates via one ``sum`` (the aggregate is a
+        float total, not a bit-pinned stream)."""
+        n = len(ms)
+        if not n:
+            return
+        # host-origin wall-clock samples; no device buffer anywhere
+        # near this path
+        ms = np.asarray(ms, np.float64)  # harlint: host-ok
+        self.count += n
+        self.total_ms += float(ms.sum())  # harlint: host-ok
+        top = float(ms.max())  # harlint: host-ok
+        if top > self.max_ms:
+            self.max_ms = top
+        idx = np.searchsorted(_BUCKET_BOUNDS_MS, ms, side="left")
+        for b, k in zip(*np.unique(idx, return_counts=True)):
+            self.buckets[int(b)] += int(k)
+        self._recent.extend(ms.tolist())
+
     def percentile(self, q: float) -> float | None:
         if not self._recent:
             return None
@@ -108,6 +132,39 @@ class StageHistogram:
         buckets = state.get("buckets")
         if buckets is not None and len(buckets) == len(self.buckets):
             self.buckets = [int(b) for b in buckets]
+
+
+class HostProfile:
+    """Per-poll host-time breakdown for the SoA host plane
+    (``har serve --profile-host`` / ``FleetConfig.profile_host``): one
+    StageHistogram per scheduler phase —
+
+      ``ingest``     push/push_many wall time (guard + ring writes +
+                     window staging) per delivery call,
+      ``due_select`` batch selection (queue pop + due bookkeeping) per
+                     dispatch,
+      ``gather``     staging-arena gather + pad/slab fill per dispatch,
+      ``retire``     retire wall (fetch + smoothing + event build +
+                     acks) per dispatch,
+      ``journal``    end-of-poll ack flush per poll.
+
+    Process-local observability by design (never journaled): the
+    breakdown measures THIS process's serving loop — what the
+    sessions-per-worker ceiling curve and future host-plane regressions
+    read out of the summary JSON.
+    """
+
+    PHASES = ("ingest", "due_select", "gather", "retire", "journal")
+
+    def __init__(self):
+        for name in self.PHASES:
+            setattr(self, name, StageHistogram())
+
+    def snapshot(self) -> dict:
+        return {
+            f"{name}_ms": getattr(self, name).snapshot()
+            for name in self.PHASES
+        }
 
 
 class FleetStats:

@@ -237,13 +237,27 @@ class _WindowAssembler:
         "_ring", "_n_seen", "_next_emit",
     )
 
-    def __init__(self, window: int, hop: int, channels: int, monitor=None):
+    def __init__(
+        self, window: int, hop: int, channels: int, monitor=None,
+        ring: np.ndarray | None = None,
+    ):
         self.window = window
         self.hop = hop
         self.channels = channels
         self.monitor = monitor
         self.drift_report = None
-        self._ring = np.zeros((window, channels), np.float32)
+        # ``ring`` — optional externally-owned storage (must arrive
+        # zeroed): the fleet engine's session arena passes one row of
+        # its contiguous ring block here (har_tpu.serve.arena), so ten
+        # thousand sessions share one allocation instead of ten
+        # thousand scattered ones.  The assembler's logic is identical
+        # either way — which is the bit-identity argument for the
+        # structure-of-arrays host plane.
+        self._ring = (
+            np.zeros((window, channels), np.float32)
+            if ring is None
+            else ring
+        )
         self._n_seen = 0
         self._next_emit = window
 

@@ -62,7 +62,14 @@ What it does:
      one drained retire — zero windows lost outside the declared shed
      reasons, conservation balanced in every per-round snapshot; red
      refuses the snapshot.
-  8. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
+  8. Runs the host-plane smoke (``har_tpu.serve.slo.host_plane_smoke``):
+     the SoA batched ingest path must emit bit-identical per-session
+     event streams to the sequential push path (mid-chunk window
+     boundaries included) and the ``{sessions, host_ms_per_poll,
+     p99_ms}`` capacity point is stamped — the regression trace the
+     sessions-per-worker ceiling artifact is read against; red
+     refuses the snapshot.
+  9. Writes ``artifacts/test_gate.json`` — counts, pass/fail, duration,
      the fleet ``{sessions, p99_ms, dropped}`` verdict, the adapt
      ``{swaps, rollbacks, shadow_agreement}`` verdict, the recovery
      ``{kill_points, recovered, windows_lost, recovery_ms}`` stamp,
@@ -248,6 +255,16 @@ def _elastic_smoke() -> dict:
     )
 
 
+def _host_plane_smoke() -> dict:
+    """Host-plane smoke verdict (PR 12, the SoA session estate):
+    batched-vs-sequential ingest bit-identity at N=64 with mid-chunk
+    window boundaries, plus one small capacity point stamping
+    ``{sessions, host_ms_per_poll, p99_ms}`` — the regression trace
+    the sessions-per-worker ceiling curve is read against
+    (har_tpu.serve.slo.host_plane_smoke)."""
+    return _run_smoke("har_tpu.serve.slo", "host_plane_smoke")
+
+
 # fresh-interpreter wall clock, import included.  Re-calibrated for
 # the 2-core build container (r15): package import alone is ~1.4 s and
 # the 8 rules ~2 s in-process there, so the honest fresh-interpreter
@@ -372,6 +389,7 @@ def main(argv=None) -> int:
     cluster = None
     elastic = None
     harlint = None
+    host_plane = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -386,6 +404,7 @@ def main(argv=None) -> int:
             cluster = prior.get("cluster_failover")
             elastic = prior.get("elastic_smoke")
             harlint = prior.get("harlint")
+            host_plane = prior.get("host_plane")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -394,6 +413,7 @@ def main(argv=None) -> int:
             cluster = None
             elastic = None
             harlint = None
+            host_plane = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -493,6 +513,18 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # host-plane gate: batched SoA ingest bit-identical to the
+        # sequential path (mid-chunk boundaries included), stamping
+        # {sessions, host_ms_per_poll, p99_ms} — the regression trace
+        # the sessions-per-worker ceiling artifact is read against
+        host_plane = _host_plane_smoke()
+        if not host_plane.get("ok"):
+            print(
+                "\nrelease_gate: RED host-plane smoke "
+                f"({json.dumps(host_plane)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -509,6 +541,7 @@ def main(argv=None) -> int:
                 "recovery_smoke": recovery,
                 "cluster_failover": cluster,
                 "elastic_smoke": elastic,
+                "host_plane": host_plane,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -537,6 +570,9 @@ def main(argv=None) -> int:
                 ),
                 "elastic_smoke_ok": (
                     None if elastic is None else elastic["ok"]
+                ),
+                "host_plane_ok": (
+                    None if host_plane is None else host_plane["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

@@ -85,6 +85,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
         "fleet_recovery", "cluster_failover", "elastic_traffic",
+        "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -224,6 +225,23 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         )
         assert extra["elastic_beats_static"] is True
         assert extra["elastic_contract_ok"] is True
+    # r16 host-plane scaling lane (the SoA session estate): the
+    # sessions-per-worker measurement with per-round host time and
+    # balanced accounting per grid point, mirrored into the flat
+    # host_sessions_ceiling / host_ms_per_poll keys — or a
+    # deadline-skip marker; never silently absent
+    host_plane = extra["lanes"]["host_plane_scaling"]
+    if "skipped" not in host_plane:
+        assert host_plane["n_runs"] >= 2
+        assert host_plane["contract_ok"] is True
+        assert host_plane["rows"]
+        for row in host_plane["rows"]:
+            assert row["windows_per_sec_median"] > 0
+            assert row["host_ms_per_poll_median"] > 0
+            assert row["accounting_balanced"] is True
+        assert extra["host_ms_per_poll"] == host_plane["host_ms_per_poll"]
+        assert "host_sessions_ceiling" in extra
+        assert extra["host_plane_contract_ok"] is True
     # parity keys exist even on the synthetic fallback (null, not absent)
     for key in (
         "lr_parity_test_accuracy",

@@ -270,6 +270,32 @@ def test_hl002_acceptance_real_fleetstats_minus_one_field():
     assert len(findings) == 2  # absent from state() AND load_state()
 
 
+def test_hl002_acceptance_real_session_arena_minus_slot_array():
+    """The SoA-estate acceptance mutation (PR 12): HL002 auto-covers
+    the session arena's per-slot blocks through the ``_SLOT_ARRAYS``
+    table its snapshot serializer reads — deleting a slot-array key
+    from the REAL arena.py source must produce HL002 findings (the
+    release gate then exits non-zero)."""
+    real = (REPO / "har_tpu" / "serve" / "arena.py").read_text()
+    mutated = real.replace(
+        '"vote_len", "vote_head",', '"vote_head",'
+    )
+    assert mutated != real, "arena.py _SLOT_ARRAYS anchor changed"
+    findings = lint_sources(
+        {"har_tpu/serve/arena.py": mutated}, [StateCompletenessRule()]
+    )
+    assert {f.symbol for f in findings} == {"SessionArena.vote_len"}
+    assert len(findings) == 2  # absent from state() AND load_state()
+    # the unmutated source is clean: the table genuinely covers every
+    # slot array today
+    assert (
+        lint_sources(
+            {"har_tpu/serve/arena.py": real}, [StateCompletenessRule()]
+        )
+        == []
+    )
+
+
 # --------------------------------------------------------------- HL003
 
 

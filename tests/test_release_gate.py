@@ -229,6 +229,29 @@ def test_gate_log_carries_elastic_smoke_verdict():
     assert elastic["balanced_every_round"] is True
 
 
+def test_gate_log_carries_host_plane_verdict():
+    """The SoA host-plane counterpart (PR 12): the gate log must carry
+    a green host-plane check with the {sessions, host_ms_per_poll,
+    p99_ms} stamp — batched push_many ingest bit-identical to the
+    sequential push path at N=64 (mid-chunk window boundaries
+    included) plus the capacity point the sessions-per-worker ceiling
+    artifact is regression-read against."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    host_plane = log.get("host_plane")
+    assert host_plane, (
+        "artifacts/test_gate.json lacks the host_plane verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in ("sessions", "host_ms_per_poll", "p99_ms"):
+        assert key in host_plane
+    assert host_plane["ok"] is True
+    assert host_plane["batched_equivalent"] is True
+    assert host_plane["sessions"] >= 256
+    assert host_plane["host_ms_per_poll"] > 0
+
+
 @pytest.mark.slow
 def test_gate_check_agrees_with_fresh_collection():
     proc = subprocess.run(
