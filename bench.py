@@ -1252,6 +1252,42 @@ def main() -> None:
         "cluster_failover", 20, _cluster_failover_lane
     )
 
+    # Wire-failover lane (r17 tentpole, har_tpu.serve.net): the same
+    # one-worker-dies measurement over the REAL transport — subprocess
+    # workers on loopback TCP with real clocks, the victim process
+    # actually SIGKILLed — reporting failover wall time plus the
+    # controller-side rpc_rtt p50/p99 (the comms/serialization term
+    # the Spark-perf study, arXiv 1612.01437, says dominates once
+    # workers leave shared memory; measured here, not assumed).  The
+    # in-process cluster_failover lane above is the shared-memory
+    # baseline the rtt overhead is read against; contract_ok pins
+    # exactly-once + complete delivery + conservation per run.
+    def _wire_failover_lane():
+        from har_tpu.serve.net.smoke import wire_failover_benchmark
+
+        session_counts = [12] if smoke else [24, 48]
+        rows = wire_failover_benchmark(
+            session_counts, n_runs=1 if smoke else lane_runs
+        )
+        return None, {
+            "model": "analytic_demo",
+            "transport": "tcp",
+            "n_runs": 1 if smoke else lane_runs,
+            "rows": rows,
+            "failover_ms_median": rows[-1]["failover_ms_median"],
+            "rpc_rtt_p50_ms": rows[-1]["rpc_rtt_p50_ms"],
+            "rpc_rtt_p99_ms": rows[-1]["rpc_rtt_p99_ms"],
+            "inproc_failover_ms_median": cluster_stats.get(
+                "failover_ms_median"
+            ),
+            "contract_ok": all(r["contract_ok"] for r in rows),
+            "chip_state_probe": chip_probe,
+        }
+
+    _, wire_stats = deadline_lane(
+        "wire_failover", 30, _wire_failover_lane
+    )
+
     # Elastic-traffic lane (r14 tentpole, har_tpu.serve.traffic): the
     # same seeded 10x diurnal swing (overnight-cohort storm, slow
     # clients, mixed rates) served three ways — static floor batch,
@@ -1541,6 +1577,14 @@ def main() -> None:
             "failover_ms_median"
         ),
         "cluster_failover_contract_ok": cluster_stats.get("contract_ok"),
+        # wire transport (har_tpu.serve.net): the same failover over
+        # REAL subprocess workers + loopback TCP, plus the measured
+        # rpc round-trip distribution — read against the in-process
+        # lane as the shared-memory baseline
+        "wire_failover_ms_median": wire_stats.get("failover_ms_median"),
+        "wire_rpc_rtt_p50_ms": wire_stats.get("rpc_rtt_p50_ms"),
+        "wire_rpc_rtt_p99_ms": wire_stats.get("rpc_rtt_p99_ms"),
+        "wire_failover_contract_ok": wire_stats.get("contract_ok"),
         # elastic traffic (har_tpu.serve.traffic): the autoscaled run's
         # numbers across the 10x swing, and whether it beat the best
         # static configuration on p99 or shed rate at equal windows/s
@@ -1639,6 +1683,7 @@ def main() -> None:
         "adaptive_serving": adaptive_stats,
         "fleet_recovery": recovery_stats,
         "cluster_failover": cluster_stats,
+        "wire_failover": wire_stats,
         "elastic_traffic": elastic_stats,
         "host_plane_scaling": host_plane_stats,
     }

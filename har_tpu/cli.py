@@ -258,6 +258,16 @@ def _parser() -> argparse.ArgumentParser:
                          "--kill-worker to demo a mid-run failover; "
                          "--journal names the cluster root (default: a "
                          "temp dir)")
+    sv.add_argument("--net", action="store_true",
+                    help="with --workers: run the REAL transport "
+                         "(har_tpu.serve.net) — each worker an OS "
+                         "subprocess (`har serve-worker`) on a loopback "
+                         "TCP socket with real clocks, the controller "
+                         "speaking length-prefixed CRC-framed RPCs with "
+                         "deadlines + retries.  --kill-worker then "
+                         "SIGKILLs the actual process and the summary "
+                         "carries the rpc counters/rtt alongside the "
+                         "conservation verdict")
     sv.add_argument("--kill-worker", default=None,
                     help="with --workers: SIGKILL this worker id (e.g. "
                          "w0) partway through the drive — its sessions "
@@ -453,6 +463,19 @@ def _parser() -> argparse.ArgumentParser:
                          "--json the timings ride the report's "
                          "rule_ms/callgraph_ms/lint_ms keys")
 
+    # a stub for discoverability: the real parser lives in
+    # har_tpu.serve.net.worker (main() intercepts and forwards before
+    # this parser ever sees the argv — the worker must not import the
+    # whole CLI surface to start)
+    sub.add_parser(
+        "serve-worker",
+        add_help=False,
+        help="one FleetServer worker process on a loopback TCP socket "
+             "(har_tpu.serve.net) — the subprocess entrypoint behind "
+             "`har serve --workers N --net`, the wire chaos matrix and "
+             "the release gate; `har serve-worker --help` for flags",
+    )
+
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
     pa = sub.add_parser(
@@ -481,6 +504,16 @@ def _parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import sys as _sys
+
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    if argv[:1] == ["serve-worker"]:
+        # forwarded verbatim: the worker subprocess parses its own
+        # flags (har_tpu.serve.net.worker) and must not pay for — or
+        # depend on — the rest of the CLI surface
+        from har_tpu.serve.net.worker import main as _worker_main
+
+        return _worker_main(argv[1:])
     args = _parser().parse_args(argv)
 
     if args.command == "lint":
@@ -854,6 +887,7 @@ def main(argv=None) -> int:
                 or args.adapt
                 or args.kill_after_polls
                 or (args.workers and args.workers > 1)
+                or args.net
                 or args.monitor
                 or args.inject_drift
                 or args.inject_drop
@@ -864,7 +898,7 @@ def main(argv=None) -> int:
                 # flags is serviced only by the steady N-session path
                 raise SystemExit(
                     "--trace drives its own churn fleet; it does not "
-                    "combine with --workers/--resume/--adapt/"
+                    "combine with --workers/--net/--resume/--adapt/"
                     "--kill-after-polls/--monitor/--inject-drift/"
                     "--inject-drop/--inject-delay/--calibrate-device "
                     "(run those modes against the steady N-session "
@@ -1055,6 +1089,11 @@ def main(argv=None) -> int:
                 rec = recordings[i].copy()
                 rec[len(rec) // 2 :] += 25.0
                 recordings[i] = rec
+        if args.net and not (args.workers and args.workers > 1):
+            raise SystemExit(
+                "--net is the multi-worker transport; pair it with "
+                "--workers N (N >= 2)"
+            )
         if args.workers and args.workers > 1:
             # multi-worker control plane (har_tpu.serve.cluster):
             # sessions partition across N journaled FleetServers behind
@@ -1067,6 +1106,173 @@ def main(argv=None) -> int:
                     "--checkpoint (each worker is an unmodified "
                     "FleetServer — run those modes single-process)"
                 )
+            if args.net:
+                # REAL transport (har_tpu.serve.net): OS subprocess
+                # workers on loopback sockets, real clocks, RPC framing
+                if args.inject_stall_every or args.monitor:
+                    raise SystemExit(
+                        "--net workers run in their own processes; "
+                        "--inject-stall-*/--monitor are in-process "
+                        "harness hooks (run them without --net)"
+                    )
+                if args.fused or args.tier != "f32":
+                    raise SystemExit(
+                        "--net workers serve their own named model "
+                        "pool (`har serve-worker --model demo`); "
+                        "--fused/--tier are per-worker serving knobs "
+                        "the wire does not carry yet — run them "
+                        "without --net"
+                    )
+                if args.pipeline_depth != 1 or args.profile_host:
+                    # refuse, never silently ignore: launch_workers
+                    # does not forward these per-worker knobs yet
+                    raise SystemExit(
+                        "--net does not carry --pipeline-depth/"
+                        "--profile-host to the worker processes yet; "
+                        "run them without --net (or start workers "
+                        "directly with `har serve-worker`)"
+                    )
+                import shutil
+                import tempfile
+                import time as _time
+
+                from har_tpu.serve.net.chaos import (
+                    _drive_net_cluster,
+                    _net_cluster_config,
+                )
+                from har_tpu.serve.net.controller import (
+                    NetCluster,
+                    launch_workers,
+                )
+                from har_tpu.serve.net.worker import model_pool
+
+                # the controller's failover restores score with THE
+                # SAME pool the workers serve (version -> model), so
+                # re-derived windows stay bit-identical to acked ones
+                pool = model_pool("demo")
+
+                cluster_tmp = None
+                root = args.journal
+                if root is None:
+                    cluster_tmp = root = tempfile.mkdtemp(
+                        prefix="har_netcluster_"
+                    )
+                procs = {}
+                try:
+                    net_workers = launch_workers(
+                        root,
+                        args.workers,
+                        window=window,
+                        hop=args.hop,
+                        channels=channels,
+                        smoothing=args.smoothing,
+                        max_sessions=max(args.sessions, 64),
+                        target_batch=args.target_batch,
+                        max_delay_ms=args.max_delay_ms,
+                        flush_every=args.journal_flush_every,
+                        snapshot_every=args.journal_snapshot_every,
+                    )
+                    procs.update(
+                        {w.worker_id: w.process for w in net_workers}
+                    )
+                    cluster = NetCluster(
+                        pool["A"],
+                        root,
+                        _workers=net_workers,
+                        config=_net_cluster_config(),
+                        loader=lambda ver: pool.get(ver, pool["A"]),
+                    )
+                    if args.kill_worker is not None and (
+                        args.kill_worker not in cluster.workers
+                    ):
+                        raise SystemExit(
+                            f"--kill-worker {args.kill_worker!r}: "
+                            f"cluster workers are "
+                            f"{list(cluster.workers)}"
+                        )
+                    for i in range(args.sessions):
+                        cluster.add_session(i)
+                    events = []
+                    killed = {"done": False}
+
+                    def on_round(c):
+                        # a REAL SIGKILL of the named worker process
+                        # once windows are flowing — detection, restore
+                        # and migration then run on the protocol alone
+                        if (
+                            args.kill_worker is not None
+                            and not killed["done"]
+                        ):
+                            try:
+                                scored = c.accounting()["scored"]
+                            except Exception:
+                                return
+                            if scored > 0:
+                                procs[args.kill_worker].kill()
+                                killed["done"] = True
+
+                    t0 = _time.perf_counter()
+                    _drive_net_cluster(
+                        cluster,
+                        recordings,
+                        [0] * args.sessions,
+                        max(map(len, recordings)),
+                        args.hop,
+                        events,
+                        on_round,
+                    )
+                    duration = _time.perf_counter() - t0
+                    stats = cluster.cluster_stats()
+                    acct = stats["accounting"]
+                    print(
+                        json.dumps(
+                            {
+                                "sessions": args.sessions,
+                                "workers": stats["workers"],
+                                "transport": "tcp",
+                                "n_events": len(events),
+                                "enqueued": acct["enqueued"],
+                                "scored": acct["scored"],
+                                "dropped": acct["dropped"],
+                                "pending": acct["pending"],
+                                "balanced": acct["balanced"],
+                                "windows_per_sec": (
+                                    round(acct["scored"] / duration, 1)
+                                    if duration
+                                    else None
+                                ),
+                                "failovers": stats["failovers"],
+                                "failover_ms": stats["failover_ms"],
+                                "migrated_sessions": max(
+                                    stats["migrated_sessions"],
+                                    stats["migrations"],
+                                ),
+                                "per_worker_sessions": stats[
+                                    "per_worker_sessions"
+                                ],
+                                "rpc": cluster.transport_stats(),
+                                "killed_worker": (
+                                    args.kill_worker
+                                    if killed["done"]
+                                    else None
+                                ),
+                                "cluster_root": root,
+                            }
+                        )
+                    )
+                    cluster.shutdown_workers()
+                    cluster.close()
+                finally:
+                    # a failed drive must not leak worker processes —
+                    # and never delete the journal root under live
+                    # writers (clean exits already reaped: kill is a
+                    # no-op on an exited process)
+                    for proc in procs.values():
+                        if proc.poll() is None:
+                            proc.kill()
+                    if cluster_tmp is not None:
+                        shutil.rmtree(cluster_tmp, ignore_errors=True)
+                return 0
             import shutil
             import tempfile
             import time as _time

@@ -18,11 +18,22 @@ Public surface:
   restore_server / recovery_smoke         — crash recovery (recover.py)
   KILL_POINTS / run_kill_point            — kill-point chaos (chaos.py)
   CLUSTER_KILL_POINTS / run_cluster_kill_point — worker-axis chaos
+  NET_PARTITION_CASES                     — partition-tolerance matrix
+                                            (runners in serve/net/chaos)
   fleet_slo_smoke / fleet_pipeline_smoke  — the release gate's checks
   har_tpu.serve.cluster                   — multi-worker control plane
                                             (FleetCluster: router,
                                             heartbeat failover, journal
                                             hand-off migration)
+  har_tpu.serve.net                       — REAL multi-host transport
+                                            (NetCluster over `har
+                                            serve-worker` subprocesses:
+                                            CRC-framed TCP RPCs with
+                                            deadlines/retries, NetWorker
+                                            proxies, replicated
+                                            controller election, the
+                                            wire chaos + partition
+                                            matrices)
   har_tpu.serve.traffic                   — elastic traffic engine
                                             (TrafficTrace: diurnal/
                                             bursty/storm churn loadgen;
@@ -37,6 +48,7 @@ docs/recovery.md for the journal format and the recovery invariants.
 
 from har_tpu.serve.chaos import (
     CLUSTER_KILL_POINTS,
+    NET_PARTITION_CASES,
     ENGINE_KILL_POINTS,
     KILL_POINTS,
     KillPlan,
@@ -110,6 +122,7 @@ __all__ = [
     "TrafficTrace",
     "elastic_smoke",
     "CLUSTER_KILL_POINTS",
+    "NET_PARTITION_CASES",
     "run_cluster_kill_point",
     "DeliveryFaults",
     "DispatchError",

@@ -87,6 +87,19 @@ ENGINE_KILL_POINTS = ("mid_promote", "mid_rollback")
 # partition).  Killed on the CLUSTER's chaos hook: the controller dies,
 # the surviving worker processes do not.
 CLUSTER_KILL_POINTS = ("mid_handoff", "mid_migration")
+# the failure modes only a REAL link has (har_tpu.serve.net.chaos —
+# run over subprocess workers on loopback TCP): a slow link and a
+# blackholed probe must NOT be failovers, a duplicated delivery must
+# not double-score, and a split brain resolves by the `handoffs`
+# generation.  Declared here beside the kill points so the full chaos
+# surface reads from one module; the runners live in net/chaos.py
+# (they need the transport, which imports this module).
+NET_PARTITION_CASES = (
+    "slow_link",
+    "dropped_probe",
+    "duplicate",
+    "split_brain",
+)
 
 # occurrence of each point the matrix kills at by default — calibrated
 # so every kill lands mid-run (some windows acked, some pending, the

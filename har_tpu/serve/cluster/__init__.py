@@ -23,6 +23,7 @@ from har_tpu.serve.cluster.controller import (
 from har_tpu.serve.cluster.membership import (
     LeaseConfig,
     Membership,
+    WorkerTimeout,
     WorkerUnavailable,
 )
 from har_tpu.serve.cluster.primitives import (
@@ -44,6 +45,7 @@ __all__ = [
     "FleetCluster",
     "LeaseConfig",
     "Membership",
+    "WorkerTimeout",
     "WorkerUnavailable",
     "broadcast",
     "cluster_failover_smoke",

@@ -68,6 +68,7 @@ from typing import Callable, Hashable
 from har_tpu.serve.cluster.membership import (
     LeaseConfig,
     Membership,
+    WorkerTimeout,
     WorkerUnavailable,
 )
 from har_tpu.serve.cluster.primitives import map_fn, reduce_sum
@@ -258,6 +259,16 @@ class FleetCluster:
         if self.chaos is not None:
             self.chaos(point)
 
+    def _note_worker_failure(self, wid, exc: WorkerUnavailable) -> None:
+        """Route the two failure species to the detector: a DEADLINE
+        (``WorkerTimeout`` — slow link / busy worker) re-paces the
+        probe WITHOUT a strike; everything else (connection refused,
+        reset — nobody home) counts toward the death verdict."""
+        if isinstance(exc, WorkerTimeout):
+            self._membership.note_timeout(wid)
+        else:
+            self._membership.note_failure(wid)
+
     # ------------------------------------------------------- data plane
 
     def add_session(self, session_id: Hashable, *, monitor=None) -> None:
@@ -284,8 +295,8 @@ class FleetCluster:
             )
         try:
             events = worker.disconnect_session(session_id)
-        except WorkerUnavailable:
-            self._membership.note_failure(wid)
+        except WorkerUnavailable as exc:
+            self._note_worker_failure(wid, exc)
             raise
         self._membership.note_ok(wid)
         del self._placement[session_id]
@@ -307,8 +318,8 @@ class FleetCluster:
                 )
             try:
                 events.extend(worker.disconnect_sessions(sids))
-            except WorkerUnavailable:
-                self._membership.note_failure(wid)
+            except WorkerUnavailable as exc:
+                self._note_worker_failure(wid, exc)
                 raise
             self._membership.note_ok(wid)
             for sid in sids:
@@ -331,8 +342,8 @@ class FleetCluster:
             )
         try:
             n = worker.push(session_id, samples)
-        except WorkerUnavailable:
-            self._membership.note_failure(wid)
+        except WorkerUnavailable as exc:
+            self._note_worker_failure(wid, exc)
             raise
         self._membership.note_ok(wid)
         return n
@@ -370,13 +381,13 @@ class FleetCluster:
                     # worker that answers it gets a full poll again
                     try:
                         w.heartbeat()
-                    except WorkerUnavailable:
-                        self._membership.note_failure(wid)
+                    except WorkerUnavailable as exc:
+                        self._note_worker_failure(wid, exc)
                         continue
                 try:
                     evs = w.poll(force=force)
-                except WorkerUnavailable:
-                    self._membership.note_failure(wid)
+                except WorkerUnavailable as exc:
+                    self._note_worker_failure(wid, exc)
                     continue
                 self._membership.note_ok(wid)
                 events.extend(evs)
@@ -405,10 +416,17 @@ class FleetCluster:
         every live worker (each applies it at its own dispatch
         boundary, the PR-3 semantics).  Idempotent per worker — a
         re-issued broadcast after a mid-swap worker loss skips workers
-        already serving ``version``."""
-        for w in self._workers.values():
-            if w.alive and w.server.model_version != version:
-                w.server.swap_model(model, version=version)
+        already serving ``version``, and a worker that dies mid-
+        broadcast is failure-detector evidence, not a broadcast
+        failure (the re-issued broadcast lands it post-failover)."""
+        for wid in list(self._workers):
+            w = self._workers[wid]
+            if not w.alive:
+                continue
+            try:
+                w.swap_model(model, version=version)
+            except WorkerUnavailable as exc:
+                self._note_worker_failure(wid, exc)
         return version
 
     def observe_drift(self, trigger) -> None:
@@ -453,14 +471,18 @@ class FleetCluster:
         side re-derivable, and the marker is the commit point."""
         t0 = time.perf_counter()
         receivers = []
+        # the restored partition wears the ordinary worker surface for
+        # the hand-off (export_session/evict_session) — one evict body,
+        # not a parallel wrapper that could drift from it
+        source = ClusterWorker(dead_wid, restored, restored.journal.root)
         for sid in restored.sessions:
-            target_wid = self._hand_off(restored, sid, dead_wid)
+            target_wid = self._hand_off(source, sid, dead_wid)
             if target_wid not in receivers:
                 receivers.append(target_wid)
             self._chaos("mid_migration")
         self.failover_ms += (time.perf_counter() - t0) * 1e3
         for wid in receivers:
-            self._workers[wid].server.stats.worker_failovers += 1
+            self._workers[wid].note_failover_absorbed()
         self._ledger.append(
             {
                 "worker_id": dead_wid,
@@ -476,14 +498,17 @@ class FleetCluster:
         )
         restored.journal.close()
 
-    def _hand_off(self, source_server, sid, source_wid, target_wid=None):
-        """Move one drained session from ``source_server`` to its ring
-        owner (or the explicit ``target_wid`` of a planned move):
+    def _hand_off(self, source, sid, source_wid, target_wid=None):
+        """Move one drained session from ``source`` to its ring owner
+        (or the explicit ``target_wid`` of a planned move):
         adopt-first (durable on the target), chaos point in the
         dual-ownership window, then the source's journaled eviction.
-        Bounded retries per target, then the next live worker — a
-        hand-off never spins and never silently drops a session."""
-        export = source_server.export_session(sid)
+        ``source`` speaks only ``export_session``/``evict_session`` —
+        a live worker (in-process or RPC) or a ``_DrainedSource`` over
+        a restored partition, transport-blind either way.  Bounded
+        retries per target, then the next live worker — a hand-off
+        never spins and never silently drops a session."""
+        export = source.export_session(sid)
         if target_wid is not None:
             candidates = [target_wid]
         else:
@@ -518,8 +543,8 @@ class FleetCluster:
                         backoff=self._handoff_backoff,
                         sleep=getattr(self._clock, "advance", None),
                     )
-                except WorkerUnavailable:
-                    self._membership.note_failure(wid)
+                except WorkerUnavailable as exc:
+                    self._note_worker_failure(wid, exc)
                     continue
                 except AdmissionError:
                     # target at its max_sessions cap: a capacity
@@ -534,13 +559,9 @@ class FleetCluster:
                 f"no live worker could adopt session {sid!r}"
             )
         self._chaos("mid_handoff")
-        source_server.handoff_session(sid)
-        if source_server.journal is not None:
-            source_server.journal.flush()
+        source.evict_session(sid)
         target = self._workers[target_wid]
-        target.server.stats.migration_ms += (
-            time.perf_counter() - t0
-        ) * 1e3
+        target.note_migration_ms((time.perf_counter() - t0) * 1e3)
         self._placement[sid] = target_wid
         self.migration_log.append(
             {"sid": sid, "from": source_wid, "to": target_wid}
@@ -566,7 +587,7 @@ class FleetCluster:
             return
         source = self._workers[src_wid]
         self._hand_off(
-            source.server, session_id, src_wid, target_wid=target_wid
+            source, session_id, src_wid, target_wid=target_wid
         )
 
     def add_worker(
@@ -642,11 +663,7 @@ class FleetCluster:
         # session discovered mid-retire would otherwise strand the
         # worker outside the failure detector with its sessions
         # unreachable forever
-        undrained = [
-            sid
-            for sid in worker.server.sessions
-            if worker.server._sessions[sid].n_live
-        ]
+        undrained = worker.undrained()
         if undrained:
             raise ClusterError(
                 f"worker {worker_id!r} has live windows for sessions "
@@ -657,16 +674,15 @@ class FleetCluster:
         self._router.remove_worker(worker_id)
         self._membership.remove(worker_id)
         moved = 0
-        for sid in worker.server.sessions:
-            self._hand_off(worker.server, sid, worker_id)
+        for sid in worker.sessions():
+            self._hand_off(worker, sid, worker_id)
             moved += 1
+        final = worker.final_accounting()
         self._ledger.append(
             {
                 "worker_id": worker_id,
-                "accounting": worker.server.stats.accounting(),
-                "scored_by_version": dict(
-                    worker.server.stats.scored_by_version
-                ),
+                "accounting": final["accounting"],
+                "scored_by_version": final["scored_by_version"],
             }
         )
         atomic_write(
@@ -728,7 +744,7 @@ class FleetCluster:
         cluster = cls(
             model,
             root,
-            hop=workers[0].server.hop if workers else 20,
+            hop=workers[0].geometry()["hop"] if workers else 20,
             config=config,
             clock=clock,
             loader=loader,
@@ -770,7 +786,7 @@ class FleetCluster:
         cluster = cls(
             model,
             root,
-            hop=workers[0].server.hop if workers else 20,
+            hop=workers[0].geometry()["hop"] if workers else 20,
             config=config,
             clock=clock,
             loader=loader,
@@ -814,23 +830,18 @@ class FleetCluster:
         that actually holds it."""
         owners: dict = {}
         for wid, w in self._workers.items():
-            for sid in w.server.sessions:
+            for sid in w.sessions():
                 owners.setdefault(sid, []).append(wid)
         for sid, wids in owners.items():
             if len(wids) > 1:
                 # adopt-first ordering: generations strictly order the
                 # copies; the highest is the adopted (newest) one
                 wids.sort(
-                    key=lambda wid: self._workers[wid]
-                    .server._sessions[sid]
-                    .handoffs
+                    key=lambda wid: self._workers[wid].generation(sid)
                 )
                 keeper = wids[-1]
                 for wid in wids[:-1]:
-                    src = self._workers[wid].server
-                    src.handoff_session(sid)
-                    if src.journal is not None:
-                        src.journal.flush()
+                    self._workers[wid].evict_session(sid)
                 self._placement[sid] = keeper
             else:
                 self._placement[sid] = wids[0]
@@ -844,8 +855,7 @@ class FleetCluster:
         double-counted or lost by a migration breaks a worker-level
         invariant before it could cancel out in the sums."""
         parts = map_fn(
-            lambda w: w.server.stats.accounting(),
-            list(self._workers.values()),
+            lambda w: w.accounting(), list(self._workers.values())
         )
         # a drained partition waiting on its phase-2 hand-offs is still
         # part of the global law (its windows are scored/pending THERE
@@ -865,6 +875,9 @@ class FleetCluster:
         migration evidence, per-worker session counts — aggregated with
         the same map/reduce primitives the drift escalation uses."""
         live = list(self._workers.values())
+        # one control_stats round trip per worker (a transport-backed
+        # worker pays one RPC here, not four)
+        per_worker = map_fn(lambda w: w.control_stats(), live)
         return {
             "workers": len(live),
             "sessions": len(self._placement),
@@ -872,20 +885,17 @@ class FleetCluster:
             "failover_ms": round(self.failover_ms, 3),
             "migrated_sessions": len(self.migration_log),
             "worker_failovers": reduce_sum(
-                map_fn(lambda w: w.server.stats.worker_failovers, live)
+                [p["worker_failovers"] for p in per_worker]
             ),
             "migrations": reduce_sum(
-                map_fn(lambda w: w.server.stats.migrations, live)
+                [p["migrations"] for p in per_worker]
             ),
             "migration_ms": round(
-                reduce_sum(
-                    map_fn(lambda w: w.server.stats.migration_ms, live)
-                ),
-                3,
+                reduce_sum([p["migration_ms"] for p in per_worker]), 3
             ),
             "per_worker_sessions": {
-                wid: len(w.server.sessions)
-                for wid, w in self._workers.items()
+                wid: p["sessions"]
+                for wid, p in zip(self._workers, per_worker)
             },
             "accounting": self.accounting(),
             "retired": [e["worker_id"] for e in self._ledger],

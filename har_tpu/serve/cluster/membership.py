@@ -34,6 +34,16 @@ class WorkerUnavailable(RuntimeError):
     """A routed call reached a dead or unreachable worker."""
 
 
+class WorkerTimeout(WorkerUnavailable):
+    """The worker did not answer inside the call deadline — a SLOW LINK
+    or a busy worker, not death evidence.  Subclasses WorkerUnavailable
+    so every existing "worker did not serve this call" path still
+    catches it; the failure detector routes it to ``note_timeout``
+    (re-paced probe, NO strike) instead of ``note_failure`` — a
+    congested-but-alive worker must never be failovered spuriously
+    (test-pinned both paths)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class LeaseConfig:
     """Failure-detection knobs."""
@@ -124,6 +134,20 @@ class Membership:
         if h is None:
             return
         h.failures += 1
+        h.next_probe = self._clock() + h.backoff.next_ms() / 1e3
+
+    def note_timeout(self, worker_id) -> None:
+        """A DEADLINE-EXCEEDED call (``WorkerTimeout``): the link is
+        slow or the worker busy — re-pace the next probe by the same
+        backoff schedule but consume NO probe strike and renew nothing.
+        Connection-refused is death evidence (nobody listening);
+        a late answer is congestion evidence, and a worker whose lease
+        expires on congestion alone still needs ``probe_retries``
+        REFUSED probes before the detector declares it — the
+        slow-link partition case resolves with zero failovers."""
+        h = self._health.get(worker_id)
+        if h is None:
+            return
         h.next_probe = self._clock() + h.backoff.next_ms() / 1e3
 
     def probe_due(self, worker_id) -> bool:

@@ -90,6 +90,91 @@ class ClusterWorker:
         self._guard()
         return self.server.watermark(session_id)
 
+    # ------------------------------------------- control-plane surface
+    # (PR 13: the controller speaks ONLY this surface — never
+    # ``worker.server.<attr>`` — so the transport-backed twin
+    # (har_tpu.serve.net.NetWorker) can implement the same methods as
+    # RPCs and the controller stays transport-blind.)
+
+    def export_session(self, session_id: Hashable) -> dict:
+        self._guard()
+        return self.server.export_session(session_id)
+
+    def evict_session(self, session_id: Hashable) -> None:
+        """Source half of a hand-off: journaled eviction + flush (the
+        record must be durable before the controller moves on)."""
+        self._guard()
+        self.server.handoff_session(session_id)
+        if self.server.journal is not None:
+            self.server.journal.flush()
+
+    def sessions(self) -> tuple:
+        return tuple(self.server.sessions)
+
+    def session_count(self) -> int:
+        return len(self.server._sessions)
+
+    def generation(self, session_id: Hashable) -> int:
+        """The session's ``handoffs`` generation — the dual-ownership
+        tie-break a takeover controller sorts by."""
+        return int(self.server._sessions[session_id].handoffs)
+
+    def undrained(self) -> list:
+        """Sessions with live (queued or in-flight) windows — what a
+        planned retire must refuse on."""
+        return [
+            sid
+            for sid in self.server.sessions
+            if self.server._sessions[sid].n_live
+        ]
+
+    def model_version(self) -> str:
+        self._guard()
+        return self.server.model_version
+
+    def swap_model(self, model, *, version: str) -> None:
+        self._guard()
+        if self.server.model_version != version:
+            self.server.swap_model(model, version=version)
+
+    def geometry(self) -> dict:
+        s = self.server
+        return {
+            "window": s.window,
+            "hop": s.hop,
+            "channels": s.channels,
+            "smoothing": s.smoothing,
+            "target_batch": int(s.config.target_batch),
+            "pipeline_depth": int(s.config.pipeline_depth),
+        }
+
+    def accounting(self) -> dict:
+        return self.server.stats.accounting()
+
+    def final_accounting(self) -> dict:
+        """The ledger entry a planned retire commits."""
+        return {
+            "accounting": self.server.stats.accounting(),
+            "scored_by_version": dict(self.server.stats.scored_by_version),
+        }
+
+    def control_stats(self) -> dict:
+        s = self.server.stats
+        return {
+            "worker_failovers": s.worker_failovers,
+            "migrations": s.migrations,
+            "migration_ms": s.migration_ms,
+            "sessions": len(self.server._sessions),
+        }
+
+    def note_failover_absorbed(self) -> None:
+        self._guard()
+        self.server.stats.worker_failovers += 1
+
+    def note_migration_ms(self, ms: float) -> None:
+        self._guard()
+        self.server.stats.migration_ms += float(ms)
+
     # ----------------------------------------------------- lifecycle
 
     def kill(self) -> None:

@@ -1,0 +1,83 @@
+"""Real multi-host transport for the fleet cluster (PR 13).
+
+The PR-7 control plane is architecturally multi-host but physically one
+process: workers are in-process objects behind the ``ClusterWorker``
+shim, the controller is a singleton, and the leases ride a FakeClock.
+This package puts a real wire behind that seam:
+
+  wire.py       length-prefixed CRC-framed messages over TCP — the SAME
+                framing the write-ahead journal uses on disk
+                (``journal.encode_record``), plus codecs for the
+                payloads that already exist as journal records
+                (session exports ride the ``adopt`` record layout,
+                events the ``ack`` layout)
+  rpc.py        request/response over a socket: deadlines, retry via
+                ``utils.backoff``, connection-refused vs
+                deadline-exceeded error taxonomy, duplicate-delivery
+                dedup, deterministic link-fault injection
+  worker.py     ``har serve-worker`` — one FleetServer + journal as an
+                OS subprocess on a loopback socket, real monotonic
+                clocks
+  client.py     ``NetWorker`` — the transport-backed twin of
+                ``ClusterWorker``: same surface, every call an RPC
+  controller.py ``NetCluster`` — ``FleetCluster`` over NetWorkers
+                (failover restores the dead worker's journal from
+                shared disk; hand-offs ride the adopt RPC)
+  election.py   replicated controller: wall-clock lease file + fenced
+                campaign; a replica completes ``takeover`` when the
+                leader's lease expires
+  chaos.py      the chaos matrix re-run over the wire + the
+                partition-tolerance matrix (slow link, dropped probe,
+                duplicated delivery, split brain)
+  smoke.py      the release gate's ``wire_failover_smoke`` + the bench
+                lane's measurement
+
+See docs/multihost.md ("Wire protocol") for the frame layout, the
+election rules and the partition-resolution argument.
+"""
+
+from har_tpu.serve.net.client import NetWorker
+from har_tpu.serve.net.controller import NetCluster, launch_workers
+from har_tpu.serve.net.election import ControllerReplica, LeaderLease
+from har_tpu.serve.net.rpc import (
+    LinkFaults,
+    RpcClient,
+    RpcConnectionRefused,
+    RpcDeadlineExceeded,
+    RpcError,
+    RpcRemoteError,
+    RpcServer,
+)
+from har_tpu.serve.net.smoke import wire_failover_smoke
+from har_tpu.serve.net.wire import (
+    MAX_FRAME_BYTES,
+    FrameBuffer,
+    FrameError,
+    decode_events,
+    decode_export,
+    encode_events,
+    encode_export,
+)
+
+__all__ = [
+    "ControllerReplica",
+    "FrameBuffer",
+    "FrameError",
+    "LeaderLease",
+    "LinkFaults",
+    "MAX_FRAME_BYTES",
+    "NetCluster",
+    "NetWorker",
+    "RpcClient",
+    "RpcConnectionRefused",
+    "RpcDeadlineExceeded",
+    "RpcError",
+    "RpcRemoteError",
+    "RpcServer",
+    "decode_events",
+    "decode_export",
+    "encode_events",
+    "encode_export",
+    "launch_workers",
+    "wire_failover_smoke",
+]

@@ -1,0 +1,599 @@
+"""Chaos over the wire: the PR-7 kill matrix re-run with REAL process
+deaths, plus the partition-tolerance matrix the in-process harness
+could not express (a shared-memory shim has no slow links).
+
+Two matrices:
+
+``run_net_kill_point`` — every engine stage boundary
+(``chaos.KILL_POINTS``) killed inside ONE subprocess worker of a live
+3-worker loopback cluster (``--chaos-point`` makes the worker
+``os._exit`` there: a genuine SIGKILL, the un-flushed journal suffix
+genuinely gone), plus the two controller points
+(``chaos.CLUSTER_KILL_POINTS``: the CONTROLLER dies mid-migration, the
+worker processes survive, ``NetCluster.takeover`` finishes the job).
+The verdict is the same three-part cross-worker contract as the
+in-process matrix — zero double-scored, migrated streams BIT-IDENTICAL
+to the un-killed IN-PROCESS reference run, global conservation in
+every observable snapshot — proving the wire changed nothing.
+
+``run_net_partition`` — the failure modes only a real link has:
+
+  ``slow_link``       one worker's calls exceed the deadline for a
+                      while: the client retries (same request id,
+                      server-side dedup = exactly-once), the prober
+                      spends NO strike (``note_timeout``), and the
+                      congested-but-alive worker is NOT failovered;
+  ``dropped_probe``   blackholed requests: timeouts re-pace the probe
+                      without a strike — again no spurious failover;
+  ``duplicate``       every push delivered twice: the server's
+                      request-id dedup answers the duplicate from
+                      cache, zero double-ingested windows;
+  ``split_brain``     a deposed controller crashes mid-hand-off
+                      (adopt durable, evict not): dual LIVE ownership,
+                      resolved by the session's ``handoffs``
+                      generation when the next controller takes over —
+                      a single surviving owner, zero windows lost.
+
+Both matrices run on real monotonic time (no FakeClock — that is the
+point), with small leases so the suite stays fast.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+
+from har_tpu.serve.chaos import (
+    CLUSTER_KILL_POINTS,
+    KILL_POINTS,
+    NET_PARTITION_CASES,
+    _DEFAULT_AT,
+    KillPlan,
+    SimulatedCrash,
+    _build_cluster,
+    _cluster_schedule,
+    _cluster_verdict,
+    _recordings,
+)
+from har_tpu.serve.cluster.controller import ClusterConfig
+from har_tpu.serve.cluster.membership import (
+    WorkerTimeout,
+    WorkerUnavailable,
+)
+from har_tpu.serve.cluster.router import ConsistentHashRouter
+from har_tpu.serve.faults import FakeClock
+from har_tpu.serve.loadgen import AnalyticDemoModel
+from har_tpu.serve.net.controller import NetCluster, launch_workers
+
+# failure detection tuned for a loopback suite: a dead process refuses
+# instantly, so death lands within ~lease_s of the kill
+_NET_CONFIG = dict(
+    lease_s=0.4, probe_retries=2, probe_base_ms=20.0, probe_cap_ms=100.0
+)
+
+
+def _net_cluster_config() -> ClusterConfig:
+    return ClusterConfig(**_NET_CONFIG)
+
+
+def predicted_owner(session_id, workers: int, replicas: int | None = None):
+    """The ring owner of a session BEFORE the cluster exists — the ring
+    is deterministic in (worker ids, replicas), so the chaos victim
+    (owner of session 0) is computable at worker-spawn time."""
+    router = ConsistentHashRouter(
+        replicas or ClusterConfig().replicas
+    )
+    for i in range(int(workers)):
+        router.add_worker(f"w{i}")
+    return router.owner(session_id)
+
+
+def _drive_net_cluster(cluster, recordings, cursors, upto, hop, events,
+                       on_round=None, max_rounds=20000, pace_s=0.002):
+    """Real-time twin of ``chaos._drive_cluster``: hop-aligned
+    round-robin delivery against a NetCluster.  A push that fails
+    (refused OR timed out) keeps its cursor; a TIMED-OUT push is
+    ambiguous (the worker may have executed it), so the cursor re-syncs
+    from the owner's durable watermark before re-delivery — the
+    documented transport contract, exercised for real here.  Completed
+    migrations rewind their session's cursor to the adopted watermark;
+    the loop keeps polling until no session is stranded on a dead
+    worker."""
+    for i in range(len(recordings)):
+        try:
+            cursors[i] = cluster.watermark(i)
+        except WorkerUnavailable:
+            pass  # mid-failover: the migration rewind below lands
+    seen_migrations = len(cluster.migration_log)
+    resync: set = set()
+    for _ in range(max_rounds):
+        active = False
+        for i, rec in enumerate(recordings):
+            stop = min(upto, len(rec))
+            if i in resync:
+                try:
+                    cursors[i] = cluster.watermark(i)
+                    resync.discard(i)
+                except WorkerUnavailable:
+                    continue  # still unreachable; keep the flag
+            if cursors[i] >= stop:
+                continue
+            active = True
+            take = hop - (cursors[i] % hop) or hop
+            chunk = rec[cursors[i] : min(cursors[i] + take, stop)]
+            try:
+                cluster.push(i, chunk)
+            except WorkerTimeout:
+                # ambiguous delivery: the worker may hold these rows —
+                # re-sync from its watermark before pushing more
+                resync.add(i)
+                continue
+            except WorkerUnavailable:
+                continue  # cursor kept; re-delivered post-failover
+            cursors[i] += len(chunk)
+        events.extend(cluster.poll(force=True))
+        if on_round is not None:
+            on_round(cluster)
+        while seen_migrations < len(cluster.migration_log):
+            sid = cluster.migration_log[seen_migrations]["sid"]
+            cursors[sid] = cluster.watermark(sid)
+            seen_migrations += 1
+        if not active:
+            # convergence is judged on the DURABLE watermark, not the
+            # cursor: a worker can accept a push and die before its
+            # records reach disk, and the controller only learns at
+            # detection time (over a real wire there is no synchronous
+            # `alive` bit).  An unreachable owner means a failover is
+            # pending (keep polling — the polls feed the detector);
+            # a watermark short of the schedule means the adopted copy
+            # needs re-delivery from there — the documented transport
+            # contract, exercised for real
+            stranded = rewound = False
+            for i in range(len(recordings)):
+                stop = min(upto, len(recordings[i]))
+                try:
+                    w = cluster.watermark(i)
+                except WorkerUnavailable:
+                    stranded = True
+                    continue
+                if w < stop:
+                    cursors[i] = w
+                    rewound = True
+            if bool(resync) or stranded or rewound:
+                pass  # not settled yet
+            else:
+                break
+        time.sleep(pace_s)  # real time IS the clock here
+    else:  # pragma: no cover - harness guard
+        raise RuntimeError("net cluster drive did not converge")
+    events.extend(cluster.flush())
+    if on_round is not None:
+        on_round(cluster)
+
+
+def _net_schedule(cluster, recordings, cursors, *, hop, swap_sample,
+                  events, on_round=None):
+    """The wire twin of ``chaos._cluster_schedule``: deliver to the
+    swap point, resize every worker to 48 (the mid-run elastic bump
+    the reference schedule applies), broadcast the hot swap, deliver
+    the rest.  Idempotent per worker like the in-process schedule — a
+    post-takeover resumption re-issues only where nothing landed."""
+    _drive_net_cluster(
+        cluster, recordings, cursors, swap_sample, hop, events, on_round
+    )
+    for w in list(cluster._workers.values()):
+        if not w.alive:
+            continue
+        try:
+            w.resize(48)
+        except WorkerUnavailable:
+            pass  # dead mid-broadcast: lands after failover via replay
+    cluster.swap_model(None, version="B")
+    _drive_net_cluster(
+        cluster, recordings, cursors, max(map(len, recordings)), hop,
+        events, on_round,
+    )
+
+
+def _safe_accounting(cluster, log: list) -> None:
+    """Per-round conservation snapshot; a worker inside its suspicion
+    window is unobservable over a real wire (its partition answers
+    nobody), so those rounds record no snapshot instead of a fake one."""
+    try:
+        log.append(cluster.accounting())
+    except WorkerUnavailable:
+        pass
+
+
+def run_net_kill_point(
+    point: str,
+    *,
+    at: int | None = None,
+    workers: int = 3,
+    sessions: int = 12,
+    seed: int = 0,
+    n_samples: int = 300,
+    window: int = 100,
+    hop: int = 50,
+    flush_every: int = 512,
+    snapshot_every: int = 40,
+    kill_round: int = 3,
+) -> dict:
+    """One cell of the wire chaos matrix (see module docstring).
+
+    The reference is an IN-PROCESS un-killed cluster run of the same
+    schedule (FakeClock, no fault hooks) — the acceptance bar is that
+    the wire run's migrated streams are bit-identical to it."""
+    if point not in KILL_POINTS and point not in CLUSTER_KILL_POINTS:
+        raise ValueError(f"unknown net kill point {point!r}")
+    at = _DEFAULT_AT[point] if at is None else at
+    recordings = _recordings(sessions, n_samples, 3, seed)
+    models = {"A": AnalyticDemoModel(), "B": AnalyticDemoModel(tau=5.0)}
+
+    def loader(ver):
+        return models.get(ver, models["A"])
+
+    swap_sample = (n_samples // hop // 2) * hop
+
+    # ---- reference: the un-killed IN-PROCESS cluster run ------------
+    ref_root = tempfile.mkdtemp(prefix="har_netref_")
+    try:
+        ref_clock = FakeClock()
+        ref = _build_cluster(
+            ref_root, ref_clock, sessions=sessions, workers=workers,
+            window=window, hop=hop, model=models["A"],
+            flush_every=flush_every, snapshot_every=snapshot_every,
+            loader=loader,
+        )
+        # the wire workers run without injected dispatch stalls; strip
+        # the reference's fault hooks so both runs share one schedule
+        for w in ref._workers.values():
+            w.server._fault_hook = None
+        for i in range(sessions):
+            ref.add_session(i)
+        ref_events: list = []
+        _cluster_schedule(
+            ref, recordings, [0] * sessions, hop=hop, clock=ref_clock,
+            models=models, swap_sample=swap_sample, events=ref_events,
+        )
+        ref.close()
+    finally:
+        shutil.rmtree(ref_root, ignore_errors=True)
+
+    # ---- the wire run -----------------------------------------------
+    victim = predicted_owner(0, workers)
+    root = tempfile.mkdtemp(prefix="har_netchaos_")
+    procs: dict = {}
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop,
+            target_batch=32, max_delay_ms=0.0, retries=1,
+            flush_every=flush_every, snapshot_every=snapshot_every,
+            chaos_worker=victim if point in KILL_POINTS else None,
+            chaos_point=point if point in KILL_POINTS else None,
+            chaos_at=at,
+        )
+        procs.update({w.worker_id: w.process for w in net_workers})
+        cluster = NetCluster(
+            models["A"], root, _workers=net_workers,
+            config=_net_cluster_config(), loader=loader,
+        )
+        for i in range(sessions):
+            cluster.add_session(i)
+        events: list = []
+        cursors = [0] * sessions
+        balance_log: list = []
+        rounds = {"n": 0}
+        plan = None
+        if point in CLUSTER_KILL_POINTS:
+            plan = KillPlan(point, at)
+            cluster.chaos = plan
+
+        def on_round(c):
+            rounds["n"] += 1
+            if (
+                point in CLUSTER_KILL_POINTS
+                and rounds["n"] == kill_round
+            ):
+                # a REAL worker death starts the failover the
+                # controller will die inside of
+                procs[victim].kill()
+            _safe_accounting(c, balance_log)
+
+        crashed = False
+        t0 = time.perf_counter()
+        try:
+            _net_schedule(
+                cluster, recordings, cursors, hop=hop,
+                swap_sample=swap_sample, events=events,
+                on_round=on_round,
+            )
+        except SimulatedCrash:
+            crashed = True
+        if point in KILL_POINTS:
+            # the victim process must have exited at its stage
+            # boundary; a still-running victim means the occurrence
+            # was never reached
+            if procs[victim].poll() is None:
+                cluster.shutdown_workers()
+                cluster.close()
+                return {
+                    "ok": False, "point": point,
+                    "why": f"kill point {point!r} never fired (at={at})",
+                    "windows_lost": 0, "failover_ms": 0.0,
+                }
+        elif not crashed:
+            cluster.shutdown_workers()
+            cluster.close()
+            return {
+                "ok": False, "point": point,
+                "why": f"kill point {point!r} never fired (at={at})",
+                "windows_lost": 0, "failover_ms": 0.0,
+            }
+        if crashed:
+            # the controller died mid-migration; its worker processes
+            # did not.  A fresh controller adopts the still-responsive
+            # workers and completes the orphaned failover — the
+            # election layer drives exactly this via the lease file
+            survivors = [
+                w for w in cluster._workers.values() if w.alive
+            ]
+            cluster = NetCluster.takeover(
+                models["A"], root, survivors,
+                config=_net_cluster_config(), loader=loader,
+            )
+            _net_schedule(
+                cluster, recordings, cursors, hop=hop,
+                swap_sample=swap_sample, events=events,
+                on_round=lambda c: _safe_accounting(c, balance_log),
+            )
+        failover_ms = (time.perf_counter() - t0) * 1e3
+        stats = cluster.cluster_stats()
+        verdict = _cluster_verdict(
+            point, ref_events, events, cluster, balance_log, stats,
+            failover_ms,
+        )
+        verdict["transport"] = "tcp"
+        verdict["rpc"] = cluster.transport_stats()
+        cluster.shutdown_workers()
+        cluster.close()
+        return verdict
+    finally:
+        # never leak worker processes or rmtree under live writers
+        # (clean exits already reaped: kill no-ops on an exited one)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ------------------------------------------------------- partitions
+
+
+def run_net_partition(
+    case: str,
+    *,
+    workers: int = 3,
+    sessions: int = 9,
+    seed: int = 0,
+    n_samples: int = 200,
+    window: int = 100,
+    hop: int = 50,
+) -> dict:
+    """One cell of the partition-tolerance matrix (module docstring).
+    Every case must end with a single surviving owner per session,
+    conservation balanced, and ``windows_lost == 0``."""
+    if case not in NET_PARTITION_CASES:
+        raise ValueError(f"unknown partition case {case!r}")
+    if case == "split_brain":
+        return _run_split_brain(
+            workers=workers, sessions=sessions, seed=seed,
+            n_samples=n_samples, window=window, hop=hop,
+        )
+    from har_tpu.serve.net.rpc import LinkFaults
+
+    recordings = _recordings(sessions, n_samples, 3, seed)
+    model = AnalyticDemoModel()
+    victim = predicted_owner(0, workers)
+    root = tempfile.mkdtemp(prefix="har_netpart_")
+    procs: list = []
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop,
+            target_batch=32, max_delay_ms=0.0,
+            deadline_s=0.3, probe_deadline_s=0.2,
+        )
+        procs.extend(w.process for w in net_workers)
+        cluster = NetCluster(
+            model, root, _workers=net_workers,
+            config=_net_cluster_config(),
+            loader=lambda ver: model,
+        )
+        for i in range(sessions):
+            cluster.add_session(i)
+        # the link degrades MID-RUN (after admission): the impairment
+        # must hit a working cluster, not its setup
+        faults = None
+        if case == "slow_link":
+            # the victim's next 3 calls blow the deadline (the peer
+            # still executes them: the retry-dedup path)
+            faults = LinkFaults("delay", method="", times=3)
+        elif case == "dropped_probe":
+            faults = LinkFaults("drop", method="", times=3)
+        elif case == "duplicate":
+            faults = LinkFaults("dup", method="push", times=10**9)
+        for w in net_workers:
+            if w.worker_id == victim:
+                w._client.faults = faults
+        events: list = []
+        cursors = [0] * sessions
+        balance_log: list = []
+        _drive_net_cluster(
+            cluster, recordings, cursors, n_samples, hop, events,
+            on_round=lambda c: _safe_accounting(c, balance_log),
+        )
+        why = _partition_verdict(
+            cluster, events, balance_log, sessions, n_samples, window,
+            hop, expect_failovers=0,
+        )
+        out = {
+            "ok": why is None,
+            "case": case,
+            "why": why,
+            "failovers": cluster.failovers,
+            "rpc": cluster.transport_stats(),
+            "delivered": len(events),
+            "accounting": cluster.accounting(),
+        }
+        cluster.shutdown_workers()
+        cluster.close()
+        return out
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _partition_verdict(cluster, events, balance_log, sessions,
+                       n_samples, window, hop, *, expect_failovers):
+    """Shared checks: exactly-once delivery, complete delivery (the
+    deterministic per-session window count), single live owner per
+    session, conservation balanced in every observed snapshot."""
+    keys = [(e.session_id, e.event.t_index) for e in events]
+    if len(keys) != len(set(keys)):
+        return "an event was delivered twice"
+    expected = sessions * ((n_samples - window) // hop + 1)
+    lost = expected - len(keys)
+    if lost:
+        return f"{lost} window(s) lost ({len(keys)}/{expected})"
+    owners: dict = {}
+    for sid in range(sessions):
+        holding = [
+            wid
+            for wid, w in cluster._workers.items()
+            if w.owns(sid)
+        ]
+        if len(holding) != 1:
+            return (
+                f"session {sid} owned by {holding!r} — not exactly one "
+                "surviving owner"
+            )
+        owners[sid] = holding[0]
+    acct = cluster.accounting()
+    if not acct["balanced"] or acct["pending"] != 0:
+        return f"conservation violated at the end: {acct}"
+    for i, snap in enumerate(balance_log):
+        if not snap["balanced"]:
+            return f"conservation violated at snapshot {i}: {snap}"
+    if cluster.failovers != expect_failovers:
+        return (
+            f"{cluster.failovers} failover(s) — expected "
+            f"{expect_failovers} (a partition is not a death)"
+        )
+    return None
+
+
+def _run_split_brain(*, workers, sessions, seed, n_samples, window,
+                     hop) -> dict:
+    """Split brain: controller A (the deposed leader) crashes inside a
+    planned hand-off — the adopt is durable on the target, the evict
+    never ran on the source — leaving the session LIVE ON TWO WORKERS.
+    Controller B takes over and must resolve to a single owner by the
+    ``handoffs`` generation (the adopted copy wins), then finish the
+    run with zero windows lost."""
+    recordings = _recordings(sessions, n_samples, 3, seed)
+    model = AnalyticDemoModel()
+    root = tempfile.mkdtemp(prefix="har_netsplit_")
+    procs: list = []
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop,
+            target_batch=32, max_delay_ms=0.0,
+        )
+        procs.extend(w.process for w in net_workers)
+        cluster = NetCluster(
+            model, root, _workers=net_workers,
+            config=_net_cluster_config(),
+            loader=lambda ver: model,
+        )
+        for i in range(sessions):
+            cluster.add_session(i)
+        events: list = []
+        cursors = [0] * sessions
+        half = (n_samples // hop // 2) * hop
+        _drive_net_cluster(
+            cluster, recordings, cursors, half, hop, events
+        )
+        # controller A: planned migration of session 0, killed at the
+        # dual-ownership boundary (adopt durable, evict pending)
+        src = cluster.worker_of(0)
+        target = next(
+            wid for wid in cluster._workers if wid != src
+        )
+        plan = KillPlan("mid_handoff", 1)
+        cluster.chaos = plan
+        crashed = False
+        try:
+            cluster.migrate_session(0, target)
+        except SimulatedCrash:
+            crashed = True
+        if not crashed:
+            cluster.shutdown_workers()
+            cluster.close()
+            return {
+                "ok": False, "case": "split_brain",
+                "why": "mid_handoff never fired",
+            }
+        dual = [
+            wid
+            for wid, w in cluster._workers.items()
+            if w.owns(0)
+        ]
+        # controller B: the next lease generation — fresh clients to
+        # the same workers; placement re-derived from actual ownership
+        survivors = [w for w in cluster._workers.values() if w.alive]
+        cluster2 = NetCluster.takeover(
+            model, root, survivors,
+            config=_net_cluster_config(),
+            loader=lambda ver: model,
+        )
+        resolved_owner = cluster2.worker_of(0)
+        balance_log: list = []
+        _drive_net_cluster(
+            cluster2, recordings, cursors, n_samples, hop, events,
+            on_round=lambda c: _safe_accounting(c, balance_log),
+        )
+        why = _partition_verdict(
+            cluster2, events, balance_log, sessions, n_samples,
+            window, hop, expect_failovers=0,
+        )
+        if why is None and len(dual) != 2:
+            why = (
+                f"mid_handoff crash left session 0 on {dual!r}, "
+                "not two workers — the split never happened"
+            )
+        if why is None and resolved_owner != target:
+            why = (
+                f"generation resolution kept {resolved_owner!r}, not "
+                f"the adopter {target!r} (higher handoffs generation)"
+            )
+        out = {
+            "ok": why is None,
+            "case": "split_brain",
+            "why": why,
+            "dual_owners": dual,
+            "resolved_owner": resolved_owner,
+            "delivered": len(events),
+            "accounting": cluster2.accounting(),
+        }
+        cluster2.shutdown_workers()
+        cluster2.close()
+        cluster.close()
+        return out
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)

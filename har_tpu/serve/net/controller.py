@@ -1,0 +1,271 @@
+"""``NetCluster`` — the FleetCluster control plane over real sockets.
+
+The controller logic is INHERITED, not rewritten: ``FleetCluster``
+already speaks only the worker surface (PR 13 refactored every
+``worker.server.<attr>`` poke into a worker method), so running over
+the wire is "construct it with ``NetWorker``s".  What this subclass
+adds is the transport's bookkeeping:
+
+  - ``net_stats`` — one ``FleetStats`` receiving the controller-side
+    transport counters (``rpc_sent`` / ``rpc_retries`` /
+    ``rpc_bytes_tx/rx`` + the ``rpc_rtt`` histogram) from every
+    worker's RPC client;
+  - honest refusals for the in-process-only surfaces
+    (``observe_drift`` maps over live ``FleetServer`` objects;
+    ``add_worker`` builds one — neither exists on this side of a
+    socket yet);
+  - worker-process lifecycle helpers (``shutdown_workers``).
+
+Failover is the inherited path verbatim: the dead worker's journal
+directory is restored LOCALLY (loopback deployment = shared
+filesystem; the journal is the hand-off currency exactly as designed)
+and the per-session hand-offs ride the ``adopt`` RPC.  Death needs
+REFUSED connections — ``WorkerTimeout`` never strikes — so a live-but-
+slow worker is never restored out from under itself (the fencing
+argument; see docs/multihost.md).
+
+``launch_workers`` spawns ``har serve-worker`` OS subprocesses on
+loopback ephemeral ports and wraps them in ``NetWorker``s; the ready
+handshake is one JSON line on the child's stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from har_tpu.serve.cluster.controller import ClusterError, FleetCluster
+from har_tpu.serve.net.client import NetWorker
+from har_tpu.serve.stats import FleetStats
+
+
+class NetCluster(FleetCluster):
+    """FleetCluster over NetWorkers.  Construct with
+    ``_workers=[NetWorker, ...]`` (``launch_workers`` builds them);
+    the positional in-process construction path is refused."""
+
+    def __init__(self, model, root, *args, **kwargs):
+        if kwargs.get("_workers") is None:
+            raise ClusterError(
+                "NetCluster needs _workers=[NetWorker, ...] — spawn "
+                "them with har_tpu.serve.net.launch_workers (or "
+                "`har serve-worker`)"
+            )
+        super().__init__(model, root, *args, **kwargs)
+        self.net_stats = FleetStats()
+        for w in self._workers.values():
+            w.bind_stats(self.net_stats)
+
+    def _adopt_worker(self, worker) -> None:
+        super()._adopt_worker(worker)
+        # workers attached after construction (takeover, scale-up)
+        # join the shared transport counters too
+        stats = getattr(self, "net_stats", None)
+        if stats is not None:
+            worker.bind_stats(stats)
+
+    # -------------------------------------- in-process-only surfaces
+
+    def observe_drift(self, trigger) -> None:
+        raise ClusterError(
+            "observe_drift maps over in-process FleetServers; the "
+            "wire transport does not carry drift reports yet — run "
+            "the adaptation loop per worker or in-process"
+        )
+
+    def add_worker(self, worker_id=None, *, rebalance: bool = False):
+        raise ClusterError(
+            "NetCluster cannot build a worker in-process; spawn one "
+            "with `har serve-worker` / launch_workers and attach it "
+            "via attach_worker()"
+        )
+
+    @classmethod
+    def resume(cls, *args, **kwargs):
+        raise ClusterError(
+            "whole-node resume restores in-process workers; over the "
+            "wire, restart the worker processes (har serve-worker "
+            "--resume) and NetCluster.takeover the survivors"
+        )
+
+    def attach_worker(self, worker: NetWorker, *, rebalance: bool = False):
+        """Scale up with an already-running worker process; with
+        ``rebalance`` the sessions its ring arcs now own migrate over
+        (the inherited drain → hand-off → resume rails)."""
+        self._adopt_worker(worker)
+        if rebalance:
+            self.rebalance()
+        return worker.worker_id
+
+    # ------------------------------------------------------ reporting
+
+    def transport_stats(self) -> dict:
+        """Controller-side RPC counters: calls, retries, bytes, rtt."""
+        s = self.net_stats
+        return {
+            "rpc_sent": s.rpc_sent,
+            "rpc_retries": s.rpc_retries,
+            "rpc_bytes_tx": s.rpc_bytes_tx,
+            "rpc_bytes_rx": s.rpc_bytes_rx,
+            "rpc_rtt_p50_ms": s.rpc_rtt.percentile(50),
+            "rpc_rtt_p99_ms": s.rpc_rtt.percentile(99),
+        }
+
+    # ------------------------------------------------------ lifecycle
+
+    def shutdown_workers(self, timeout_s: float = 5.0) -> None:
+        """Ask every live worker process to exit cleanly and reap the
+        subprocess handles this controller launched."""
+        for w in self._workers.values():
+            if w.alive:
+                w.shutdown()
+        deadline = time.monotonic() + timeout_s
+        for w in self._workers.values():
+            proc = w.process
+            if proc is None:
+                continue
+            try:
+                proc.wait(max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+def launch_workers(
+    root: str,
+    n: int,
+    *,
+    model: str = "demo",
+    window: int = 200,
+    hop: int = 200,
+    channels: int = 3,
+    smoothing: str = "ema",
+    max_sessions: int = 4096,
+    target_batch: int = 32,
+    max_delay_ms: float = 0.0,
+    retries: int = 1,
+    flush_every: int = 512,
+    snapshot_every: int = 40,
+    deadline_s: float = 2.0,
+    probe_deadline_s: float = 0.25,
+    rpc_retries: int = 2,
+    max_idle_s: float = 120.0,
+    chaos_worker: str | None = None,
+    chaos_point: str | None = None,
+    chaos_at: int = 1,
+    stats: FleetStats | None = None,
+    ready_timeout_s: float = 30.0,
+) -> list[NetWorker]:
+    """Spawn ``n`` ``har serve-worker`` subprocesses under ``root`` (one
+    journal directory each, ``root/wK``) on loopback ephemeral ports
+    and return their ``NetWorker`` proxies.  ``chaos_worker`` names the
+    one worker started with ``--chaos-point`` (the wire chaos matrix's
+    victim).  Each child's stderr is captured to
+    ``<journal_dir>/worker.stderr.log`` for post-mortems."""
+    os.makedirs(root, exist_ok=True)
+    workers: list[NetWorker] = []
+    procs: list[tuple[str, str, subprocess.Popen]] = []
+    try:
+        for i in range(int(n)):
+            wid = f"w{i}"
+            jdir = os.path.join(root, wid)
+            os.makedirs(jdir, exist_ok=True)
+            cmd = [
+                sys.executable, "-m", "har_tpu.serve.net.worker",
+                "--worker-id", wid,
+                "--journal", jdir,
+                "--model", model,
+                "--window", str(window),
+                "--hop", str(hop),
+                "--channels", str(channels),
+                "--smoothing", smoothing,
+                "--max-sessions", str(max_sessions),
+                "--target-batch", str(target_batch),
+                "--max-delay-ms", str(max_delay_ms),
+                "--retries", str(retries),
+                "--flush-every", str(flush_every),
+                "--snapshot-every", str(snapshot_every),
+                "--max-idle-s", str(max_idle_s),
+            ]
+            if chaos_point is not None and wid == chaos_worker:
+                cmd += [
+                    "--chaos-point", chaos_point,
+                    "--chaos-at", str(chaos_at),
+                ]
+            err = open(os.path.join(jdir, "worker.stderr.log"), "wb")
+            try:
+                proc = subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=err,
+                    text=True,
+                )
+            finally:
+                err.close()
+            procs.append((wid, jdir, proc))
+        for wid, jdir, proc in procs:
+            ready = _read_ready_line(proc, wid, jdir, ready_timeout_s)
+            workers.append(
+                NetWorker(
+                    wid,
+                    ready["host"],
+                    ready["port"],
+                    jdir,
+                    deadline_s=deadline_s,
+                    probe_deadline_s=probe_deadline_s,
+                    retries=rpc_retries,
+                    stats=stats,
+                    process=proc,
+                )
+            )
+        return workers
+    except BaseException:
+        for _, _, proc in procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        raise
+
+
+def _read_ready_line(proc, wid, jdir, timeout_s: float) -> dict:
+    """One JSON handshake line from the child's stdout; a child that
+    dies or stalls before it is a launch failure with its stderr tail
+    attached — never a hang."""
+    import selectors
+
+    deadline = time.monotonic() + timeout_s
+    line = ""
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    try:
+        while time.monotonic() < deadline:
+            if sel.select(0.1):
+                line = proc.stdout.readline()
+                break
+            if proc.poll() is not None:
+                break
+    finally:
+        sel.close()
+    if not line:
+        tail = ""
+        try:
+            with open(
+                os.path.join(jdir, "worker.stderr.log"), "rb"
+            ) as f:
+                tail = f.read()[-800:].decode(errors="replace")
+        except OSError:
+            pass
+        raise ClusterError(
+            f"worker {wid!r} never printed its ready line "
+            f"(rc={proc.poll()}); stderr tail: {tail}"
+        )
+    try:
+        return json.loads(line)
+    except ValueError:
+        raise ClusterError(
+            f"worker {wid!r} printed a garbled ready line: {line!r}"
+        )

@@ -1,0 +1,214 @@
+"""The release gate's wire-failover check + the bench lane measurement.
+
+``wire_failover_smoke``: three REAL subprocess workers on loopback
+TCP, one SIGKILLed mid-dispatch (an actual ``Process.kill`` — not a
+shim), and the protocol must do the whole job on real clocks: refused
+connections strike the prober, the lease expires, the partition
+restores from its journal and migrates to the survivors over the
+adopt RPC.  The verdict demands exactly-once delivery of every window
+the un-killed schedule would have produced (``windows_lost == 0`` —
+the expected count is deterministic), global conservation, and one
+failover; the gate stamps ``{workers, transport, failover_ms,
+windows_lost}`` into ``artifacts/test_gate.json``.
+
+``wire_failover_benchmark`` is the same run instrumented per fleet
+size for bench.py's ``wire_failover`` lane: failover wall time plus
+the controller-side ``rpc_rtt`` p50/p99 — the comms term the
+Spark-perf study (arXiv 1612.01437) says dominates once workers leave
+shared memory, measured instead of assumed, against the in-process
+``cluster_failover`` lane as the shared-memory baseline.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+from har_tpu.serve.cluster.membership import WorkerUnavailable
+from har_tpu.serve.net.chaos import (
+    _drive_net_cluster,
+    _net_cluster_config,
+    _safe_accounting,
+    predicted_owner,
+)
+from har_tpu.serve.net.controller import NetCluster, launch_workers
+
+
+def _run_wire_failover(
+    sessions: int, workers: int, seed: int, n_samples: int,
+    window: int = 100, hop: int = 50,
+) -> dict:
+    """One measured wire-failover run: drive, kill the victim process
+    once windows are flowing, let the protocol finish, verdict."""
+    from har_tpu.serve.chaos import _recordings
+    from har_tpu.serve.loadgen import AnalyticDemoModel
+
+    model = AnalyticDemoModel()
+    victim = predicted_owner(0, workers)
+    root = tempfile.mkdtemp(prefix="har_wire_smoke_")
+    procs: dict = {}
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop,
+            target_batch=32, max_delay_ms=0.0,
+        )
+        procs = {w.worker_id: w.process for w in net_workers}
+        cluster = NetCluster(
+            model, root, _workers=net_workers,
+            config=_net_cluster_config(),
+            loader=lambda ver: model,
+        )
+        for i in range(sessions):
+            cluster.add_session(i)
+        recordings = _recordings(sessions, n_samples, 3, seed)
+        events: list = []
+        balance_log: list = []
+        killed = {"t": None}
+
+        def on_round(c):
+            if killed["t"] is None:
+                try:
+                    scored = c.accounting()["scored"]
+                except WorkerUnavailable:
+                    return
+                if scored > 0:
+                    procs[victim].kill()  # a real SIGKILL
+                    killed["t"] = time.perf_counter()
+                return
+            _safe_accounting(c, balance_log)
+
+        _drive_net_cluster(
+            cluster, recordings, [0] * sessions, n_samples, hop,
+            events, on_round,
+        )
+        wall_failover_ms = (
+            None
+            if killed["t"] is None
+            else (time.perf_counter() - killed["t"]) * 1e3
+        )
+        stats = cluster.cluster_stats()
+        acct = stats["accounting"]
+        keys = {(e.session_id, e.event.t_index) for e in events}
+        expected = sessions * ((n_samples - window) // hop + 1)
+        why = None
+        if killed["t"] is None:
+            why = "the victim was never killed (no windows scored?)"
+        elif len(keys) != len(events):
+            why = "an event was delivered twice across the kill"
+        elif len(keys) != expected:
+            why = f"{expected - len(keys)} window(s) lost"
+        elif not acct["balanced"] or acct["pending"] != 0:
+            why = f"conservation violated: {acct}"
+        elif stats["failovers"] != 1:
+            why = f"failovers == {stats['failovers']}, expected 1"
+        elif any(not s["balanced"] for s in balance_log):
+            why = "conservation violated in a per-round snapshot"
+        out = {
+            "ok": why is None,
+            "why": why,
+            "sessions": int(sessions),
+            # the LAUNCHED fleet size (the bench lane's semantics for
+            # this key); the post-failover census rides alongside
+            "workers": int(workers),
+            "surviving_workers": stats["workers"],
+            "transport": "tcp",
+            "failovers": stats["failovers"],
+            "migrated_sessions": max(
+                stats["migrated_sessions"], stats["migrations"]
+            ),
+            # restore + drain + hand-offs (the control plane's own
+            # work), and the wall time from the SIGKILL to the drive
+            # settling — detection latency included
+            "failover_ms": round(stats["failover_ms"], 3),
+            "detect_to_settle_ms": (
+                None
+                if wall_failover_ms is None
+                else round(wall_failover_ms, 1)
+            ),
+            "windows_lost": max(expected - len(keys), 0),
+            "rpc": cluster.transport_stats(),
+        }
+        cluster.shutdown_workers()
+        cluster.close()
+        return out
+    finally:
+        # a failed run must not leak worker processes, and the rmtree
+        # must never delete the root under live writers (clean exits
+        # already reaped: kill is a no-op on an exited process)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def wire_failover_smoke(
+    sessions: int = 18, workers: int = 3, seed: int = 0
+) -> dict:
+    """Gate verdict: one wire failover run reshaped into the gate-log
+    stamp (keys pinned by tests/test_release_gate.py)."""
+    out = _run_wire_failover(sessions, workers, seed, n_samples=300)
+    return {
+        "ok": out["ok"],
+        "why": out["why"],
+        "sessions": out["sessions"],
+        "workers": out["workers"],
+        "transport": out["transport"],
+        "failover_ms": out["failover_ms"],
+        "windows_lost": out["windows_lost"],
+        "rpc_rtt_p50_ms": out["rpc"]["rpc_rtt_p50_ms"],
+        "rpc_retries": out["rpc"]["rpc_retries"],
+    }
+
+
+def wire_failover_benchmark(
+    session_counts,
+    n_runs: int = 3,
+    *,
+    workers: int = 3,
+    seed: int = 0,
+    n_samples: int = 300,
+) -> list[dict]:
+    """bench.py's ``wire_failover`` lane rows: per fleet size, median
+    failover wall time over the REAL transport plus the rpc_rtt
+    distribution, ``contract_ok`` pinning the conservation + complete-
+    delivery verdict on every measured run."""
+    rows = []
+    for n_sessions in session_counts:
+        times, rtt50, rtt99, migrated, ok = [], [], [], 0, True
+        for r in range(int(n_runs)):
+            out = _run_wire_failover(
+                int(n_sessions), workers, seed + r, n_samples
+            )
+            ok = ok and out["ok"]
+            times.append(out["failover_ms"])
+            if out["rpc"]["rpc_rtt_p50_ms"] is not None:
+                rtt50.append(out["rpc"]["rpc_rtt_p50_ms"])
+                rtt99.append(out["rpc"]["rpc_rtt_p99_ms"])
+            migrated = out["migrated_sessions"]
+        rows.append(
+            {
+                "n_sessions": int(n_sessions),
+                "workers": int(workers),
+                "transport": "tcp",
+                "migrated_sessions": int(migrated),
+                "failover_ms_median": round(float(np.median(times)), 3),
+                "failover_ms_std": round(float(np.std(times)), 3),
+                "rpc_rtt_p50_ms": (
+                    round(float(np.median(rtt50)), 4) if rtt50 else None
+                ),
+                "rpc_rtt_p99_ms": (
+                    round(float(np.median(rtt99)), 4) if rtt99 else None
+                ),
+                "contract_ok": ok,
+            }
+        )
+    return rows
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(wire_failover_smoke()))

@@ -94,11 +94,15 @@ class StageHistogram:
     def snapshot(self) -> dict:
         if not self.count:
             return {"count": 0}
+        # a freshly restored histogram has durable counts but an empty
+        # trailing window (percentiles restart after recovery, by
+        # design) — report None, never crash the snapshot
+        p50, p99 = self.percentile(50), self.percentile(99)
         out = {
             "count": self.count,
             "mean_ms": round(self.total_ms / self.count, 4),
-            "p50_ms": round(self.percentile(50), 4),
-            "p99_ms": round(self.percentile(99), 4),
+            "p50_ms": None if p50 is None else round(p50, 4),
+            "p99_ms": None if p99 is None else round(p99, 4),
             "max_ms": round(self.max_ms, 4),
         }
         # sparse bucket view: only non-empty buckets, keyed by upper
@@ -259,6 +263,16 @@ class FleetStats:
         self.scale_ups = 0
         self.scale_downs = 0
         self.utilization = 0.0  # harlint: ephemeral
+        # wire transport (har_tpu.serve.net): RPC round trips issued,
+        # deadline-exceeded re-attempts, and bytes moved each way —
+        # the comms/serialization term the Spark-perf study says
+        # dominates off-box (arXiv 1612.01437), measured not assumed.
+        # Worker-side RpcServers and controller-side RpcClients count
+        # into their own FleetStats with the same field names.
+        self.rpc_sent = 0
+        self.rpc_retries = 0
+        self.rpc_bytes_tx = 0
+        self.rpc_bytes_rx = 0
         # forward-compat guard (the runtime half of harlint HL002):
         # state keys a NEWER writer persisted that this version does
         # not know — counted and warned in load_state, never silently
@@ -269,6 +283,10 @@ class FleetStats:
         self.smooth = StageHistogram()
         self.event = StageHistogram()
         self.shadow = StageHistogram()
+        # one RPC round-trip latency histogram (controller side: call
+        # issue -> response decoded; the wire_failover bench lane's
+        # p50/p99 source)
+        self.rpc_rtt = StageHistogram()
 
     # ------------------------------------------------------- recording
 
@@ -378,6 +396,10 @@ class FleetStats:
             "worker_failovers": self.worker_failovers,
             "migrations": self.migrations,
             "migration_ms": round(self.migration_ms, 3),
+            "rpc_sent": self.rpc_sent,
+            "rpc_retries": self.rpc_retries,
+            "rpc_bytes_tx": self.rpc_bytes_tx,
+            "rpc_bytes_rx": self.rpc_bytes_rx,
             "resizes": self.resizes,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
@@ -401,6 +423,7 @@ class FleetStats:
                 "smooth_ms": self.smooth.snapshot(),
                 "event_ms": self.event.snapshot(),
                 "shadow_ms": self.shadow.snapshot(),
+                "rpc_rtt_ms": self.rpc_rtt.snapshot(),
             },
         }
 
@@ -416,9 +439,12 @@ class FleetStats:
         "worker_failovers", "migrations",
         "resizes", "scale_ups", "scale_downs",
         "fused_dispatches", "fetch_bytes", "fetch_bytes_saved",
+        "rpc_sent", "rpc_retries", "rpc_bytes_tx", "rpc_bytes_rx",
         "unknown_state_keys",
     )
-    _STAGES = ("queue_wait", "dispatch", "smooth", "event", "shadow")
+    _STAGES = (
+        "queue_wait", "dispatch", "smooth", "event", "shadow", "rpc_rtt"
+    )
     # the state() envelope: every top-level key a state dict may carry.
     # load_state counts anything outside this set (or outside
     # _COUNTERS/_STAGES within it) as an unknown key and warns.

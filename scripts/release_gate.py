@@ -255,6 +255,16 @@ def _elastic_smoke() -> dict:
     )
 
 
+def _wire_smoke() -> dict:
+    """Wire-failover smoke verdict (PR 13, har_tpu.serve.net): three
+    REAL subprocess workers on loopback TCP, one SIGKILLed
+    mid-dispatch — refused-connection evidence, lease expiry, journal
+    restore and adopt-RPC migration all on real clocks; the stamp
+    carries ``{workers, transport, failover_ms, windows_lost}`` plus
+    the controller-side rpc rtt/retries."""
+    return _run_smoke("har_tpu.serve.net.smoke", "wire_failover_smoke")
+
+
 def _host_plane_smoke() -> dict:
     """Host-plane smoke verdict (PR 12, the SoA session estate):
     batched-vs-sequential ingest bit-identity at N=64 with mid-chunk
@@ -390,6 +400,7 @@ def main(argv=None) -> int:
     elastic = None
     harlint = None
     host_plane = None
+    wire = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -405,6 +416,7 @@ def main(argv=None) -> int:
             elastic = prior.get("elastic_smoke")
             harlint = prior.get("harlint")
             host_plane = prior.get("host_plane")
+            wire = prior.get("wire_failover")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -414,6 +426,7 @@ def main(argv=None) -> int:
             elastic = None
             harlint = None
             host_plane = None
+            wire = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -525,6 +538,18 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # wire gate: 3 subprocess workers on loopback, one process
+        # SIGKILLed mid-dispatch — the protocol alone must detect,
+        # restore and migrate with zero windows lost, stamping
+        # {workers, transport, failover_ms, windows_lost}
+        wire = _wire_smoke()
+        if not wire.get("ok"):
+            print(
+                "\nrelease_gate: RED wire failover smoke "
+                f"({json.dumps(wire)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -542,6 +567,7 @@ def main(argv=None) -> int:
                 "cluster_failover": cluster,
                 "elastic_smoke": elastic,
                 "host_plane": host_plane,
+                "wire_failover": wire,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -573,6 +599,9 @@ def main(argv=None) -> int:
                 ),
                 "host_plane_ok": (
                     None if host_plane is None else host_plane["ok"]
+                ),
+                "wire_failover_ok": (
+                    None if wire is None else wire["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

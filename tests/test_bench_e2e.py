@@ -84,8 +84,8 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
-        "fleet_recovery", "cluster_failover", "elastic_traffic",
-        "host_plane_scaling",
+        "fleet_recovery", "cluster_failover", "wire_failover",
+        "elastic_traffic", "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -199,6 +199,27 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == failover["failover_ms_median"]
         )
         assert extra["cluster_failover_contract_ok"] is True
+    # r17 wire-failover lane: the same one-worker-dies measurement
+    # over REAL subprocess workers + loopback TCP — failover wall time
+    # plus the controller-side rpc_rtt p50/p99, contract_ok pinning
+    # exactly-once + complete delivery + conservation per measured
+    # run; or a deadline-skip marker; never silently absent
+    wire = extra["lanes"]["wire_failover"]
+    if "skipped" not in wire:
+        assert wire["transport"] == "tcp"
+        assert wire["contract_ok"] is True
+        assert wire["failover_ms_median"] > 0
+        assert wire["rpc_rtt_p50_ms"] is not None
+        for row in wire["rows"]:
+            assert row["workers"] == 3
+            assert row["migrated_sessions"] > 0
+            assert row["contract_ok"] is True
+        assert "chip_state_probe" in wire
+        assert (
+            extra["wire_failover_ms_median"]
+            == wire["failover_ms_median"]
+        )
+        assert extra["wire_failover_contract_ok"] is True
     # r14 elastic-traffic lane: the autoscaled diurnal swing vs the
     # static floor/ceiling configurations under the deterministic
     # dispatch-cost model — the adaptive run must beat the best static

@@ -1412,6 +1412,80 @@ def test_hl004_real_registry_wall_clock_is_a_reviewed_contract():
     assert "stored/passed as a callable" in findings[0].message
 
 
+# ------------------------------------------ HL004 wall-clock allowlist
+
+
+def test_hl004_net_wallclock_allowlist_scope():
+    """PR-13 satellite: ``har_tpu/serve/net/`` is the DECLARED
+    wall-clock scope (real transport deadlines, the cross-process
+    leader lease) — the wall-clock findings are path-scoped off there,
+    while the RNG/set-iteration findings still apply inside it."""
+    src = """
+import random
+import time
+
+class Lease:
+    def __init__(self, wall=None):
+        self._wall = wall or time.time      # callable ref
+
+    def expires(self):
+        return time.time() + 1.0            # direct call
+
+    def jitter(self, peers):
+        bad = random.random()               # still illegal in net/
+        for p in {x for x in peers}:        # still illegal in net/
+            pass
+        return bad
+"""
+    net = lint_sources(
+        {"har_tpu/serve/net/election2.py": src}, [DeterminismRule()]
+    )
+    msgs = " | ".join(f.message for f in net)
+    # wall clocks: allowed here; RNG + set iteration: still findings
+    assert "wall-clock" not in msgs and "wall clock" not in msgs
+    assert "random." in msgs
+    assert "iterating a set" in msgs
+    assert len(net) == 2
+    # the SAME source anywhere else in serve/ flags all four
+    eng = lint_sources(
+        {"har_tpu/serve/lease_helper.py": src}, [DeterminismRule()]
+    )
+    assert len(eng) == 4
+
+
+def test_hl004_acceptance_mutation_planted_wall_clock_in_real_engine():
+    """THE satellite acceptance mutation: the allowlist must not have
+    widened the gate — a ``time.time()`` planted in the REAL
+    ``serve/engine.py`` still fails, while the REAL net transport
+    sources (which live on wall deadlines) lint clean."""
+    real = (REPO / "har_tpu" / "serve" / "engine.py").read_text()
+    assert lint_sources(
+        {"har_tpu/serve/engine.py": real}, [DeterminismRule()]
+    ) == []
+    anchor = "    def poll(self, *, force: bool = False)"
+    assert anchor in real, "engine.py poll anchor changed"
+    planted = real.replace(
+        anchor,
+        "    def _wall_now(self):\n"
+        "        return time.time()\n\n" + anchor,
+        1,
+    )
+    findings = lint_sources(
+        {"har_tpu/serve/engine.py": planted}, [DeterminismRule()]
+    )
+    assert len(findings) == 1
+    assert "`time.time()` call" in findings[0].message
+    # the real transport sources: wall clocks by declared design,
+    # zero determinism findings
+    for rel in (
+        "har_tpu/serve/net/rpc.py",
+        "har_tpu/serve/net/election.py",
+        "har_tpu/serve/net/chaos.py",
+    ):
+        src = (REPO / rel).read_text()
+        assert lint_sources({rel: src}, [DeterminismRule()]) == [], rel
+
+
 # ------------------------------------------- baseline property + CLI
 
 

@@ -833,6 +833,57 @@ def test_stats_cluster_counters_roundtrip_and_pre_cluster_defaults():
     assert s3.accounting()["balanced"]
 
 
+def test_stats_rpc_counters_roundtrip_and_pre_net_defaults():
+    """The wire-transport counters (rpc_sent, rpc_retries,
+    rpc_bytes_tx/rx) and the rpc_rtt stage histogram round-trip
+    through state()/load_state, and a PRE-NET state dict missing them
+    entirely loads with zero defaults and no unknown-key warning —
+    both directions pinned (HL002's runtime contract, PR-13
+    satellite)."""
+    s = FleetStats()
+    s.enqueued = 3
+    s.note_scored(3, "v1")
+    s.rpc_sent = 41
+    s.rpc_retries = 2
+    s.rpc_bytes_tx = 9000
+    s.rpc_bytes_rx = 4500
+    s.rpc_rtt.record(0.8)
+    s.rpc_rtt.record(12.5)
+    state = json.loads(json.dumps(s.state()))
+    s2 = FleetStats()
+    s2.load_state(state)
+    assert s2.rpc_sent == 41
+    assert s2.rpc_retries == 2
+    assert s2.rpc_bytes_tx == 9000
+    assert s2.rpc_bytes_rx == 4500
+    assert s2.rpc_rtt.count == 2
+    assert s2.rpc_rtt.total_ms == s.rpc_rtt.total_ms
+    snap = s2.snapshot()
+    assert snap["rpc_sent"] == 41
+    assert snap["rpc_retries"] == 2
+    assert snap["rpc_bytes_tx"] == 9000
+    assert snap["rpc_bytes_rx"] == 4500
+    assert snap["stages"]["rpc_rtt_ms"]["count"] == 2
+    # pre-net state: counters AND the rpc_rtt stage absent entirely —
+    # zero defaults, no unknown-key warning in either direction
+    old = json.loads(json.dumps(state))
+    for k in ("rpc_sent", "rpc_retries", "rpc_bytes_tx", "rpc_bytes_rx"):
+        old["counters"].pop(k)
+    old["stages"].pop("rpc_rtt")
+    s3 = FleetStats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        s3.load_state(old)
+    assert s3.rpc_sent == 0
+    assert s3.rpc_retries == 0
+    assert s3.rpc_bytes_tx == 0
+    assert s3.rpc_bytes_rx == 0
+    assert s3.rpc_rtt.count == 0
+    assert s3.accounting()["balanced"]
+
+
 def test_stats_elastic_counters_roundtrip_and_pre_elastic_defaults():
     """The elastic-capacity counters (resizes, scale_ups, scale_downs)
     round-trip through state()/load_state, and a pre-elastic state dict

@@ -194,6 +194,29 @@ def test_gate_log_carries_cluster_failover_verdict():
     assert cluster["migration_ms"] >= 0
 
 
+def test_gate_log_carries_wire_failover_verdict():
+    """The wire counterpart of the cluster verdict (PR 13,
+    har_tpu.serve.net): the gate log must carry a green wire-failover
+    check with the {workers, transport, failover_ms, windows_lost}
+    stamp — three REAL subprocess workers on loopback TCP, one process
+    SIGKILLed mid-dispatch, detection/restore/migration on real clocks
+    via the protocol alone, zero windows lost."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    wire = log.get("wire_failover")
+    assert wire, (
+        "artifacts/test_gate.json lacks the wire_failover verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in ("workers", "transport", "failover_ms", "windows_lost"):
+        assert key in wire
+    assert wire["ok"] is True
+    assert wire["transport"] == "tcp"
+    assert wire["windows_lost"] == 0
+    assert wire["failover_ms"] >= 0
+
+
 def test_gate_log_carries_elastic_smoke_verdict():
     """The elastic counterpart of the cluster verdict: the gate log
     must carry a green elastic-traffic check with the {swing, resizes,
