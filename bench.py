@@ -1331,17 +1331,22 @@ def main() -> None:
         session_counts = [64, 128] if smoke else [1000, 4000]
         rows = host_plane_benchmark(session_counts, n_runs=lane_runs)
         baseline_rows = None
+        budget = None
         try:
             committed = json.loads(
                 (pathlib.Path("artifacts") / "host_plane_scaling.json")
                 .read_text()
             )
             baseline_rows = committed.get("baseline_rows")
+            # the chain's carried equal-p99 budget (the PR-10 1k-session
+            # operating point) — same yardstick as the artifact script
+            budget = committed.get("p99_budget_ms")
         except (OSError, ValueError):
             pass
         stats = host_plane_summary(
             rows, lane_runs,
             baseline_rows=None if smoke else baseline_rows,
+            p99_budget_ms=None if smoke else budget,
         )
         stats["chip_state_probe"] = chip_probe
         return None, stats

@@ -4,10 +4,12 @@
 Public surface:
   FleetServer / FleetConfig / FleetEvent  — the engine (engine.py)
   FleetStats / HostProfile                — observability (stats.py)
-  SessionArena                            — structure-of-arrays session
-                                            estate (arena.py): rings,
-                                            heads/fills, smoother state
-                                            and counters as contiguous
+  SessionArena / PendingArena             — structure-of-arrays session
+                                            + pending-queue estates
+                                            (arena.py): rings, heads/
+                                            fills, smoother state,
+                                            counters and the queued-
+                                            window FIFO as contiguous
                                             slot-indexed arrays
   DispatchTicket / StagingArena / make_scorer — pipelined dispatch
                                             plane (dispatch.py)
@@ -57,7 +59,7 @@ from har_tpu.serve.chaos import (
     run_kill_point,
     run_random_kill,
 )
-from har_tpu.serve.arena import SessionArena
+from har_tpu.serve.arena import PendingArena, SessionArena
 from har_tpu.serve.dispatch import (
     DispatchTicket,
     StagingArena,
@@ -144,6 +146,7 @@ __all__ = [
     "KILL_POINTS",
     "KillPlan",
     "LoadReport",
+    "PendingArena",
     "RecoveryError",
     "SessionArena",
     "SimulatedCrash",

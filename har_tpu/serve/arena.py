@@ -37,15 +37,33 @@ sessions per worker" item:
     classifiers across smoothing modes, chunkings, churn and ring
     depths 1–4).
 
-What stays per-object, deliberately: ``_Pending`` queue entries (they
-carry cross-references the drop/retire bookkeeping needs), drift
-monitors (their state is per-session objects; their EWMA update is
-batched via ``DriftMonitor.update_many`` instead), and the
-``_FleetSession`` handle itself (a slot-carrying façade whose counter
-attributes read through to the arena).  Snapshots serialize slots BACK
-to the per-session layout (``ring{i}`` / ``ema{i}`` arrays, per-session
-metadata dicts), so the on-disk journal format is unchanged and
-pre-SoA snapshots restore cleanly — test-pinned.
+  ``PendingArena`` — the queued-window estate in the same SoA form
+    (PR 14; PR 11 deliberately left it per-object).  One completed,
+    not-yet-scored window is a SLOT into parallel arrays — owning
+    session's arena slot, ``t_index``, staging slot, enqueue clock,
+    drift flag, ``dropped``/``launched`` bitmasks, a ``next_idx``
+    link — plus the global FIFO as an index RING over those slots.
+    Each session's pending view is the ``next_idx`` linked list hung
+    off the session arena's ``pend_head``/``pend_tail`` columns, so
+    enqueue, due-selection, batch assembly, shed-stalest walks,
+    ``remove_session`` drop-flagging and retire unlinking are all
+    array operations with zero per-window Python object allocation
+    (test-pinned by an object-census test).  A slot is recycled when
+    its two references — the ring-or-ticket one and the session-list
+    one — are both released (``refs`` starts at 2; flagging a drop
+    releases neither: flagged entries keep their queue position for
+    the FIFO unlink, exactly like the per-object queue did).
+
+What stays per-object, deliberately: drift monitors (their state is
+per-session objects; their EWMA update is batched via
+``DriftMonitor.update_many`` instead), the emitted ``StreamEvent``s
+(they ARE the API), and the ``_FleetSession`` handle itself (a
+slot-carrying façade whose counter attributes read through to the
+arena).  Snapshots serialize slots BACK to the per-session layout
+(``ring{i}`` / ``ema{i}`` arrays, per-session metadata dicts) and the
+pending queue back to the stacked ``pending`` array in global FIFO
+order, so the on-disk journal format is unchanged and pre-SoA
+snapshots restore cleanly — test-pinned.
 """
 
 from __future__ import annotations
@@ -102,6 +120,12 @@ class SessionArena:
         self.ema: np.ndarray | None = None
         self.ema_set = np.zeros(capacity, bool)
         self.ema_local = np.zeros(capacity, bool)
+        # per-session pending view (PendingArena): head/tail indices of
+        # the session's next_idx linked list through the pending slots
+        # (-1 = empty) — derived queue state, rebuilt by replay like
+        # the queue itself, never serialized per session
+        self.pend_head = np.full(capacity, -1, np.int64)
+        self.pend_tail = np.full(capacity, -1, np.int64)
         self._free = list(range(capacity - 1, -1, -1))
         self.grows = 0
 
@@ -115,6 +139,7 @@ class SessionArena:
         "rings", "n_seen", "next_emit", "raw_seen", "n_enqueued",
         "n_scored", "n_dropped", "n_live", "handoffs", "votes",
         "vote_len", "vote_head", "ema_set", "ema_local",
+        "pend_head", "pend_tail",
     )
 
     @property
@@ -156,6 +181,11 @@ class SessionArena:
             getattr(self, name)[slot] = 0
         self.ema_set[slot] = False
         self.ema_local[slot] = False
+        # fresh pending view: empty linked list (growth zero-fills the
+        # slot arrays, and 0 is a VALID pending index — the scrub here
+        # is what makes -1 the reliable empty sentinel)
+        self.pend_head[slot] = -1
+        self.pend_tail[slot] = -1
         return slot
 
     def release(self, slot: int) -> None:
@@ -269,6 +299,19 @@ class SessionArena:
         return labels, smoothed
 
     # ------------------------------------------------- observability
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of every slot block (EMA included) — the
+        ``arena_bytes`` footprint gauge's source (the 20k-session point
+        of the scaling curve is partially memory-bound; this is the
+        visibility the ROADMAP asked for)."""
+        total = sum(
+            int(getattr(self, name).nbytes) for name in self._SLOT_ARRAYS
+        )
+        if self.ema is not None:
+            total += int(self.ema.nbytes)
+        return total
 
     def state(self) -> dict:
         """Snapshot-provider payload: geometry + sizing observability,
@@ -457,3 +500,362 @@ class _SlotSmoother(_Smoother):
             # see two distinct EMA states, not the final one twice
             return (out[0], out[1], out[2].copy())
         return out
+
+
+class PendingArena:
+    """Slot-indexed SoA storage for the pending (queued-window) estate.
+
+    One completed, not-yet-scored window is an index into parallel
+    arrays; the global FIFO is an index RING over those slots.  A slot
+    carries exactly what the per-object ``_Pending`` carried — owning
+    session's arena slot, ``t_index``, staging slot, enqueue clock,
+    drift flag, ``dropped``/``launched`` marks — plus the ``next_idx``
+    link that threads each session's pending view (heads/tails live in
+    the session arena's ``pend_head``/``pend_tail`` columns, engine-
+    managed).
+
+    Slot lifetime is reference-counted with exactly TWO references:
+    the queue-side one (the FIFO ring until launch, then the dispatch
+    ticket until retire — launch TRANSFERS it, so the count never
+    moves on the hot path) and the session-list one (released at the
+    retire unlink / lazy dropped-prefix discard / ``remove_session``
+    clear).  Flagging a window ``dropped`` releases neither reference:
+    flagged entries keep their position in both views, exactly the
+    per-object queue's contract, and the slot recycles when the second
+    reference goes (``release`` pushes it back on the free stack).
+
+    Growth is geometric and amortized; steady-state serving allocates
+    nothing per window — enqueue/pop/flag/release are all array writes
+    (the zero-allocation contract is pinned by an object-census test).
+    """
+
+    def __init__(self, capacity: int = 256):
+        capacity = max(int(capacity), 32)
+        # per-slot columns — everything the per-object _Pending carried
+        self.sess_slot = np.full(capacity, -1, np.int64)
+        self.t_index = np.zeros(capacity, np.int64)
+        self.stage_slot = np.zeros(capacity, np.int64)
+        self.t_enqueue = np.zeros(capacity, np.float64)
+        self.drift = np.zeros(capacity, bool)
+        self.dropped = np.zeros(capacity, bool)
+        self.launched = np.zeros(capacity, bool)
+        self.next_idx = np.full(capacity, -1, np.int64)
+        self.refs = np.zeros(capacity, np.uint8)
+        self.grows = 0
+        # free slots as an int stack (array + count): block allocation
+        # is one slice, never a per-slot Python pop
+        self._free = np.arange(capacity - 1, -1, -1, dtype=np.int64)
+        self._n_free = capacity
+        # the global FIFO: a power-of-two circular index ring with
+        # monotonic head/tail counters.  Ring size is bounded by the
+        # slot capacity (a ring entry holds a slot reference), so the
+        # ring grows in step with the slot arrays.
+        self._ring = np.empty(_pow2(capacity), np.int64)
+        self._rhead = 0
+        self._rtail = 0
+
+    # every per-slot column the arena owns — THE table state()/
+    # load_state read, so a field added to __init__ without joining it
+    # trips harlint HL002's state-completeness rule (acceptance
+    # mutation pinned in tests/test_harlint.py; the slot CONTENT
+    # itself is serialized back to the snapshot's stacked ``pending``
+    # array in global FIFO order by the engine, which is what keeps
+    # the on-disk format pre-SoA-compatible)
+    _PENDING_ARRAYS = (
+        "sess_slot", "t_index", "stage_slot", "t_enqueue", "drift",
+        "dropped", "launched", "next_idx", "refs",
+    )
+
+    @property
+    def capacity(self) -> int:
+        return len(self.sess_slot)
+
+    @property
+    def in_use(self) -> int:
+        return len(self.sess_slot) - self._n_free
+
+    @property
+    def queued(self) -> int:
+        """Entries currently in the FIFO ring (dropped-but-unpopped
+        included) — the due-selection view's raw size."""
+        return self._rtail - self._rhead
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of the pending estate (ring + free stack
+        included) — the ``pending_bytes`` footprint gauge's source."""
+        return (
+            sum(
+                int(getattr(self, name).nbytes)
+                for name in self._PENDING_ARRAYS
+            )
+            + int(self._ring.nbytes)
+            + int(self._free.nbytes)
+        )
+
+    # ---------------------------------------------------- slot estate
+
+    def _grow(self, need: int = 0) -> None:
+        cap = self.capacity
+        new_cap = cap * 2
+        while new_cap < need:
+            new_cap *= 2
+        for name in self._PENDING_ARRAYS:
+            old = getattr(self, name)
+            buf = np.zeros(new_cap, old.dtype)
+            buf[:cap] = old
+            setattr(self, name, buf)
+        free = np.empty(new_cap, np.int64)
+        free[: self._n_free] = self._free[: self._n_free]
+        free[self._n_free: self._n_free + new_cap - cap] = np.arange(
+            new_cap - 1, cap - 1, -1
+        )
+        self._free = free
+        self._n_free += new_cap - cap
+        self.grows += 1
+
+    def alloc_block(self, m: int) -> np.ndarray:
+        """Claim ``m`` fresh slots (flags reset, both references held);
+        FIFO position is the caller's job (``ring_extend``)."""
+        if self._n_free < m:
+            self._grow(self.in_use + m)
+        idx = self._free[self._n_free - m: self._n_free].copy()
+        self._n_free -= m
+        self.dropped[idx] = False
+        self.launched[idx] = False
+        self.next_idx[idx] = -1
+        self.refs[idx] = 2
+        return idx
+
+    def add_block(
+        self, sess_slots, t_indices, stage_slots, drifts, now: float
+    ) -> np.ndarray:
+        """Enqueue a block of windows in one shot: claim slots, fill
+        every column, append to the FIFO ring in block order.  The
+        batched ingest's whole-round enqueue — a handful of array
+        writes where the per-object queue ran five Python statements
+        per window."""
+        idx = self.alloc_block(len(sess_slots))
+        self.sess_slot[idx] = sess_slots
+        self.t_index[idx] = t_indices
+        self.stage_slot[idx] = stage_slots
+        self.drift[idx] = drifts
+        self.t_enqueue[idx] = now
+        self.ring_extend(idx)
+        return idx
+
+    def add(
+        self, sess_slot: int, t_index: int, stage_slot, drift: bool,
+        now: float,
+    ) -> int:
+        """Scalar enqueue (the sequential ``push`` / replay path)."""
+        if not self._n_free:
+            self._grow()
+        self._n_free -= 1
+        i = self._free[self._n_free]
+        self.sess_slot[i] = sess_slot
+        self.t_index[i] = t_index
+        self.stage_slot[i] = stage_slot
+        self.t_enqueue[i] = now
+        self.drift[i] = drift
+        self.dropped[i] = False
+        self.launched[i] = False
+        self.next_idx[i] = -1
+        self.refs[i] = 2
+        self._ring_append(i)
+        return int(i)
+
+    def release(self, i: int) -> None:
+        """Drop one reference; recycle the slot when both are gone."""
+        self.refs[i] -= 1
+        if not self.refs[i]:
+            if self._n_free >= len(self._free):  # pragma: no cover
+                raise AssertionError("pending free-stack overflow")
+            self._free[self._n_free] = i
+            self._n_free += 1
+
+    def release_block(self, idx: np.ndarray) -> None:
+        """Vectorized reference drop (the end-of-retire ticket
+        release): one subtract, one mask, one slice write."""
+        if not len(idx):
+            return
+        self.refs[idx] -= 1
+        done = idx[self.refs[idx] == 0]
+        m = len(done)
+        if m:
+            self._free[self._n_free: self._n_free + m] = done
+            self._n_free += m
+
+    # ------------------------------------------------ the FIFO ring
+
+    def _ring_grow(self) -> None:
+        cap = len(self._ring)
+        size = self._rtail - self._rhead
+        buf = np.empty(cap * 2, np.int64)
+        h = self._rhead & (cap - 1)
+        first = min(cap - h, size)
+        buf[:first] = self._ring[h: h + first]
+        buf[first:size] = self._ring[: size - first]
+        self._ring = buf
+        self._rhead = 0
+        self._rtail = size
+
+    def _ring_append(self, i: int) -> None:
+        if self._rtail - self._rhead >= len(self._ring):
+            self._ring_grow()
+        self._ring[self._rtail & (len(self._ring) - 1)] = i
+        self._rtail += 1
+
+    def ring_extend(self, idx: np.ndarray) -> None:
+        m = len(idx)
+        while self._rtail - self._rhead + m > len(self._ring):
+            self._ring_grow()
+        cap = len(self._ring)
+        t = self._rtail & (cap - 1)
+        first = min(cap - t, m)
+        self._ring[t: t + first] = idx[:first]
+        if first < m:
+            self._ring[: m - first] = idx[first:]
+        self._rtail += m
+
+    def ring_indices(self) -> np.ndarray:
+        """The FIFO ring's contents in queue order (dropped-but-
+        unpopped entries included) — the snapshot serializer's and the
+        shed-stalest walk's view.  A fresh array, never a live view."""
+        cap = len(self._ring)
+        size = self._rtail - self._rhead
+        h = self._rhead & (cap - 1)
+        first = min(cap - h, size)
+        out = np.empty(size, np.int64)
+        out[:first] = self._ring[h: h + first]
+        out[first:] = self._ring[: size - first]
+        return out
+
+    def pop_batch(self, target: int) -> np.ndarray:
+        """Pop up to ``target`` LIVE entries off the FIFO head in one
+        vectorized sweep per contiguous ring segment, marking them
+        launched; dropped entries encountered on the way are popped
+        and their queue-side reference released (their session-list
+        reference — and their flagged position there — is untouched,
+        exactly like the per-object pop-and-skip).  Returns the
+        launched indices in FIFO order."""
+        taken: list[np.ndarray] = []
+        got = 0
+        cap = len(self._ring)
+        while got < target and self._rtail > self._rhead:
+            h = self._rhead & (cap - 1)
+            seg = min(
+                cap - h, self._rtail - self._rhead, target - got
+            )
+            chunk = self._ring[h: h + seg].copy()
+            mask = self.dropped[chunk]
+            if mask.any():
+                dead = chunk[mask]
+                self.release_block(dead)
+                chunk = chunk[~mask]
+            self._rhead += seg
+            if len(chunk):
+                self.launched[chunk] = True
+                taken.append(chunk)
+                got += len(chunk)
+        if not taken:
+            return _EMPTY_IDX
+        return taken[0] if len(taken) == 1 else np.concatenate(taken)
+
+    def head_live(self, n: int) -> np.ndarray:
+        """The first ``n`` LIVE indices from the FIFO head, in queue
+        order, WITHOUT popping anything — the shed-stalest walk's
+        view.  Stops as soon as ``n`` are found (one vectorized mask
+        per ring segment), so shedding one window off a deep queue is
+        O(shed + dropped prefix), not O(queue)."""
+        found: list[np.ndarray] = []
+        got = 0
+        cap = len(self._ring)
+        pos = self._rhead
+        while got < n and pos < self._rtail:
+            h = pos & (cap - 1)
+            seg = min(cap - h, self._rtail - pos)
+            chunk = self._ring[h: h + seg]
+            live = chunk[~self.dropped[chunk]]
+            if len(live):
+                found.append(live[: n - got])
+                got += len(found[-1])
+            pos += seg
+        if not found:
+            return _EMPTY_IDX
+        return found[0] if len(found) == 1 else np.concatenate(found)
+
+    def oldest_live_enqueue(self) -> float | None:
+        """Enqueue clock of the FIFO head's oldest live entry (the
+        micro-batcher's deadline input), popping-and-releasing dropped
+        heads on the way — the per-object ``_oldest_live`` as array
+        ops."""
+        cap = len(self._ring)
+        while self._rtail > self._rhead:
+            h = self._rhead & (cap - 1)
+            seg = min(cap - h, self._rtail - self._rhead)
+            chunk = self._ring[h: h + seg]
+            live = np.flatnonzero(~self.dropped[chunk])
+            if len(live):
+                n_dead = int(live[0])
+                if n_dead:
+                    self.release_block(chunk[:n_dead].copy())
+                    self._rhead += n_dead
+                return float(self.t_enqueue[chunk[n_dead]])
+            self.release_block(chunk.copy())
+            self._rhead += seg
+        return None
+
+    # ------------------------------------------------- observability
+
+    def state(self) -> dict:
+        """Snapshot-provider payload: sizing observability only, one
+        entry PER PENDING ARRAY (``_PENDING_ARRAYS``) — the queued
+        windows themselves serialize back to the snapshot's stacked
+        ``pending`` array in global FIFO order (engine snapshot
+        builder), so the on-disk format is unchanged and pre-SoA
+        snapshots restore cleanly.  Deleting a column key from this
+        serializer (the ``_PENDING_ARRAYS`` table) fails the harlint
+        HL002 gate — acceptance mutation pinned in
+        tests/test_harlint.py."""
+        return {
+            "capacity": self.capacity,
+            "in_use": self.in_use,
+            "queued": self.queued,
+            "grows": self.grows,
+            "nbytes": self.nbytes,
+            "arrays": {
+                name: int(getattr(self, name).nbytes)
+                for name in self._PENDING_ARRAYS
+            },
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore the observability gauges.  The columns named in
+        ``_PENDING_ARRAYS`` re-fill through the engine's pending-queue
+        restore path (snapshot ``pending`` rows + push/ack replay);
+        what survives HERE is the cumulative ``grows`` counter —
+        ``capacity``/``in_use``/``queued`` are live allocation
+        properties recomputed by the restored queue itself."""
+        self.grows = int(state.get("grows", 0))
+        unknown = [
+            name
+            for name in (state.get("arrays") or {})
+            if name not in self._PENDING_ARRAYS
+        ]
+        if unknown:
+            import warnings
+
+            warnings.warn(
+                "PendingArena.load_state: unknown pending arrays "
+                f"{sorted(unknown)} — written by a newer version?",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+
+def _pow2(n: int) -> int:
+    return 1 << (max(int(n), 2) - 1).bit_length()
+
+
+_EMPTY_IDX = np.empty(0, np.int64)

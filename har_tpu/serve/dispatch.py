@@ -90,11 +90,18 @@ class DispatchTicket:
     __slots__ = (
         "batch", "k", "pad_k", "windows", "handle", "scorer", "version",
         "t0", "t_inflight0", "t_carried0", "idle_ms", "attempts",
-        "failed", "last_error", "fused", "slab",
+        "failed", "last_error", "fused", "slab", "sids",
     )
 
     def __init__(self, batch, windows, scorer, version, t0, *,
                  fused: bool = False, slab=None):
+        # ``batch`` is the int64 index array of the ticket's pending-
+        # arena slots (har_tpu.serve.arena.PendingArena), in FIFO
+        # order; the ticket owns the queue-side reference on each
+        # until retire releases it.  ``sids`` is the launch-time
+        # session-id snapshot the dispatch tap consumes (captured only
+        # when a tap is installed — retire could not resolve a row
+        # whose session was removed mid-flight).
         self.batch = batch
         self.k = len(batch)
         self.pad_k = len(windows)
@@ -112,6 +119,7 @@ class DispatchTicket:
         # tap read it — and is only recycled after the tap has run)
         self.fused = fused
         self.slab = slab
+        self.sids = None
         # deliberate carry idle (inter-poll span) accumulated before
         # retire: excluded from dispatch_ms, so the SLO ladder never
         # reads the pipeline's own buffering as a slow tunnel
@@ -124,11 +132,28 @@ class DispatchTicket:
 class StagingArena:
     """Contiguous staging storage for queued windows.
 
-    Slots are recycled through a free-list; the block grows
-    geometrically when the queue outruns it (amortized — steady-state
-    serving never reallocates).  ``gather`` is the batch-assembly path:
-    one fancy-index copy out of contiguous storage, replacing the
-    per-window ``np.stack`` of the synchronous engine.
+    Slots recycle through a FIFO free ring (an int index ring, not a
+    Python list): allocation hands out slots in the order retires
+    returned them, which — because enqueue order IS launch order IS
+    retire order in this engine — keeps a delivery round's staged
+    windows CONTIGUOUS in the buffer in steady state.  That contiguity
+    is what the zero-copy batch-assembly fast path rides: ``gather``
+    returns a slice VIEW (no copy at all) and ``gather_into``
+    degenerates to one contiguous block copy (no ``np.take``) whenever
+    the requested slots form an ascending run; fragmented rounds
+    (drops, sheds, churn punch holes in the recycle order) fall back
+    to the scatter-gather path and re-converge on the next cycle.  The
+    block grows geometrically when the queue outruns it (amortized —
+    steady-state serving never reallocates).
+
+    A VIEW handed to a dispatch is only safe because slot frees are
+    retire-ordered: the engine frees a launched window's slot at its
+    ticket's retire (after the blocking fetch — the same ordering the
+    fused slab pool relies on), never mid-flight, so no re-``put`` can
+    rewrite rows an un-fetched device array still aliases (CPU
+    ``device_put`` aliases contiguous f32 buffers).  Growth mid-flight
+    is also safe: the old buffer stays alive — and immutable — behind
+    any view that still references it.
     """
 
     def __init__(self, window: int, channels: int, capacity: int = 512):
@@ -138,7 +163,13 @@ class StagingArena:
         self._buf = np.empty(
             (capacity, self.window, self.channels), np.float32
         )
-        self._free = list(range(capacity - 1, -1, -1))
+        # FIFO free ring: pow2 index buffer, monotonic head/tail
+        self._free = np.empty(
+            1 << (capacity - 1).bit_length(), np.int64
+        )
+        self._free[:capacity] = np.arange(capacity)
+        self._fhead = 0
+        self._ftail = capacity
         self.grows = 0
 
     @property
@@ -147,7 +178,38 @@ class StagingArena:
 
     @property
     def in_use(self) -> int:
-        return len(self._buf) - len(self._free)
+        return len(self._buf) - (self._ftail - self._fhead)
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of the staging block (free ring included) —
+        the ``staging_bytes`` footprint gauge's source."""
+        return int(self._buf.nbytes) + int(self._free.nbytes)
+
+    # ------------------------------------------------- free-slot ring
+
+    def _free_extend(self, slots) -> None:
+        m = len(slots)
+        cap = len(self._free)
+        if self._ftail - self._fhead + m > cap:  # pragma: no cover
+            raise AssertionError("staging free-ring overflow")
+        t = self._ftail & (cap - 1)
+        first = min(cap - t, m)
+        self._free[t: t + first] = slots[:first]
+        if first < m:
+            self._free[: m - first] = slots[first:]
+        self._ftail += m
+
+    def _free_popn(self, m: int) -> np.ndarray:
+        cap = len(self._free)
+        h = self._fhead & (cap - 1)
+        first = min(cap - h, m)
+        out = np.empty(m, np.int64)
+        out[:first] = self._free[h: h + first]
+        if first < m:
+            out[first:] = self._free[: m - first]
+        self._fhead += m
+        return out
 
     def _grow(self, need: int = 0) -> None:
         """Double the block — or jump straight past ``need`` total
@@ -161,69 +223,159 @@ class StagingArena:
         buf = np.empty((new_cap, self.window, self.channels), np.float32)
         buf[:cap] = self._buf
         self._buf = buf
-        self._free.extend(range(new_cap - 1, cap - 1, -1))
+        n_free = self._ftail - self._fhead
+        free = np.empty(1 << (new_cap - 1).bit_length(), np.int64)
+        if n_free:
+            free[:n_free] = self._free_popn(n_free)
+        free[n_free: n_free + new_cap - cap] = np.arange(cap, new_cap)
+        self._free = free
+        self._fhead = 0
+        self._ftail = n_free + new_cap - cap
         self.grows += 1
 
     def put(self, window: np.ndarray) -> int:
         """Stage one ``(window, channels)`` snapshot; returns its slot."""
-        if not self._free:
+        if self._ftail == self._fhead:
             self._grow()
-        slot = self._free.pop()
+        slot = self._free[self._fhead & (len(self._free) - 1)]
+        self._fhead += 1
         self._buf[slot] = window
         return slot
 
-    def put_block(self, windows: np.ndarray) -> list[int]:
+    def put_block(self, windows: np.ndarray) -> np.ndarray:
         """Stage a ``(m, window, channels)`` block in one vectorized
         copy (the assembler's catch-up-burst path and the batched
-        ``push_many`` round staging); returns the slots."""
+        ``push_many`` round staging); returns the slots (an int64
+        array — FIFO-recycled, so in steady state an ascending run)."""
         m = len(windows)
-        if len(self._free) < m:
+        if self._ftail - self._fhead < m:
             self._grow(self.in_use + m)
-        slots = [self._free.pop() for _ in range(m)]
-        self._buf[slots] = windows
+        slots = self._free_popn(m)
+        s0 = self._run_start(slots)
+        if s0 is not None:  # FIFO steady state: one basic-slice write
+            self._buf[s0: s0 + m] = windows
+        else:
+            self._buf[slots] = windows
         return slots
 
+    def reserve(self, m: int) -> np.ndarray:
+        """Claim ``m`` slots off the FIFO free ring WITHOUT writing —
+        the batched ingest reserves a whole delivery round's slots up
+        front in DELIVERY order (the FIFO enqueue order), then each
+        boundary-offset subgroup writes into its mapped subset
+        (``put_block_pair(slots=...)``).  Assigning slots in delivery
+        order is what keeps the launch-side gather a contiguous run —
+        and therefore zero-copy — even when the round spans many
+        subgroups."""
+        if self._ftail - self._fhead < m:
+            self._grow(self.in_use + m)
+        return self._free_popn(m)
+
     def put_block_pair(
-        self, head: np.ndarray, tail: np.ndarray
-    ) -> list[int]:
+        self, head: np.ndarray, tail: np.ndarray, slots=None
+    ) -> np.ndarray:
         """Stage a block of windows whose rows are each split in two
         contiguous parts — ``head[i] ++ tail[i]`` — writing BOTH parts
         straight into the staging storage (no intermediate
         concatenation).  The batched ingest path's mid-chunk window
         snapshots arrive exactly like this: the ring tail up to the
-        boundary plus the chunk head that completes the window."""
+        boundary plus the chunk head that completes the window.
+        ``slots`` uses pre-``reserve``d slots instead of popping."""
         m = len(head)
-        if len(self._free) < m:
-            self._grow(self.in_use + m)
-        slots = [self._free.pop() for _ in range(m)]
+        if slots is None:
+            if self._ftail - self._fhead < m:
+                self._grow(self.in_use + m)
+            slots = self._free_popn(m)
         split = head.shape[1]
+        s0 = self._run_start(slots)
+        if s0 is not None:  # FIFO steady state: basic-slice writes
+            rows = self._buf[s0: s0 + m]
+            if split:
+                rows[:, :split] = head
+            rows[:, split:] = tail
+            return slots
         if split:
             self._buf[slots, :split] = head
         self._buf[slots, split:] = tail
         return slots
 
     def free(self, slot: int) -> None:
-        self._free.append(slot)
+        cap = len(self._free)
+        if self._ftail - self._fhead >= cap:  # pragma: no cover
+            raise AssertionError("staging free-ring overflow")
+        self._free[self._ftail & (cap - 1)] = slot
+        self._ftail += 1
+
+    def free_block(self, slots) -> None:
+        """Vectorized retire-order free: a whole batch's slots return
+        to the FIFO ring in one slice write, in their original enqueue
+        order — the recycling discipline that keeps future rounds
+        contiguous."""
+        if len(slots):
+            self._free_extend(slots)
+
+    @staticmethod
+    def _run_start(idx: np.ndarray):
+        """First slot of an ascending +1 run covering the whole index
+        array, or None when the request is fragmented — the zero-copy
+        eligibility check (host-side index arithmetic throughout)."""
+        k = len(idx)
+        if not k:
+            return None
+        s0 = idx[0]
+        if idx[k - 1] - s0 != k - 1:
+            return None
+        if k > 2 and not (idx[1:] - idx[:-1] == 1).all():
+            return None
+        return s0
+
+    def gather_view(self, slots) -> np.ndarray | None:
+        """The zero-copy batch: a slice VIEW over the staged rows when
+        ``slots`` is one ascending run (the FIFO-recycled steady
+        state), None when fragmented — the fused launch's exact-fit
+        path, which then skips the slab entirely."""
+        # host-side index-array build (no device fetch)
+        # harlint: host-ok
+        idx = np.asarray(slots, np.intp)
+        s0 = self._run_start(idx)
+        if s0 is None:
+            return None
+        return self._buf[s0: s0 + len(idx)]
 
     def gather(self, slots) -> np.ndarray:
-        """One contiguous ``(k, window, channels)`` batch copy."""
-        # the slot list is a host-side Python list; this is the index-
+        """One ``(k, window, channels)`` batch out of the block.  A
+        FIFO-contiguous slot run returns a slice VIEW — the staged
+        bytes themselves, zero copies (valid until the slots are freed
+        AND re-``put``, which retire-ordered freeing defers past the
+        dispatch that consumes it); fragmented requests fall back to
+        the fancy-index copy."""
+        # the slot list is a host-side index array; this is the index-
         # array build for the gather, not a device fetch
         # harlint: host-ok
-        return self._buf[np.asarray(slots, np.intp)]
+        idx = np.asarray(slots, np.intp)
+        s0 = self._run_start(idx)
+        if s0 is not None:
+            return self._buf[s0: s0 + len(idx)]
+        return self._buf[idx]
 
     def gather_into(self, slots, out: np.ndarray) -> np.ndarray:
         """Gather ``slots`` into the first ``len(slots)`` rows of a
         PREALLOCATED ``out`` slab and pad the tail by repeating the last
-        gathered row — the zero-allocation batch-assembly path of the
-        fused dispatch hot loop.  ``out`` must already be sized to the
-        scorer's padded shape; the exact-fit case (``len(slots) ==
-        len(out)``) skips the tail fill entirely, so a full batch pays
-        exactly one copy (the gather itself) and nothing else."""
+        gathered row — the batch-assembly path of the fused dispatch
+        hot loop.  A FIFO-contiguous run degenerates to one contiguous
+        block copy (no ``np.take`` scatter-gather); ``out`` must
+        already be sized to the scorer's padded shape, and the
+        exact-fit case (``len(slots) == len(out)``) skips the tail
+        fill entirely."""
         k = len(slots)
         # host-side index-array build, same as gather (no device fetch)
         # harlint: host-ok
-        np.take(self._buf, np.asarray(slots, np.intp), axis=0, out=out[:k])
+        idx = np.asarray(slots, np.intp)
+        s0 = self._run_start(idx)
+        if s0 is not None:
+            out[:k] = self._buf[s0: s0 + k]
+        else:
+            np.take(self._buf, idx, axis=0, out=out[:k])
         if k < len(out):
             out[k:] = out[k - 1]
         return out

@@ -492,6 +492,7 @@ def host_plane_benchmark(
         rounds = host_plane_rounds(recordings, hop, offsets)
         wps, poll_ms, p99s, p50s = [], [], [], []
         balanced = True
+        footprint = {}
         for run in range(int(n_runs) + 1):  # +1 warmup
             server = FleetServer(
                 model, window=window, hop=hop, smoothing="ema",
@@ -524,11 +525,24 @@ def host_plane_benchmark(
             ev = server.stats.event
             p99s.append(ev.percentile(99) or 0.0)
             p50s.append(ev.percentile(50) or 0.0)
+            # memory-footprint gauges (PR 14): resident bytes of the
+            # SoA estates at end of run — the "partially memory-bound"
+            # visibility the scaling artifact rows carry (identical
+            # across runs at a given N: capacities are load-determined)
+            prof = server.stats_snapshot().get("host_profile") or {}
+            footprint = {
+                key: prof[key]
+                for key in (
+                    "arena_bytes", "staging_bytes", "pending_bytes"
+                )
+                if key in prof  # absent on pre-SoA baseline trees
+            }
         rows.append(
             {
                 "n_sessions": n_sessions,
                 "windows": n_sessions * windows_per_session,
                 "n_runs": int(n_runs),
+                **footprint,
                 "windows_per_sec_median": round(float(np.median(wps)), 1),
                 "windows_per_sec_std": round(float(np.std(wps)), 1),
                 "host_ms_per_poll_median": round(

@@ -55,23 +55,38 @@ class RecoveryError(RuntimeError):
     session) — corruption, not a normal crash signature."""
 
 
-def _oldest_live(sess):
-    pending = sess.pending
-    while pending and pending[0].dropped:
-        pending.popleft()
-    return pending[0] if pending else None
+def _oldest_live(server, sess):
+    """The session's oldest live pending index, discarding (and
+    releasing the session-list reference of) flagged-dropped heads —
+    the SoA pending queue's replay-side head walk (the entries' queue-
+    side references stay in the FIFO ring, which skips them at the
+    next poll exactly like the live engine)."""
+    pq = server._pending
+    arena = server._session_arena
+    slot = sess.slot
+    h = arena.pend_head[slot]
+    while h >= 0 and pq.dropped[h]:
+        nxt = pq.next_idx[h]
+        arena.pend_head[slot] = nxt
+        if nxt < 0:
+            arena.pend_tail[slot] = -1
+        pq.release(h)
+        h = nxt
+    return int(h) if h >= 0 else None
 
 
 def _consume_ack(server, sess, ti, ver, shed, probs):
-    p = _oldest_live(sess)
-    if p is None or p.t_index != ti:
+    pq = server._pending
+    p = _oldest_live(server, sess)
+    if p is None or pq.t_index[p] != ti:
         raise RecoveryError(
             f"ack for session {sess.sid!r} t_index={ti} does not match "
             f"the oldest recovered window "
-            f"({None if p is None else p.t_index}) — a window would be "
-            "double-scored; refusing to recover from this journal"
+            f"({None if p is None else int(pq.t_index[p])}) — a window "
+            "would be double-scored; refusing to recover from this "
+            "journal"
         )
-    sess.pending.popleft()
+    server._session_pop_head(sess)
     # consumed: hide it from the global FIFO and free its arena slot
     server._release_pending(p)
     sess.n_scored += 1
@@ -86,12 +101,17 @@ def _consume_ack(server, sess, ti, ver, shed, probs):
 
 
 def _consume_drop(server, sess, ti, reason):
-    for p in sess.pending:
-        if not p.dropped and p.t_index == ti:
-            server._release_pending(p)
+    pq = server._pending
+    h = server._session_arena.pend_head[sess.slot]
+    while h >= 0:
+        if not pq.dropped[h] and pq.t_index[h] == ti:
+            # flagged in place (list position kept for the FIFO
+            # unlink), exactly like the live engine's sheds
+            server._release_pending(int(h))
             sess.n_dropped += 1
             server.stats.drop(1, reason)
             return
+        h = pq.next_idx[h]
     raise RecoveryError(
         f"drop record for session {sess.sid!r} t_index={ti} matches no "
         "recovered window"

@@ -254,19 +254,28 @@ def fleet_pipeline_smoke(
 def host_plane_smoke(
     sessions: int = 256, *, check_sessions: int = 64, seed: int = 5
 ) -> dict:
-    """The release gate's host-plane check (PR 12, the SoA session
-    estate): two halves, one verdict —
+    """The release gate's host-plane check (PR 12 SoA session estate +
+    PR 14 SoA pending queue): three halves, one verdict —
 
       1. equivalence: the BATCHED ingest path (``push_many`` over the
          session arena, mid-chunk boundaries included) must produce
          per-session event streams bit-identical to the sequential
          ``push`` path at N=64 — phase-staggered 20 Hz chunks, so
          windows complete mid-chunk (the production shape);
-      2. capacity: one small ``host_plane_benchmark`` point stamps
-         ``{sessions, host_ms_per_poll, p99_ms}`` into the gate log —
-         the host-plane regression trace the sessions-per-worker
-         ceiling curve (artifacts/host_plane_scaling.json) is read
-         against.
+      2. pending-queue identity under pressure: the SAME comparison
+         with TIGHT queue bounds, so the shed-stalest walk, the
+         per-session bound and the FIFO pop all fire constantly — the
+         batched and sequential cadences must shed the SAME windows
+         and emit bit-identical surviving streams (the per-object
+         queue's semantics, re-proven against the slot-indexed
+         ``PendingArena`` every gate run), with the conservation law
+         balanced and every drop attributed;
+      3. capacity: one small ``host_plane_benchmark`` point stamps
+         ``{sessions, host_ms_per_poll, p99_ms}`` — plus the PR-14
+         footprint gauges (``arena_bytes``/``staging_bytes``/
+         ``pending_bytes``) and ``pending_soa: true`` — into the gate
+         log: the regression trace the sessions-per-worker ceiling
+         curve (artifacts/host_plane_scaling.json) is read against.
     """
     import numpy as np
 
@@ -289,34 +298,59 @@ def host_plane_smoke(
         recs, hop, rng.integers(0, hop, size=n)
     )
 
-    def one_run(batched: bool):
+    def one_run(batched: bool, config: FleetConfig, poll_every: int = 1):
         server = FleetServer(
             model, window=window, hop=hop, smoothing="ema",
-            config=FleetConfig(max_sessions=n),
+            config=config,
         )
         for i in range(n):
             server.add_session(i)
         by_sid: dict[int, list] = {i: [] for i in range(n)}
-        for ids, chunks in rounds:
+        for r, (ids, chunks) in enumerate(rounds):
             if batched:
                 server.push_many(ids, chunks)
             else:
                 for sid, part in zip(ids, chunks):
                     server.push(sid, part)
-            for fe in server.poll(force=True):
-                by_sid[fe.session_id].append(fe.event)
+            if (r + 1) % poll_every == 0:
+                for fe in server.poll(force=True):
+                    by_sid[fe.session_id].append(fe.event)
         for fe in server.flush():
             by_sid[fe.session_id].append(fe.event)
         return server, by_sid
 
-    _, seq = one_run(False)
-    server, bat = one_run(True)
-    equivalent = all(
-        len(seq[i]) == len(bat[i])
-        and all(events_equal(a, b) for a, b in zip(seq[i], bat[i]))
-        for i in range(n)
-    ) and any(len(seq[i]) for i in range(n))
+    def streams_equal(seq, bat):
+        return all(
+            len(seq[i]) == len(bat[i])
+            and all(events_equal(a, b) for a, b in zip(seq[i], bat[i]))
+            for i in range(n)
+        ) and any(len(seq[i]) for i in range(n))
+
+    nominal = FleetConfig(max_sessions=n)
+    _, seq = one_run(False, nominal)
+    server, bat = one_run(True, nominal)
+    equivalent = streams_equal(seq, bat)
     acct = server.stats.accounting()
+
+    # pending-queue identity under pressure: tight bounds make every
+    # queue mechanism fire (per-session shed, global shed-stalest,
+    # non-full batches); both cadences must agree window for window
+    # polls every 5th round so the backlog builds past both bounds
+    pressure = FleetConfig(
+        max_sessions=n, target_batch=16,
+        max_pending_per_session=3, max_queue_windows=48,
+    )
+    ps, pseq = one_run(False, pressure, poll_every=5)
+    pb, pbat = one_run(True, pressure, poll_every=5)
+    pending_equivalent = streams_equal(pseq, pbat)
+    p_acct = pb.stats.accounting()
+    pending_ok = bool(
+        pending_equivalent
+        and pb.stats.dropped_total > 0  # pressure actually fired
+        and pb.stats.dropped == ps.stats.dropped  # same sheds, by reason
+        and p_acct["balanced"]
+        and p_acct["pending"] == 0
+    )
 
     row = host_plane_benchmark([int(sessions)], n_runs=2)[0]
     return {
@@ -325,8 +359,15 @@ def host_plane_smoke(
         "p99_ms": row["event_p99_ms_median"],
         "windows_per_sec": row["windows_per_sec_median"],
         "batched_equivalent": equivalent,
+        "pending_soa": True,
+        "pending_equivalent": pending_equivalent,
+        "pressure_dropped": pb.stats.dropped_total,
+        "arena_bytes": row["arena_bytes"],
+        "staging_bytes": row["staging_bytes"],
+        "pending_bytes": row["pending_bytes"],
         "ok": bool(
             equivalent
+            and pending_ok
             and acct["balanced"]
             and acct["pending"] == 0
             and row["accounting_balanced"]

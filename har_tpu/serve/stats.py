@@ -163,12 +163,21 @@ class HostProfile:
     def __init__(self):
         for name in self.PHASES:
             setattr(self, name, StageHistogram())
+        # pending-queue depth distribution (windows, not ms): the
+        # un-launched backlog sampled at poll entry and before every
+        # launch (StageHistogram.record_many, one call per poll) — the
+        # size axis that makes due-selection cost attributable: a fat
+        # due_select histogram with a fat depth histogram is load, with
+        # a thin one is a scheduler regression
+        self.pending_depth = StageHistogram()
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             f"{name}_ms": getattr(self, name).snapshot()
             for name in self.PHASES
         }
+        out["pending_depth"] = self.pending_depth.snapshot()
+        return out
 
 
 class FleetStats:
@@ -263,6 +272,15 @@ class FleetStats:
         self.scale_ups = 0
         self.scale_downs = 0
         self.utilization = 0.0  # harlint: ephemeral
+        # memory-footprint gauges (PR 14): resident bytes of the SoA
+        # session estate, the staging block and the pending queue —
+        # recomputed from the live structures at every stats_snapshot
+        # (the 20k-session scaling point is partially memory-bound;
+        # these are the "why", stamped into the host_plane gate entry
+        # and the scaling-artifact rows), never snapshot state
+        self.arena_bytes = 0  # harlint: ephemeral
+        self.staging_bytes = 0  # harlint: ephemeral
+        self.pending_bytes = 0  # harlint: ephemeral
         # wire transport (har_tpu.serve.net): RPC round trips issued,
         # deadline-exceeded re-attempts, and bytes moved each way —
         # the comms/serialization term the Spark-perf study says
@@ -404,6 +422,9 @@ class FleetStats:
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "utilization": round(self.utilization, 4),
+            "arena_bytes": self.arena_bytes,
+            "staging_bytes": self.staging_bytes,
+            "pending_bytes": self.pending_bytes,
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
             "fused_dispatches": self.fused_dispatches,

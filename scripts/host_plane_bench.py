@@ -2,26 +2,35 @@
 """Committed-artifact run of the host-plane scaling curve.
 
 Measures the sessions-per-worker ceiling of the structure-of-arrays
-host plane (PR 12) against the PR-10 dict-of-objects baseline on the
-same hardware: the SAME harness (``loadgen.host_plane_benchmark`` —
-shared with bench.py's ``host_plane_scaling`` lane, so the committed
-artifact and the round bench cannot compute the numbers differently)
-drives N = 1k/4k/10k/20k synthetic sessions through a FleetServer on
-the training-free host model, n_runs >= 3, median + std.
+host plane against the PREVIOUS generation on the same hardware: the
+SAME harness (``loadgen.host_plane_benchmark`` — shared with bench.py's
+``host_plane_scaling`` lane, so the committed artifact and the round
+bench cannot compute the numbers differently) drives N = 1k/4k/10k/
+20k/50k/100k synthetic sessions through a FleetServer on the
+training-free host model, n_runs >= 3, median + std.
 
-The PR-10 baseline rows were captured by running this harness against
-the pre-SoA tree (commit f6b6ed7) on this container before the
-refactor landed; re-capture them on other hardware with::
+Generations so far: PR 11 rebuilt the session estate as SoA
+(``SessionArena``) against the PR-10 dict-of-objects baseline
+(f6b6ed7, ceiling ratio 3.07 at the PR-10 1k-session p99 budget);
+PR 14 replaced the per-window ``_Pending`` objects with the SoA
+``PendingArena`` + zero-copy FIFO-slice staging and extended the curve
+to 50k/100k points against the PR-11 tree.  Baseline rows are always
+captured by running this harness AGAINST THE PREVIOUS TREE on the
+same container::
 
-    git stash / checkout f6b6ed7
+    git stash / checkout <previous-pr-sha>
     python scripts/host_plane_bench.py --capture-baseline BASE.json
-    git checkout -                     # back to the SoA tree
-    python scripts/host_plane_bench.py --baseline BASE.json
+    git checkout -                     # back to the current tree
+    python scripts/host_plane_bench.py --baseline BASE.json \
+        --baseline-label pr11_<sha>_same_harness_same_host
 
-The ceiling claim is "equal p99": both generations are judged against
-the SAME p99 budget — the baseline's median event p99 at its 1,000-
-session operating point (PR-10's own bench notes are stated there) —
-and the artifact must show ``ceiling_ratio >= 3``.
+The ceiling claim is "equal p99", and the BUDGET IS CARRIED THROUGH
+THE CHAIN: every generation is judged against the same absolute p99
+budget — the PR-10 baseline's median event p99 at its 1,000-session
+operating point (first stamped in the PR-11 artifact's
+``p99_budget_ms`` and re-used from the committed artifact by default)
+— so ceiling ratios multiply across PRs instead of moving the
+goalposts per refresh.
 
 Writes ``artifacts/host_plane_scaling.json``.
 """
@@ -38,7 +47,7 @@ if str(REPO) not in sys.path:  # runnable from any cwd, no install
     sys.path.insert(0, str(REPO))
 OUT = REPO / "artifacts" / "host_plane_scaling.json"
 
-SESSION_COUNTS = (1000, 4000, 10000, 20000)
+SESSION_COUNTS = (1000, 4000, 10000, 20000, 50000, 100000)
 
 
 def main(argv=None) -> int:
@@ -58,6 +67,18 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--sessions", type=int, nargs="*", default=list(SESSION_COUNTS)
     )
+    ap.add_argument(
+        "--p99-budget-ms", type=float, default=None,
+        help="equal-p99 budget; defaults to the committed artifact's "
+             "p99_budget_ms (the chain's PR-10 1k-session operating "
+             "point), falling back to the baseline's smallest-N p99",
+    )
+    ap.add_argument(
+        "--baseline-label", default=None,
+        help="provenance label for the baseline rows (e.g. "
+             "pr11_<sha>_same_harness_same_host); defaults to the "
+             "committed artifact's label",
+    )
     args = ap.parse_args(argv)
 
     from har_tpu.serve.loadgen import (
@@ -74,10 +95,11 @@ def main(argv=None) -> int:
         return 0
 
     baseline_rows = None
+    prior = json.loads(OUT.read_text()) if OUT.exists() else {}
     if args.baseline:
         baseline_rows = json.loads(Path(args.baseline).read_text())["rows"]
-    elif OUT.exists():
-        baseline_rows = json.loads(OUT.read_text()).get("baseline_rows")
+    else:
+        baseline_rows = prior.get("baseline_rows")
     if not baseline_rows:
         print(
             "error: no PR-10 baseline rows — pass --baseline (captured "
@@ -87,10 +109,17 @@ def main(argv=None) -> int:
         )
         return 1
 
+    budget = args.p99_budget_ms
+    if budget is None:
+        budget = prior.get("p99_budget_ms")  # the chain's carried budget
     summary = host_plane_summary(
-        rows, args.n_runs, baseline_rows=baseline_rows
+        rows, args.n_runs, baseline_rows=baseline_rows,
+        p99_budget_ms=budget,
     )
-    summary["baseline"] = "pr10_f6b6ed7_same_harness_same_host"
+    summary["baseline"] = (
+        args.baseline_label
+        or prior.get("baseline", "pr10_f6b6ed7_same_harness_same_host")
+    )
     OUT.parent.mkdir(exist_ok=True)
     OUT.write_text(json.dumps(summary, indent=1))
     print(json.dumps(summary, indent=1))

@@ -796,8 +796,11 @@ def test_pad_exact_fit_skips_the_copy_and_compile_count_unchanged():
 
 
 def test_fused_slab_pool_bounded_and_recycled():
-    """The fused staging slabs are pooled per padded shape: at most
-    pipeline_depth slabs per shape ever exist, recycled at retire —
+    """Fused staging, both halves of the PR-14 contract: exact-fit
+    FIFO-contiguous batches ride the ZERO-COPY fast path (the device
+    gets the staging slice itself — no slab is ever acquired), while
+    padded batches fall back to the per-shape slab pool, bounded at
+    pipeline_depth slabs and recycled at retire — either way,
     steady-state fused serving allocates nothing per dispatch."""
     model = JitDemoModel(window=10)
     server = FleetServer(
@@ -813,6 +816,14 @@ def test_fused_slab_pool_bounded_and_recycled():
         server.poll(force=True)
     server.flush()
     assert server.stats.fused_dispatches == server.stats.dispatches >= 12
+    # exact-fit in-order rounds: zero-copy, so NO slab was ever needed
+    assert server._slab_pool == {}
+    # a partial batch (3 windows -> pad 4) cannot ride the view: it
+    # takes the pooled-slab path, bounded per shape
+    for _ in range(4):
+        server.push(0, np.zeros((10 * 3, 3), np.float32))
+        server.poll(force=True)
+    server.flush()
     pool = server._slab_pool
     assert set(pool) == {4}
     assert 1 <= len(pool[4]) <= 3

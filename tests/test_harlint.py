@@ -296,6 +296,25 @@ def test_hl002_acceptance_real_session_arena_minus_slot_array():
     )
 
 
+def test_hl002_acceptance_real_pending_arena_minus_column():
+    """The SoA pending-queue acceptance mutation (PR 14): HL002
+    auto-covers the pending arena's per-slot columns through the
+    ``_PENDING_ARRAYS`` table its state()/load_state serializers read
+    — deleting a column key from the REAL arena.py source must
+    produce HL002 findings (the release gate then exits non-zero)."""
+    real = (REPO / "har_tpu" / "serve" / "arena.py").read_text()
+    mutated = real.replace(
+        '"dropped", "launched", "next_idx", "refs",',
+        '"dropped", "launched", "refs",',
+    )
+    assert mutated != real, "arena.py _PENDING_ARRAYS anchor changed"
+    findings = lint_sources(
+        {"har_tpu/serve/arena.py": mutated}, [StateCompletenessRule()]
+    )
+    assert {f.symbol for f in findings} == {"PendingArena.next_idx"}
+    assert len(findings) == 2  # absent from state() AND load_state()
+
+
 # --------------------------------------------------------------- HL003
 
 

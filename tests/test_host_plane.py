@@ -497,10 +497,13 @@ def test_soa_fleet_bit_identical_under_churn_and_depths(seed):
 
 def test_pre_soa_snapshot_restores_into_arena(tmp_path):
     """A snapshot written in the pre-SoA per-session layout — ring{i}/
-    ema{i} arrays, per-session metadata dicts, votes as lists, NO
-    session_arena extra — restores cleanly: state lands in the arena
-    through the façades, streams continue bit-identically, and no new
-    record types were needed."""
+    ema{i} arrays, per-session metadata dicts, votes as lists, a
+    stacked ``pending`` array with [sidx, t_index, drift] metadata
+    rows, NO session_arena/pending_arena extras — restores cleanly:
+    state lands in the SoA arenas through the façades, the recovered
+    pending window re-stages and scores, streams continue
+    bit-identically, and no new record types were needed (PR 14's
+    SoA pending queue serializes back to this exact layout)."""
     from har_tpu.serve.journal import FleetJournal, JournalConfig
 
     root = str(tmp_path / "old")
@@ -508,6 +511,7 @@ def test_pre_soa_snapshot_restores_into_arena(tmp_path):
     rng = np.random.default_rng(4)
     ring = rng.normal(size=(100, 3)).astype(np.float32)
     ema = rng.random(3)
+    pend = rng.normal(size=(1, 100, 3)).astype(np.float32)
     state = {
         "geometry": {
             "window": 100, "hop": 50, "channels": 3,
@@ -518,18 +522,21 @@ def test_pre_soa_snapshot_restores_into_arena(tmp_path):
         "ladder": {
             "smoothing_shed": False, "breaches": 0, "ok_streak": 0,
         },
-        "stats": {"counters": {"enqueued": 3, "scored": 3}},
+        "stats": {"counters": {"enqueued": 4, "scored": 3}},
         "sessions": [
             {
                 "sid": 0, "n_seen": 250, "raw_seen": 250,
-                "next_emit": 300, "n_enqueued": 3, "n_scored": 3,
+                "next_emit": 300, "n_enqueued": 4, "n_scored": 3,
                 "n_dropped": 0, "votes": [1, 2], "monitor": None,
             }
         ],
-        "pending": [],
-        "extra": {},  # pre-SoA: no session_arena record
+        # one un-acked window, the pre-crash queue's FIFO layout
+        "pending": [[0, 250, False]],
+        "extra": {},  # pre-SoA: no session_arena/pending_arena record
     }
-    j.write_snapshot(state, {"ring0": ring, "ema0": ema})
+    j.write_snapshot(
+        state, {"ring0": ring, "ema0": ema, "pending": pend}
+    )
     j.close()
     restored = FleetServer.restore(root, _StubModel(), reattach=False)
     sess = restored._sessions[0]
@@ -538,7 +545,19 @@ def test_pre_soa_snapshot_restores_into_arena(tmp_path):
     assert sess.n_scored == 3 and sess.raw_seen == 250
     np.testing.assert_array_equal(sess.smoother._ema, ema)
     assert list(sess.smoother._votes) == [1, 2]
-    # and the restored stream continues: next window at t=300
+    # the recovered pending window re-staged into the SoA queue ...
+    assert sess.n_live == 1 and restored._pending.queued == 1
+    np.testing.assert_array_equal(
+        restored._arena.gather(
+            restored._pending.stage_slot[
+                restored._pending.ring_indices()
+            ]
+        )[0],
+        pend[0],
+    )
+    # ... and scores first, then the stream continues at t=300
+    evs = restored.flush()
+    assert [e.event.t_index for e in evs] == [250]
     assert restored.push(
         0, rng.normal(size=(50, 3)).astype(np.float32)
     ) == 1
@@ -554,6 +573,7 @@ def test_pre_soa_snapshot_restores_into_arena(tmp_path):
     assert "ring0" in arrays2 and "ema0" in arrays2
     assert state2["sessions"][0]["n_seen"] == 300
     assert "session_arena" in state2["extra"]  # observability only
+    assert "pending_arena" in state2["extra"]  # observability only
 
 
 # --------------------------------------------------- CLI path pins

@@ -267,10 +267,19 @@ def test_gate_log_carries_host_plane_verdict():
         "artifacts/test_gate.json lacks the host_plane verdict — "
         "run scripts/release_gate.py"
     )
-    for key in ("sessions", "host_ms_per_poll", "p99_ms"):
+    for key in (
+        "sessions", "host_ms_per_poll", "p99_ms",
+        # PR 14: the SoA pending queue's identity-under-pressure
+        # verdict and the memory-footprint gauges
+        "pending_soa", "pending_equivalent", "arena_bytes",
+        "staging_bytes", "pending_bytes",
+    ):
         assert key in host_plane
     assert host_plane["ok"] is True
     assert host_plane["batched_equivalent"] is True
+    assert host_plane["pending_soa"] is True
+    assert host_plane["pending_equivalent"] is True
+    assert host_plane["arena_bytes"] > 0
     assert host_plane["sessions"] >= 256
     assert host_plane["host_ms_per_poll"] > 0
 
