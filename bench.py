@@ -1288,6 +1288,42 @@ def main() -> None:
         "wire_failover", 30, _wire_failover_lane
     )
 
+    # Journal-ship lane (r19 tentpole, har_tpu.serve.net.ship): the
+    # same one-worker-dies failover with NO shared filesystem — every
+    # worker's journal in a private per-host directory, the dead
+    # partition pulled over the ship RPC (chunked, per-chunk acked,
+    # whole-file-digest verified) from the host's agent — measured
+    # against the shared-dir restore as the baseline, so the cost of
+    # moving the recovery currency across a process boundary is a
+    # number, not an assumption.  ship_ms is the wall time inside
+    # fetch_journal; failover_ms the whole restore+drain+hand-off.
+    def _journal_ship_lane():
+        from har_tpu.serve.net.smoke import journal_ship_benchmark
+
+        session_counts = [12] if smoke else [96, 192, 384]
+        rows = journal_ship_benchmark(
+            session_counts, n_runs=1 if smoke else lane_runs
+        )
+        return None, {
+            "model": "analytic_demo",
+            "transport": "tcp",
+            "private_dirs": True,
+            "n_runs": 1 if smoke else lane_runs,
+            "rows": rows,
+            "ship_ms_median": rows[-1]["ship_ms_median"],
+            "failover_ms_median": rows[-1]["failover_ms_median"],
+            "baseline_failover_ms_median": rows[-1][
+                "baseline_failover_ms_median"
+            ],
+            "shipped_bytes": rows[-1]["shipped_bytes"],
+            "contract_ok": all(r["contract_ok"] for r in rows),
+            "chip_state_probe": chip_probe,
+        }
+
+    _, ship_stats = deadline_lane(
+        "journal_ship", 60, _journal_ship_lane
+    )
+
     # Elastic-traffic lane (r14 tentpole, har_tpu.serve.traffic): the
     # same seeded 10x diurnal swing (overnight-cohort storm, slow
     # clients, mixed rates) served three ways — static floor batch,
@@ -1590,6 +1626,17 @@ def main() -> None:
         "wire_rpc_rtt_p50_ms": wire_stats.get("rpc_rtt_p50_ms"),
         "wire_rpc_rtt_p99_ms": wire_stats.get("rpc_rtt_p99_ms"),
         "wire_failover_contract_ok": wire_stats.get("contract_ok"),
+        # shared-nothing failover (har_tpu.serve.net.ship): the ship
+        # transfer's own wall time and the whole-failover time with
+        # private journal dirs, read against the shared-dir restore
+        "journal_ship_ms_median": ship_stats.get("ship_ms_median"),
+        "journal_ship_failover_ms_median": ship_stats.get(
+            "failover_ms_median"
+        ),
+        "journal_ship_baseline_ms_median": ship_stats.get(
+            "baseline_failover_ms_median"
+        ),
+        "journal_ship_contract_ok": ship_stats.get("contract_ok"),
         # elastic traffic (har_tpu.serve.traffic): the autoscaled run's
         # numbers across the 10x swing, and whether it beat the best
         # static configuration on p99 or shed rate at equal windows/s
@@ -1689,6 +1736,7 @@ def main() -> None:
         "fleet_recovery": recovery_stats,
         "cluster_failover": cluster_stats,
         "wire_failover": wire_stats,
+        "journal_ship": ship_stats,
         "elastic_traffic": elastic_stats,
         "host_plane_scaling": host_plane_stats,
     }

@@ -8,19 +8,23 @@ the shape that drifts:
   1. every journal record type written anywhere in the fleet stack
      (``_jappend({"t": "push", ...})`` in the engine,
      ``journal.append({"t": "adapt", ...})`` in the adaptation
-     controller) must have a replay handler in
-     ``serve/recover.py`` (``t == "push"`` dispatch) — a recordless
-     handler is dead code, a handlerless record is data a crash writes
-     and recovery silently drops (the replay loop tolerates unknown
-     types BY DESIGN for forward compat, which is precisely why the
-     same-version check must be static);
+     controller, ``ship_journal.append({"t": "ship_chunk", ...})`` in
+     the journal-ship receiver) must have a replay handler in a
+     replay module — ``serve/recover.py`` for fleet records, ``serve/
+     net/ship.py`` for the ship log's own records (``t == "push"`` /
+     ``t == "ship_chunk"`` dispatch) — a recordless handler is dead
+     code, a handlerless record is data a crash writes and recovery
+     silently drops (the replay loops tolerate unknown types BY DESIGN
+     for forward compat, which is precisely why the same-version check
+     must be static);
   2. every replay handler must correspond to a written record type;
   3. the kill-point names the chaos matrix enumerates
-     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` in ``serve/chaos.py``)
-     must biject with the ``chaos_point("...")`` / ``_chaos("...")``
-     call sites across the stack, and every matrix point needs a
-     ``_DEFAULT_AT`` occurrence calibration — a stage boundary without
-     a matrix entry is a crash window no chaos run ever exercises.
+     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` + the cluster and ship
+     tuples in ``serve/chaos.py``) must biject with the
+     ``chaos_point("...")`` / ``_chaos("...")`` call sites across the
+     stack, and every matrix point needs a ``_DEFAULT_AT`` occurrence
+     calibration — a stage boundary without a matrix entry is a crash
+     window no chaos run ever exercises.
 """
 
 from __future__ import annotations
@@ -154,7 +158,12 @@ class JournalExhaustivenessRule(Rule):
             base = ctx.rel.rsplit("/", 1)[-1]
             for t, node in _record_writes(ctx):
                 written.setdefault(t, (ctx, node))
-            if base == "recover.py":
+            # two replay modules: the fleet suffix replay (recover.py)
+            # and the ship log's resume replay (net/ship.py) — the ship
+            # record family's handlers live beside their writer, and a
+            # deleted ship_chunk handler must flag exactly like a
+            # deleted fleet handler
+            if base in ("recover.py", "ship.py"):
                 for t, node in _replay_handlers(ctx):
                     handled.setdefault(t, (ctx, node))
             if base == "chaos.py":
@@ -168,8 +177,14 @@ class JournalExhaustivenessRule(Rule):
                 # point — a hand-off stage boundary without a matrix
                 # entry is a crash window no chaos run exercises
                 ckp, _ = _string_tuple(ctx.tree, "CLUSTER_KILL_POINTS")
-                declared = kp | ekp | ckp
-                matrix_points = kp | ckp
+                # the journal-ship transfer's stage boundaries
+                # (mid_ship_send / mid_ship_recv / post_ship_pre_drain)
+                # join the same way: dropping one from the declared
+                # tuple orphans its call site, deleting a call site
+                # orphans the matrix entry
+                skp, _ = _string_tuple(ctx.tree, "SHIP_KILL_POINTS")
+                declared = kp | ekp | ckp | skp
+                matrix_points = kp | ckp | skp
                 declared_node = kp_node
                 default_at = _dict_keys(ctx.tree, "_DEFAULT_AT")
             for node in ast.walk(ctx.tree):

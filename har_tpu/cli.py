@@ -476,6 +476,19 @@ def _parser() -> argparse.ArgumentParser:
              "the release gate; `har serve-worker --help` for flags",
     )
 
+    # same stub pattern as serve-worker: the real parser lives in
+    # har_tpu.serve.net.ship (main() forwards before this parser runs)
+    sub.add_parser(
+        "serve-agent",
+        add_help=False,
+        help="one journal-ship agent per worker host "
+             "(har_tpu.serve.net.ship): serves that host's journal "
+             "directories to an adopting controller as chunked, "
+             "digest-manifested, resumable transfers — the shared-"
+             "nothing failover's hand-off currency; `har serve-agent "
+             "--help` for flags",
+    )
+
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
     pa = sub.add_parser(
@@ -514,6 +527,13 @@ def main(argv=None) -> int:
         from har_tpu.serve.net.worker import main as _worker_main
 
         return _worker_main(argv[1:])
+    if argv[:1] == ["serve-agent"]:
+        # same forwarding contract as serve-worker: the ship agent is
+        # a byte server — it must start without the CLI (or a jax
+        # backend) behind it
+        from har_tpu.serve.net.ship import main as _agent_main
+
+        return _agent_main(argv[1:])
     args = _parser().parse_args(argv)
 
     if args.command == "lint":

@@ -20,6 +20,7 @@ Public surface:
   restore_server / recovery_smoke         — crash recovery (recover.py)
   KILL_POINTS / run_kill_point            — kill-point chaos (chaos.py)
   CLUSTER_KILL_POINTS / run_cluster_kill_point — worker-axis chaos
+  SHIP_KILL_POINTS                        — journal-ship transfer chaos
   NET_PARTITION_CASES                     — partition-tolerance matrix
                                             (runners in serve/net/chaos)
   fleet_slo_smoke / fleet_pipeline_smoke  — the release gate's checks
@@ -53,6 +54,7 @@ from har_tpu.serve.chaos import (
     NET_PARTITION_CASES,
     ENGINE_KILL_POINTS,
     KILL_POINTS,
+    SHIP_KILL_POINTS,
     KillPlan,
     SimulatedCrash,
     run_cluster_kill_point,
@@ -144,6 +146,7 @@ __all__ = [
     "JournalConfig",
     "JournalError",
     "KILL_POINTS",
+    "SHIP_KILL_POINTS",
     "KillPlan",
     "LoadReport",
     "PendingArena",

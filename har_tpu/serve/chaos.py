@@ -87,6 +87,22 @@ ENGINE_KILL_POINTS = ("mid_promote", "mid_rollback")
 # partition).  Killed on the CLUSTER's chaos hook: the controller dies,
 # the surviving worker processes do not.
 CLUSTER_KILL_POINTS = ("mid_handoff", "mid_migration")
+# the journal-shipping transfer's stage boundaries (shared-nothing
+# failover, har_tpu.serve.net.ship): the SENDING host's agent dies
+# mid-transfer (mid_ship_send — a real os._exit inside the agent
+# process; the restarted agent must serve the resume from the last
+# durable chunk), the RECEIVING controller dies between chunks
+# (mid_ship_recv — the takeover controller resumes the staged
+# transfer), and the controller dies after the verified ship lands but
+# before the restored engine drains (post_ship_pre_drain — the
+# takeover finds a complete staged copy and finishes).  Run in the
+# wire matrix (net/chaos.py) with every worker journal in a private,
+# non-shared directory.
+SHIP_KILL_POINTS = (
+    "mid_ship_send",
+    "mid_ship_recv",
+    "post_ship_pre_drain",
+)
 # the failure modes only a REAL link has (har_tpu.serve.net.chaos —
 # run over subprocess workers on loopback TCP): a slow link and a
 # blackholed probe must NOT be failovers, a duplicated delivery must
@@ -117,6 +133,12 @@ _DEFAULT_AT = {
     "mid_resize": 1,
     "mid_handoff": 1,
     "mid_migration": 2,
+    # ship-axis occurrences: the chunk counts are calibrated against
+    # the matrix's small ship_chunk_bytes so both kills land genuinely
+    # MID-transfer (durable progress exists, the transfer is unfinished)
+    "mid_ship_send": 3,
+    "mid_ship_recv": 3,
+    "post_ship_pre_drain": 1,
 }
 
 

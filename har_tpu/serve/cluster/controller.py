@@ -87,6 +87,15 @@ class ClusterError(RuntimeError):
     unknown session, duplicate worker id)."""
 
 
+class PartitionUnavailable(ClusterError):
+    """A dead worker's journal could not be FETCHED right now (the
+    shared-nothing deployment's ship agent is unreachable,
+    har_tpu.serve.net.ship).  Not a failure: the failover PARKS on the
+    fetch queue and retries at a later poll — survivors keep serving,
+    the dead partition's disk state is untouched, and nothing is lost,
+    only delayed."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ClusterConfig:
     """Control-plane knobs: ring shape, failure detection, hand-off
@@ -177,6 +186,11 @@ class FleetCluster:
         # crash at the mid_migration/mid_handoff stage boundaries can
         # never strand an acked-but-undelivered event
         self._handoff_queue: list = []
+        # failovers whose partition FETCH failed (shared-nothing ship
+        # agent unreachable): (dead_wid, worker) pairs retried at each
+        # poll — a dead worker whose host agent is also down parks here
+        # while the survivors keep serving
+        self._fetch_queue: list = []
         # hand-off retry pacing: the same Backoff policy family as the
         # dispatch retry loop (har_tpu.utils.backoff), seeded — the
         # control plane is deterministic under the chaos harness
@@ -369,6 +383,24 @@ class FleetCluster:
                 dead_wid, restored = self._handoff_queue[0]
                 self._complete_failover(dead_wid, restored)
                 self._handoff_queue.pop(0)
+            if self._fetch_queue:
+                # parked shared-nothing failovers: retry the partition
+                # fetch (the dead host's ship agent may be back); a
+                # still-unreachable agent re-parks without blocking the
+                # survivors' polls below.  Entries are popped one at a
+                # time so a crash mid-retry loses at most the IN-FLIGHT
+                # entry (the controller-crash model; takeover re-derives
+                # it from the agents) — never the not-yet-retried rest.
+                retry, self._fetch_queue = self._fetch_queue, []
+                try:
+                    while retry:
+                        dead_wid, worker = retry.pop(0)
+                        events.extend(
+                            self._continue_failover(dead_wid, worker)
+                        )
+                except BaseException:
+                    self._fetch_queue.extend(retry)
+                    raise
             for wid in self._membership.expired():
                 events.extend(self._begin_failover(wid))
             for wid in list(self._workers):
@@ -440,27 +472,62 @@ class FleetCluster:
     def _begin_failover(self, dead_wid) -> list:
         """Phase 1 of a declared death: fence the worker (refuse any
         late responses — the in-process stand-in for lease-based
-        fencing), remove it from the ring, restore its partition from
-        its journal and DRAIN it — the recovered pending windows score
-        through the restored engine (the PR-4 path; acks land durably
-        in the dead journal, so a re-drain after a second crash
-        re-emits nothing).  Returns the drained events; the hand-offs
-        are queued for the next poll's phase 2."""
+        fencing), remove it from the ring, FETCH its partition
+        (``_fetch_partition`` — the dead directory itself on a shared
+        disk, a digest-verified shipped copy in the shared-nothing
+        deployment), restore and DRAIN it — the recovered pending
+        windows score through the restored engine (the PR-4 path; acks
+        land durably in the restored journal, so a re-drain after a
+        second crash re-emits nothing).  Returns the drained events;
+        the hand-offs are queued for the next poll's phase 2."""
         worker = self._workers.pop(dead_wid)
         worker.kill()
         self._router.remove_worker(dead_wid)
         self.failovers += 1
-        marker = os.path.join(worker.journal_dir, RETIRED_MARKER)
-        if os.path.exists(marker):
-            return []  # already consumed by an earlier controller
+        return self._continue_failover(dead_wid, worker)
+
+    def _continue_failover(self, dead_wid, worker) -> list:
+        """Fetch + restore + drain one declared-dead partition.  A
+        fetch refusal (``PartitionUnavailable``) parks the pair on the
+        fetch queue for the next poll; a fetch that reports the
+        partition already consumed (retired marker on either side)
+        ends the failover with nothing to do."""
         t0 = time.perf_counter()
+        try:
+            src = self._fetch_partition(worker)
+        except PartitionUnavailable:
+            self._fetch_queue.append((dead_wid, worker))
+            return []
+        if src is None:
+            return []  # already consumed by an earlier controller
+        # the verified partition is local and whole; the crash window
+        # between the landed ship and the drain is its own kill point
+        self._chaos("post_ship_pre_drain")
         restored = FleetServer.restore(
-            worker.journal_dir, self._loader, clock=self._clock
+            src, self._loader, clock=self._clock
         )
         events = restored.flush()
         self.failover_ms += (time.perf_counter() - t0) * 1e3
         self._handoff_queue.append((dead_wid, restored))
         return events
+
+    def _fetch_partition(self, worker) -> str | None:
+        """Locate (or materialize) the dead worker's journal locally
+        and return the directory to restore from; None when the
+        partition was already consumed (retired).  The shared-disk
+        default reads the directory in place; the shared-nothing
+        transport (``har_tpu.serve.net.NetCluster``) overrides this
+        with the journal-shipping RPC — raising
+        ``PartitionUnavailable`` when the ship agent is unreachable."""
+        marker = os.path.join(worker.journal_dir, RETIRED_MARKER)
+        if os.path.exists(marker):
+            return None
+        return worker.journal_dir
+
+    @property
+    def pending_failovers(self) -> int:
+        """Failovers parked on an unreachable partition fetch."""
+        return len(self._fetch_queue)
 
     def _complete_failover(self, dead_wid, restored) -> None:
         """Phase 2: hand every drained session to the survivors, then
@@ -496,7 +563,15 @@ class FleetCluster:
             os.path.join(restored.journal.root, RETIRED_MARKER),
             json.dumps(self._ledger[-1]),
         )
+        # shared-nothing hook: the transport controller also marks the
+        # SOURCE copy retired on its home host (best-effort — the local
+        # marker above is the commit point for this controller lineage)
+        self._commit_retired(dead_wid, self._ledger[-1])
         restored.journal.close()
+
+    def _commit_retired(self, dead_wid, entry: dict) -> None:
+        """Transport hook (no-op in-process): propagate a consumed
+        partition's retired marker back to its source host."""
 
     def _hand_off(self, source, sid, source_wid, target_wid=None):
         """Move one drained session from ``source`` to its ring owner

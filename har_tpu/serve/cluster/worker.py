@@ -167,6 +167,21 @@ class ClusterWorker:
             "sessions": len(self.server._sessions),
         }
 
+    def drift_reports(self) -> list:
+        """Every monitored session's latest ``DriftReport`` as
+        ``[(sid, report)]`` (monitor-less sessions skipped) — the
+        evidence the fleet-global retrain trigger aggregates.  Part of
+        the worker surface so the transport twin can ship it
+        (``NetWorker.drift_reports`` rides the float64-exact wire
+        codec) and ``NetCluster.observe_drift`` stops being refused."""
+        self._guard()
+        out = []
+        for sid in self.server.sessions:
+            report = self.server.drift_report(sid)
+            if report is not None:
+                out.append((sid, report))
+        return out
+
     def note_failover_absorbed(self) -> None:
         self._guard()
         self.server.stats.worker_failovers += 1

@@ -51,6 +51,7 @@ from __future__ import annotations
 import dataclasses
 import gc
 import time
+import warnings
 from collections import deque
 from typing import Callable, Hashable, Sequence
 
@@ -444,6 +445,13 @@ class FleetServer:
         # same code paths
         self._journal: FleetJournal | None = None
         self._replaying = False
+        # storage-fault containment: True while the last journal write/
+        # fsync FAILED (ENOSPC, a dying disk) — the serving loop keeps
+        # running as a counted, declared degradation (acks in the
+        # failed window are not durable), and snapshots are refused
+        # until a flush succeeds (a rotation would prune the segments
+        # the un-flushed suffix still needs)
+        self._journal_degraded = False
         # extra snapshot state registered by controllers riding this
         # server (the AdaptationEngine persists its episode/probation
         # state here), and what recovery read back for them
@@ -501,6 +509,7 @@ class FleetServer:
                 "/ `har serve --resume`) or use an empty directory"
             )
         self._journal = journal
+        self._journal_degraded = False
         if snapshot:
             self.write_snapshot()
         return journal
@@ -515,18 +524,75 @@ class FleetServer:
         if self._journal is not None:
             self._journal.chaos_point(point)
 
+    def _note_journal_error(self, what: str, exc: OSError) -> None:
+        """One storage failure absorbed: count it, warn loudly, mark
+        the journal degraded.  The records stay buffered (FleetJournal
+        keeps a failed flush retry-safe), so a later successful flush
+        restores full durability with nothing lost — the degradation
+        window is exactly the crash-reemission risk the warning
+        declares."""
+        self._journal_degraded = True
+        self.stats.journal_write_errors += 1
+        warnings.warn(
+            f"journal {what} failed ({exc}): serving continues, but "
+            "acks in this window are NOT durable — a crash now may "
+            "re-emit already-delivered events; snapshots are refused "
+            "until a flush succeeds (journal_write_errors="
+            f"{self.stats.journal_write_errors})",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+    def _contained_flush(self, what: str) -> bool:
+        """Flush the journal, absorbing a storage failure as the
+        declared degradation above instead of killing the serving
+        loop.  Returns True when everything appended so far is
+        durable."""
+        try:
+            self._journal.flush()
+        except OSError as exc:
+            self._note_journal_error(what, exc)
+            return False
+        self._journal_degraded = False
+        return True
+
     def _jappend(self, meta: dict, payload: bytes = b"") -> None:
         if self._journal is not None and not self._replaying:
-            self._journal.append(meta, payload)
+            try:
+                self._journal.append(meta, payload)
+            except OSError as exc:
+                # the record itself is safely buffered — only the
+                # flush_every auto-flush can raise here
+                self._note_journal_error("append", exc)
 
     def write_snapshot(self) -> None:
         """Persist full fleet state to the journal (atomic; rotates the
         journal segment).  Called automatically at the snapshot cadence
-        (JournalConfig.snapshot_every) from poll()."""
+        (JournalConfig.snapshot_every) from poll().
+
+        REFUSED while the journal is degraded (a preceding write/fsync
+        failed): the rotation would prune segments while the un-flushed
+        suffix is still the only durable record of delivered events —
+        the acks-not-durable refusal.  A snapshot whose own write fails
+        is absorbed the same way; the pre-failure snapshot + segments
+        stay authoritative (write_snapshot is atomic)."""
         if self._journal is None:
             return
+        if self._journal_degraded and not self._contained_flush(
+            "pre-snapshot flush"
+        ):
+            warnings.warn(
+                "snapshot refused: journal degraded (acks not "
+                "durable); retrying the flush at the next poll",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
         state, arrays = self._snapshot_state()
-        self._journal.write_snapshot(state, arrays)
+        try:
+            self._journal.write_snapshot(state, arrays)
+        except OSError as exc:
+            self._note_journal_error("snapshot", exc)
 
     def _snapshot_state(self) -> tuple[dict, dict]:
         """Everything a dead process needs restated: geometry + config,
@@ -1174,15 +1240,21 @@ class FleetServer:
             and not self._replaying
             and (len(samples) or n_bad)
         ):
-            self._journal.append(
-                {
-                    "t": "push",
-                    "sid": session_id,
-                    "n": len(samples),
-                    "rn": raw_len,
-                },
-                samples.tobytes(),
-            )
+            try:
+                self._journal.append(
+                    {
+                        "t": "push",
+                        "sid": session_id,
+                        "n": len(samples),
+                        "rn": raw_len,
+                    },
+                    samples.tobytes(),
+                )
+            except OSError as exc:
+                # flush_every auto-flush hit a storage fault: contained
+                # (the record stays buffered; push-loss is bounded by
+                # the transport's watermark re-delivery either way)
+                self._note_journal_error("push append", exc)
         # the assembler stages every completed window straight into the
         # arena (one copy, contiguous storage; multi-window bursts stage
         # in one vectorized block write) — batch assembly later is a
@@ -1830,10 +1902,15 @@ class FleetServer:
         if self._journal is not None and not self._replaying:
             # THE ack boundary: every event about to be returned has its
             # ack durable first, so a consumer can never see an event
-            # that recovery would emit again (zero double-scored)
+            # that recovery would emit again (zero double-scored).  A
+            # storage failure here (fsync error, ENOSPC) is contained —
+            # counted + warned, the records stay buffered for the next
+            # flush, events still deliver — instead of an uncaught
+            # exception killing the serving loop; the declared cost is
+            # the re-emission window the warning names.
             prof = self.host_profile
             t_j0 = self._clock() if prof is not None else 0.0
-            self._journal.flush()
+            self._contained_flush("ack flush")
             if prof is not None:
                 prof.journal.record((self._clock() - t_j0) * 1e3)
         return events
@@ -1882,7 +1959,7 @@ class FleetServer:
         self._jappend({"t": "swap", "ver": version})
         self._chaos("mid_swap")
         if self._journal is not None and not self._replaying:
-            self._journal.flush()
+            self._contained_flush("swap flush")
 
     def resize(
         self,
@@ -1989,7 +2066,7 @@ class FleetServer:
         )
         self._chaos("mid_resize")
         if self._journal is not None and not self._replaying:
-            self._journal.flush()
+            self._contained_flush("resize flush")
 
     def set_dispatch_tap(self, tap: Callable | None) -> None:
         """Install (or clear, with None) the mirrored-dispatch consumer.
@@ -2497,16 +2574,22 @@ class FleetServer:
             # HERE like push's record: the dict + tobytes copy are
             # per-EVENT allocations a journal-less fleet must not pay.)
             if journal_live:
-                self._journal.append(
-                    {
-                        "t": "ack",
-                        "sid": sess.sid,
-                        "ti": t_idx_col[j],
-                        "ver": ticket.version,
-                        "shed": shed,
-                    },
-                    np.asarray(probs[i], np.float64).tobytes(),
-                )
+                try:
+                    self._journal.append(
+                        {
+                            "t": "ack",
+                            "sid": sess.sid,
+                            "ti": t_idx_col[j],
+                            "ver": ticket.version,
+                            "shed": shed,
+                        },
+                        np.asarray(probs[i], np.float64).tobytes(),
+                    )
+                except OSError as exc:
+                    # contained like the push append: the ack stays
+                    # buffered; the end-of-poll flush (or a later one)
+                    # lands it, and the degradation is declared
+                    self._note_journal_error("ack append", exc)
             fe = new(FleetEvent)
             fe.__dict__.update(
                 session_id=sess.sid, event=ev, degraded=shed

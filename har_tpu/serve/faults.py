@@ -83,6 +83,45 @@ class FakeClock:
         return self.t
 
 
+class JournalFaults:
+    """Deterministic storage faults for the FleetJournal fault hook
+    (``FleetJournal.fault``): raise an OSError at the Nth occurrence of
+    the chosen journal operation — ``"write"`` (the segment append),
+    ``"fsync"`` (durability sync), or ``"snapshot"`` (the atomic
+    snapshot write).  ``times`` consecutive occurrences fail from that
+    point (a disk that stays full), then the hook goes quiet (space
+    freed) — counter-based, no RNG, so a containment test replays
+    exactly.  ``errno_code`` defaults to ENOSPC; pass ``errno.EIO`` for
+    the dying-disk flavor."""
+
+    def __init__(self, op: str, at: int = 1, times: int = 1,
+                 errno_code: int | None = None):
+        import errno
+
+        if op not in ("write", "fsync", "snapshot"):
+            raise ValueError(f"unknown journal fault op {op!r}")
+        self.op = op
+        self.at = int(at)
+        self.times = int(times)
+        self.errno_code = (
+            errno.ENOSPC if errno_code is None else int(errno_code)
+        )
+        self.hits = 0
+        self.fired = 0
+
+    def __call__(self, op: str) -> None:
+        if op != self.op:
+            return
+        self.hits += 1
+        if self.at <= self.hits < self.at + self.times:
+            self.fired += 1
+            raise OSError(
+                self.errno_code,
+                f"injected journal {op} fault "
+                f"(occurrence {self.hits})",
+            )
+
+
 @dataclasses.dataclass(frozen=True)
 class DeliveryFaults:
     """Transport-side fault probabilities for the load generator.

@@ -85,6 +85,14 @@ _SEG_SUFFIX = ".log"
 _SNAP_PREFIX = "snap."
 _STATE = "state.json"
 _ARRAYS = "arrays.npz"
+# journal-ship receive-side markers (har_tpu.serve.net.ship): a shipped
+# copy of a journal directory carries SHIP_LOG (the durable chunk log)
+# for its whole life and SHIP_DONE only once every file's whole-file
+# digest verified.  load_journal refuses the in-between state — the
+# digest-before-replay rule lives HERE, at the replay layer, so no
+# caller can restore a torn or bit-rotted ship by accident.
+SHIP_LOG = "ship.log"
+SHIP_DONE = "ship.done"
 
 # the on-disk format version, stamped into every snapshot: a future
 # layout change bumps it and keeps this loader working on old dirs
@@ -168,10 +176,28 @@ class FleetJournal:
         self.config = config or JournalConfig()
         os.makedirs(self.root, exist_ok=True)
         self.chaos: Callable[[str], None] | None = None
+        # storage fault hook: called with the operation name ("write" /
+        # "fsync" / "snapshot") right before the real syscall; a test
+        # hook raises OSError (ENOSPC, EIO) there to model a failing
+        # disk.  The ENGINE owns the containment policy (count + warn +
+        # keep serving, har_tpu.serve.engine); this layer only makes a
+        # failed flush RETRY-SAFE (see flush()).
+        self.fault: Callable[[str], None] | None = None
         self._buf: list[bytes] = []
         self._since_snapshot = 0
         self._segment = self._next_segment_index()
         self._fh = open(self._segment_path(self._segment), "ab")
+        # retry-safety bookkeeping: the segment offset below which every
+        # byte is a COMPLETE written record (bytes past it are the torn
+        # tail of an in-flight failed write — the rewind target), and
+        # whether written-but-unsynced bytes still need an fsync (a
+        # failed fsync must be retried even when the record buffer is
+        # empty).  The rewind target must advance on write success, NOT
+        # after the fsync: once the buffer is cleared the file is the
+        # records' only home, and a later failed-write rewind past them
+        # would lose acks a healed journal then claims are durable.
+        self._written_off = self._fh.tell()
+        self._sync_pending = False
         self._killed = False
 
     # ----------------------------------------------------- file layout
@@ -202,16 +228,54 @@ class FleetJournal:
         if len(self._buf) >= self.config.flush_every:
             self.flush()
 
+    def _fault(self, op: str) -> None:
+        if self.fault is not None:
+            self.fault(op)
+
     def flush(self) -> None:
         """Write + fsync the buffered records: everything appended so
-        far is durable once this returns."""
-        if self._killed or not self._buf:
+        far is durable once this returns.
+
+        RETRY-SAFE under storage faults: a failed WRITE (ENOSPC mid-
+        record) truncates the segment back to the last complete-record
+        offset before re-raising, so the retry cannot leave a torn
+        record in the MIDDLE of the log (the torn-tail framing only
+        protects the end — records appended after an interior tear
+        would be silently unreachable at replay); a failed FSYNC keeps
+        the sync-pending flag set, so the next flush re-fsyncs even
+        when no new records arrived.  The rewind target advances with
+        the WRITE, not the fsync: records whose write landed but whose
+        fsync failed live only in the file (the buffer is cleared), so
+        a later failed-write rewind must stop short of them.  The
+        caller (the engine's containment path) decides whether a
+        failure is fatal."""
+        if self._killed:
             return
-        self._fh.write(b"".join(self._buf))
-        self._buf.clear()
-        self._fh.flush()
-        if self.config.fsync:
+        if self._buf:
+            data = b"".join(self._buf)
+            try:
+                self._fault("write")
+                self._fh.write(data)
+                self._fh.flush()
+            except OSError:
+                # rewind to the complete-record prefix: shrinking needs
+                # no disk space, so this succeeds even on a full disk;
+                # if the handle itself is broken the torn tail stays —
+                # and the framing discards it at replay like any kill
+                # tear
+                try:
+                    self._fh.truncate(self._written_off)
+                    self._fh.seek(self._written_off)
+                except OSError:
+                    pass
+                raise
+            self._buf.clear()
+            self._written_off = self._fh.tell()
+            self._sync_pending = True
+        if self._sync_pending and self.config.fsync:
+            self._fault("fsync")
             os.fsync(self._fh.fileno())
+        self._sync_pending = False
 
     @property
     def pending_records(self) -> int:
@@ -239,6 +303,7 @@ class FleetJournal:
         instant leaves either the old snapshot+segments or the new
         ones, never neither."""
         self.flush()
+        self._fault("snapshot")
         nxt = self._segment + 1
         snap = self._snap_path(nxt)
         tmp = snap + ".tmp"
@@ -257,12 +322,32 @@ class FleetJournal:
             f.flush()
             os.fsync(f.fileno())
         self.chaos_point("mid_snapshot")
-        os.replace(tmp, snap)
-        _fsync_dir(self.root)
-        # rotate: the new snapshot covers every earlier segment
+        # failure-ordered rotation: the NEW segment opens BEFORE the
+        # snapshot becomes visible, and the old handle closes only
+        # after both succeeded — a failing open (full disk) aborts
+        # with the old snapshot + old segment + live handle fully
+        # intact (the engine's containment can keep appending), and a
+        # failing rename leaves only a harmless empty wal.<nxt>.
+        # Committing the snapshot BEFORE the segment rotated would be
+        # worse than no snapshot: load_journal reads segments >= the
+        # snapshot's index, so records still landing in the OLD
+        # segment would silently vanish from replay.
+        new_fh = open(self._segment_path(nxt), "ab")
+        try:
+            os.replace(tmp, snap)
+            _fsync_dir(self.root)
+        except BaseException:
+            new_fh.close()
+            try:
+                os.remove(self._segment_path(nxt))
+            except OSError:
+                pass
+            raise
         self._fh.close()
         self._segment = nxt
-        self._fh = open(self._segment_path(nxt), "ab")
+        self._fh = new_fh
+        self._written_off = self._fh.tell()
+        self._sync_pending = False
         self._since_snapshot = 0
         self.prune()
         return snap
@@ -361,6 +446,16 @@ def load_journal(root: str) -> tuple[dict, dict, list[tuple[dict, bytes]]]:
     root = os.path.abspath(os.path.expanduser(root))
     if not os.path.isdir(root):
         raise JournalError(f"no journal directory at {root}")
+    if os.path.exists(os.path.join(root, SHIP_LOG)) and not os.path.exists(
+        os.path.join(root, SHIP_DONE)
+    ):
+        raise JournalError(
+            f"journal directory {root} is a partially shipped copy "
+            "(ship.log without ship.done): the whole-file digests were "
+            "never verified — resume the ship "
+            "(har_tpu.serve.net.ship.fetch_journal); a torn or "
+            "bit-rotted ship is refused, never replayed"
+        )
     snaps = _list_indexed(root, _SNAP_PREFIX)
     state: dict = {}
     arrays: dict = {}

@@ -21,8 +21,14 @@ This package puts a real wire behind that seam:
   client.py     ``NetWorker`` — the transport-backed twin of
                 ``ClusterWorker``: same surface, every call an RPC
   controller.py ``NetCluster`` — ``FleetCluster`` over NetWorkers
-                (failover restores the dead worker's journal from
-                shared disk; hand-offs ride the adopt RPC)
+                (hand-offs ride the adopt RPC; with ship agents
+                registered, failover is SHARED-NOTHING: the dead
+                worker's journal ships over the wire into a private
+                staging dir and is digest-verified before restore)
+  ship.py       the journal-shipping RPC (``har serve-agent``): one
+                agent per worker host streams journal dirs as chunked,
+                manifest-digested, resumable transfers — the failover
+                hand-off currency across a real process boundary
   election.py   replicated controller: wall-clock lease file + fenced
                 campaign; a replica completes ``takeover`` when the
                 leader's lease expires
@@ -37,8 +43,20 @@ election rules and the partition-resolution argument.
 """
 
 from har_tpu.serve.net.client import NetWorker
-from har_tpu.serve.net.controller import NetCluster, launch_workers
+from har_tpu.serve.net.controller import (
+    AgentHandle,
+    NetCluster,
+    launch_agents,
+    launch_workers,
+)
 from har_tpu.serve.net.election import ControllerReplica, LeaderLease
+from har_tpu.serve.net.ship import (
+    ShipAgent,
+    ShipClient,
+    ShipError,
+    ShipUnavailable,
+    fetch_journal,
+)
 from har_tpu.serve.net.rpc import (
     LinkFaults,
     RpcClient,
@@ -60,6 +78,7 @@ from har_tpu.serve.net.wire import (
 )
 
 __all__ = [
+    "AgentHandle",
     "ControllerReplica",
     "FrameBuffer",
     "FrameError",
@@ -74,10 +93,16 @@ __all__ = [
     "RpcError",
     "RpcRemoteError",
     "RpcServer",
+    "ShipAgent",
+    "ShipClient",
+    "ShipError",
+    "ShipUnavailable",
     "decode_events",
     "decode_export",
     "encode_events",
     "encode_export",
+    "fetch_journal",
+    "launch_agents",
     "launch_workers",
     "wire_failover_smoke",
 ]

@@ -2,6 +2,13 @@
 deaths, plus the partition-tolerance matrix the in-process harness
 could not express (a shared-memory shim has no slow links).
 
+SHARED-NOTHING throughout (PR 14): every worker's journal lives in a
+private per-host directory the controller never reads, with one
+journal-ship agent per host (``net/ship.py``) — so every failover in
+every cell exercises the ship RPC, and the matrix gains the ship axis
+(``SHIP_KILL_POINTS``: the agent killed mid-send, the controller
+killed mid-receive or post-verify).
+
 Two matrices:
 
 ``run_net_kill_point`` — every engine stage boundary
@@ -40,6 +47,7 @@ point), with small leases so the suite stays fast.
 
 from __future__ import annotations
 
+import os
 import shutil
 import tempfile
 import time
@@ -48,6 +56,7 @@ from har_tpu.serve.chaos import (
     CLUSTER_KILL_POINTS,
     KILL_POINTS,
     NET_PARTITION_CASES,
+    SHIP_KILL_POINTS,
     _DEFAULT_AT,
     KillPlan,
     SimulatedCrash,
@@ -64,7 +73,11 @@ from har_tpu.serve.cluster.membership import (
 from har_tpu.serve.cluster.router import ConsistentHashRouter
 from har_tpu.serve.faults import FakeClock
 from har_tpu.serve.loadgen import AnalyticDemoModel
-from har_tpu.serve.net.controller import NetCluster, launch_workers
+from har_tpu.serve.net.controller import (
+    NetCluster,
+    launch_agents,
+    launch_workers,
+)
 
 # failure detection tuned for a loopback suite: a dead process refuses
 # instantly, so death lands within ~lease_s of the kill
@@ -72,9 +85,52 @@ _NET_CONFIG = dict(
     lease_s=0.4, probe_retries=2, probe_base_ms=20.0, probe_cap_ms=100.0
 )
 
+# ship pull granularity for the matrix: small enough that the smoke-
+# scale journals span MANY chunks, so the mid_ship_* occurrences land
+# genuinely mid-transfer (durable progress exists, transfer unfinished)
+_MATRIX_CHUNK_BYTES = 4096
+
 
 def _net_cluster_config() -> ClusterConfig:
     return ClusterConfig(**_NET_CONFIG)
+
+
+def _launch_private_fleet(
+    root: str,
+    priv: str,
+    workers: int,
+    *,
+    chaos_worker=None,
+    chaos_point=None,
+    chaos_at=1,
+    agent_chaos_worker=None,
+    agent_chaos_point=None,
+    agent_chaos_at=1,
+    **worker_kwargs,
+):
+    """The shared-nothing launch: each worker journals under its own
+    private host directory ``<priv>/hK/wK`` (the controller never
+    reads it), with one journal-ship agent per host serving it.
+    Returns ``(net_workers, agent_handles)``."""
+    net_workers = launch_workers(
+        root, workers,
+        journal_root=priv,
+        chaos_worker=chaos_worker,
+        chaos_point=chaos_point,
+        chaos_at=chaos_at,
+        **worker_kwargs,
+    )
+    roots = {
+        w.worker_id: os.path.dirname(w.journal_dir)
+        for w in net_workers
+    }
+    handles = launch_agents(
+        roots,
+        chaos_agent=agent_chaos_worker,
+        chaos_point=agent_chaos_point,
+        chaos_at=agent_chaos_at,
+    )
+    return net_workers, handles
 
 
 def predicted_owner(session_id, workers: int, replicas: int | None = None):
@@ -224,8 +280,25 @@ def run_net_kill_point(
 
     The reference is an IN-PROCESS un-killed cluster run of the same
     schedule (FakeClock, no fault hooks) — the acceptance bar is that
-    the wire run's migrated streams are bit-identical to it."""
-    if point not in KILL_POINTS and point not in CLUSTER_KILL_POINTS:
+    the wire run's migrated streams are bit-identical to it.
+
+    SHARED-NOTHING throughout: every worker's journal lives in a
+    private per-host directory the controller never reads; failover
+    journals arrive via the ship RPC from the host's agent process.
+    The ship-axis points (``SHIP_KILL_POINTS``) additionally kill the
+    transfer itself: the victim worker is really SIGKILLed mid-run,
+    and then either the sending agent dies mid-ship (``mid_ship_send``
+    — the harness restarts it, modeling a host daemon restart, and the
+    parked failover resumes from the last durable chunk), the
+    controller dies between chunks (``mid_ship_recv`` — takeover
+    resumes the staged transfer), or the controller dies after the
+    verified ship lands (``post_ship_pre_drain`` — takeover restores
+    the complete staged copy)."""
+    if (
+        point not in KILL_POINTS
+        and point not in CLUSTER_KILL_POINTS
+        and point not in SHIP_KILL_POINTS
+    ):
         raise ValueError(f"unknown net kill point {point!r}")
     at = _DEFAULT_AT[point] if at is None else at
     recordings = _recordings(sessions, n_samples, 3, seed)
@@ -264,20 +337,34 @@ def run_net_kill_point(
     # ---- the wire run -----------------------------------------------
     victim = predicted_owner(0, workers)
     root = tempfile.mkdtemp(prefix="har_netchaos_")
+    priv = tempfile.mkdtemp(prefix="har_netpriv_")
     procs: dict = {}
+    agent_procs: dict = {}
     try:
-        net_workers = launch_workers(
-            root, workers, window=window, hop=hop,
+        net_workers, handles = _launch_private_fleet(
+            root, priv, workers, window=window, hop=hop,
             target_batch=32, max_delay_ms=0.0, retries=1,
             flush_every=flush_every, snapshot_every=snapshot_every,
             chaos_worker=victim if point in KILL_POINTS else None,
             chaos_point=point if point in KILL_POINTS else None,
             chaos_at=at,
+            agent_chaos_worker=(
+                victim if point == "mid_ship_send" else None
+            ),
+            agent_chaos_point=(
+                point if point == "mid_ship_send" else None
+            ),
+            agent_chaos_at=at,
         )
         procs.update({w.worker_id: w.process for w in net_workers})
+        agent_procs.update(
+            {wid: h.process for wid, h in handles.items()}
+        )
         cluster = NetCluster(
             models["A"], root, _workers=net_workers,
             config=_net_cluster_config(), loader=loader,
+            agents={wid: h.client() for wid, h in handles.items()},
+            ship_chunk_bytes=_MATRIX_CHUNK_BYTES,
         )
         for i in range(sessions):
             cluster.add_session(i)
@@ -285,23 +372,44 @@ def run_net_kill_point(
         cursors = [0] * sessions
         balance_log: list = []
         rounds = {"n": 0}
-        plan = None
-        if point in CLUSTER_KILL_POINTS:
-            plan = KillPlan(point, at)
-            cluster.chaos = plan
+        restarted = {"agent": False}
+        controller_points = CLUSTER_KILL_POINTS + (
+            "mid_ship_recv", "post_ship_pre_drain",
+        )
+        if point in controller_points:
+            cluster.chaos = KillPlan(point, at)
 
         def on_round(c):
             rounds["n"] += 1
             if (
-                point in CLUSTER_KILL_POINTS
+                (point in CLUSTER_KILL_POINTS
+                 or point in SHIP_KILL_POINTS)
                 and rounds["n"] == kill_round
             ):
-                # a REAL worker death starts the failover the
-                # controller will die inside of
+                # a REAL worker death starts the failover the chosen
+                # point then kills (the controller, or the transfer)
                 procs[victim].kill()
+            if (
+                point == "mid_ship_send"
+                and agent_procs[victim].poll() is not None
+                and not restarted["agent"]
+            ):
+                # the sending host's agent died at its chunk boundary
+                # (os._exit 137).  Restart it — a host daemon coming
+                # back — and re-register: the parked failover retries
+                # at the next poll and RESUMES from the last durable
+                # chunk, never from scratch.
+                restarted["agent"] = True
+                fresh = launch_agents(
+                    {victim: handles[victim].root}
+                )[victim]
+                handles[victim] = fresh
+                agent_procs[victim] = fresh.process
+                c.register_agent(victim, fresh.client())
             _safe_accounting(c, balance_log)
 
         crashed = False
+        pre_crash_rpc = None
         t0 = time.perf_counter()
         try:
             _net_schedule(
@@ -311,19 +419,18 @@ def run_net_kill_point(
             )
         except SimulatedCrash:
             crashed = True
-        if point in KILL_POINTS:
-            # the victim process must have exited at its stage
-            # boundary; a still-running victim means the occurrence
-            # was never reached
-            if procs[victim].poll() is None:
-                cluster.shutdown_workers()
-                cluster.close()
-                return {
-                    "ok": False, "point": point,
-                    "why": f"kill point {point!r} never fired (at={at})",
-                    "windows_lost": 0, "failover_ms": 0.0,
-                }
-        elif not crashed:
+            # the dead controller's transport evidence (bytes it
+            # shipped before dying) — the takeover's counters restart
+            # at zero, but the matrix judges the WHOLE failover
+            pre_crash_rpc = cluster.transport_stats()
+        fired = (
+            procs[victim].poll() is not None
+            if point in KILL_POINTS
+            else restarted["agent"]
+            if point == "mid_ship_send"
+            else crashed
+        )
+        if not fired:
             cluster.shutdown_workers()
             cluster.close()
             return {
@@ -332,9 +439,10 @@ def run_net_kill_point(
                 "windows_lost": 0, "failover_ms": 0.0,
             }
         if crashed:
-            # the controller died mid-migration; its worker processes
-            # did not.  A fresh controller adopts the still-responsive
-            # workers and completes the orphaned failover — the
+            # the controller died mid-migration (or mid-ship); its
+            # worker processes did not.  A fresh controller adopts the
+            # still-responsive workers, resumes any half-shipped staged
+            # transfer, and completes the orphaned failover — the
             # election layer drives exactly this via the lease file
             survivors = [
                 w for w in cluster._workers.values() if w.alive
@@ -342,6 +450,10 @@ def run_net_kill_point(
             cluster = NetCluster.takeover(
                 models["A"], root, survivors,
                 config=_net_cluster_config(), loader=loader,
+                agents={
+                    wid: h.client() for wid, h in handles.items()
+                },
+                ship_chunk_bytes=_MATRIX_CHUNK_BYTES,
             )
             _net_schedule(
                 cluster, recordings, cursors, hop=hop,
@@ -356,16 +468,43 @@ def run_net_kill_point(
         )
         verdict["transport"] = "tcp"
         verdict["rpc"] = cluster.transport_stats()
+        shipped = verdict["rpc"]["shipped_bytes"]
+        resumes = verdict["rpc"]["ship_resumes"]
+        chunks = verdict["rpc"]["ship_chunks"]
+        if pre_crash_rpc is not None:
+            shipped += pre_crash_rpc["shipped_bytes"]
+            resumes += pre_crash_rpc["ship_resumes"]
+            chunks += pre_crash_rpc["ship_chunks"]
+        verdict["shipped_bytes"] = shipped
+        verdict["ship_chunks"] = chunks
+        verdict["ship_resumes"] = resumes
+        if verdict["ok"] and shipped <= 0:
+            verdict["ok"] = False
+            verdict["why"] = (
+                "failover completed without shipping any journal "
+                "bytes — the shared-nothing path was bypassed"
+            )
+        if (
+            verdict["ok"]
+            and point in ("mid_ship_send", "mid_ship_recv")
+            and resumes < 1
+        ):
+            verdict["ok"] = False
+            verdict["why"] = (
+                f"{point} fired but no transfer RESUMED from durable "
+                "chunks — the ship restarted from scratch"
+            )
         cluster.shutdown_workers()
         cluster.close()
         return verdict
     finally:
-        # never leak worker processes or rmtree under live writers
-        # (clean exits already reaped: kill no-ops on an exited one)
-        for proc in procs.values():
+        # never leak worker/agent processes or rmtree under live
+        # writers (clean exits already reaped: kill no-ops there)
+        for proc in list(procs.values()) + list(agent_procs.values()):
             if proc.poll() is None:
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)
 
 
 # ------------------------------------------------------- partitions
@@ -397,12 +536,18 @@ def run_net_partition(
     model = AnalyticDemoModel()
     victim = predicted_owner(0, workers)
     root = tempfile.mkdtemp(prefix="har_netpart_")
+    priv = tempfile.mkdtemp(prefix="har_netpartpriv_")
     procs: list = []
     try:
+        # private per-worker journal dirs here too: the partition
+        # matrix must prove its zero-failover verdicts without any
+        # shared-disk escape hatch (no agents needed — no partition
+        # case restores a journal)
         net_workers = launch_workers(
             root, workers, window=window, hop=hop,
             target_batch=32, max_delay_ms=0.0,
             deadline_s=0.3, probe_deadline_s=0.2,
+            journal_root=priv,
         )
         procs.extend(w.process for w in net_workers)
         cluster = NetCluster(
@@ -454,6 +599,7 @@ def run_net_partition(
             if proc.poll() is None:
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)
 
 
 def _partition_verdict(cluster, events, balance_log, sessions,
@@ -506,11 +652,13 @@ def _run_split_brain(*, workers, sessions, seed, n_samples, window,
     recordings = _recordings(sessions, n_samples, 3, seed)
     model = AnalyticDemoModel()
     root = tempfile.mkdtemp(prefix="har_netsplit_")
+    priv = tempfile.mkdtemp(prefix="har_netsplitpriv_")
     procs: list = []
     try:
         net_workers = launch_workers(
             root, workers, window=window, hop=hop,
             target_batch=32, max_delay_ms=0.0,
+            journal_root=priv,
         )
         procs.extend(w.process for w in net_workers)
         cluster = NetCluster(
@@ -597,3 +745,4 @@ def _run_split_brain(*, workers, sessions, seed, n_samples, window,
             if proc.poll() is None:
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)

@@ -235,6 +235,16 @@ class NetWorker:
         resp, _ = self._call("control_stats")
         return {k: v for k, v in resp.items() if k != "id"}
 
+    def drift_reports(self) -> list:
+        """Every monitored session's latest DriftReport, float64-exact
+        across the wire (``wire.encode_drift_reports``): the aggregator
+        sees the same z / log-ratio numbers and the same
+        ``(generation, onset)`` episode ids it would in-process, so
+        threshold verdicts and episode dedup cannot drift with the
+        transport."""
+        resp, payload = self._call("drift_reports")
+        return wire.decode_drift_reports(resp, payload)
+
     def note_failover_absorbed(self) -> None:
         self._call("note_failover_absorbed")
 

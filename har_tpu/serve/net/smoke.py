@@ -29,7 +29,9 @@ import numpy as np
 
 from har_tpu.serve.cluster.membership import WorkerUnavailable
 from har_tpu.serve.net.chaos import (
+    _MATRIX_CHUNK_BYTES,
     _drive_net_cluster,
+    _launch_private_fleet,
     _net_cluster_config,
     _safe_accounting,
     predicted_owner,
@@ -39,27 +41,52 @@ from har_tpu.serve.net.controller import NetCluster, launch_workers
 
 def _run_wire_failover(
     sessions: int, workers: int, seed: int, n_samples: int,
-    window: int = 100, hop: int = 50,
+    window: int = 100, hop: int = 50, private: bool = False,
 ) -> dict:
     """One measured wire-failover run: drive, kill the victim process
-    once windows are flowing, let the protocol finish, verdict."""
+    once windows are flowing, let the protocol finish, verdict.
+
+    ``private=True`` is the SHARED-NOTHING variant: every worker's
+    journal lives in its own per-host directory the controller never
+    reads, and the dead partition arrives via the journal-shipping RPC
+    from the host's agent (``har_tpu.serve.net.ship``) — the
+    ``journal_ship_smoke`` / bench-lane configuration.  ``False``
+    keeps the single-box shared-disk restore, which doubles as the
+    bench lane's baseline."""
     from har_tpu.serve.chaos import _recordings
     from har_tpu.serve.loadgen import AnalyticDemoModel
 
     model = AnalyticDemoModel()
     victim = predicted_owner(0, workers)
     root = tempfile.mkdtemp(prefix="har_wire_smoke_")
+    priv = tempfile.mkdtemp(prefix="har_wire_priv_")
     procs: dict = {}
+    agent_procs: dict = {}
     try:
-        net_workers = launch_workers(
-            root, workers, window=window, hop=hop,
-            target_batch=32, max_delay_ms=0.0,
-        )
+        if private:
+            net_workers, handles = _launch_private_fleet(
+                root, priv, workers, window=window, hop=hop,
+                target_batch=32, max_delay_ms=0.0,
+            )
+            agent_procs = {
+                wid: h.process for wid, h in handles.items()
+            }
+            agents = {
+                wid: h.client() for wid, h in handles.items()
+            }
+        else:
+            net_workers = launch_workers(
+                root, workers, window=window, hop=hop,
+                target_batch=32, max_delay_ms=0.0,
+            )
+            agents = None
         procs = {w.worker_id: w.process for w in net_workers}
         cluster = NetCluster(
             model, root, _workers=net_workers,
             config=_net_cluster_config(),
             loader=lambda ver: model,
+            agents=agents,
+            ship_chunk_bytes=_MATRIX_CHUNK_BYTES,
         )
         for i in range(sessions):
             cluster.add_session(i)
@@ -106,6 +133,12 @@ def _run_wire_failover(
             why = f"failovers == {stats['failovers']}, expected 1"
         elif any(not s["balanced"] for s in balance_log):
             why = "conservation violated in a per-round snapshot"
+        rpc = cluster.transport_stats()
+        if why is None and private and rpc["shipped_bytes"] <= 0:
+            why = (
+                "failover completed without shipping any journal "
+                "bytes — the shared-nothing path was bypassed"
+            )
         out = {
             "ok": why is None,
             "why": why,
@@ -129,19 +162,22 @@ def _run_wire_failover(
                 else round(wall_failover_ms, 1)
             ),
             "windows_lost": max(expected - len(keys), 0),
-            "rpc": cluster.transport_stats(),
+            "private_dirs": bool(private),
+            "ship_ms": rpc["ship_ms"],
+            "rpc": rpc,
         }
         cluster.shutdown_workers()
         cluster.close()
         return out
     finally:
-        # a failed run must not leak worker processes, and the rmtree
-        # must never delete the root under live writers (clean exits
-        # already reaped: kill is a no-op on an exited process)
-        for proc in procs.values():
+        # a failed run must not leak worker/agent processes, and the
+        # rmtree must never delete the root under live writers (clean
+        # exits already reaped: kill is a no-op on an exited process)
+        for proc in list(procs.values()) + list(agent_procs.values()):
             if proc.poll() is None:
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)
 
 
 def wire_failover_smoke(
@@ -161,6 +197,94 @@ def wire_failover_smoke(
         "rpc_rtt_p50_ms": out["rpc"]["rpc_rtt_p50_ms"],
         "rpc_retries": out["rpc"]["rpc_retries"],
     }
+
+
+def journal_ship_smoke(
+    sessions: int = 18, workers: int = 3, seed: int = 0
+) -> dict:
+    """Gate verdict for SHARED-NOTHING failover (the journal-shipping
+    tentpole): three subprocess workers with PRIVATE journal
+    directories (one per-host dir each, a ship agent beside it — the
+    controller never reads a worker's filesystem), one worker
+    SIGKILLed mid-dispatch, and the dead partition must arrive over
+    the ship RPC — chunked, digest-verified, restored from the staged
+    copy — before its sessions migrate to the survivors.  The stamp
+    carries ``{shipped_bytes, chunks, resumes, windows_lost}`` (keys
+    pinned by tests/test_release_gate.py)."""
+    out = _run_wire_failover(
+        sessions, workers, seed, n_samples=300, private=True
+    )
+    return {
+        "ok": out["ok"],
+        "why": out["why"],
+        "sessions": out["sessions"],
+        "workers": out["workers"],
+        "transport": out["transport"],
+        "private_dirs": out["private_dirs"],
+        "shipped_bytes": out["rpc"]["shipped_bytes"],
+        "chunks": out["rpc"]["ship_chunks"],
+        "resumes": out["rpc"]["ship_resumes"],
+        "ship_ms": out["rpc"]["ship_ms"],
+        "failover_ms": out["failover_ms"],
+        "windows_lost": out["windows_lost"],
+    }
+
+
+def journal_ship_benchmark(
+    session_counts,
+    n_runs: int = 3,
+    *,
+    workers: int = 3,
+    seed: int = 0,
+    n_samples: int = 300,
+) -> list[dict]:
+    """bench.py's ``journal_ship`` lane rows: per fleet size, the
+    shared-nothing failover measured twice — the SHIPPED run (private
+    dirs + agents: ``ship_ms`` inside fetch_journal, plus the whole
+    failover wall time) against the SHARED-DIR baseline (the same
+    kill, the dead directory restored in place) — so the cost of
+    crossing the process boundary with the recovery currency is a
+    measured delta, not an assumption.  ``contract_ok`` pins the
+    exactly-once + complete-delivery + conservation verdict on every
+    measured run of BOTH modes."""
+    rows = []
+    for n_sessions in session_counts:
+        ship_ms, failover_ms, base_ms = [], [], []
+        shipped_bytes, chunks, ok = 0, 0, True
+        for r in range(int(n_runs)):
+            shipped = _run_wire_failover(
+                int(n_sessions), workers, seed + r, n_samples,
+                private=True,
+            )
+            base = _run_wire_failover(
+                int(n_sessions), workers, seed + r, n_samples,
+                private=False,
+            )
+            ok = ok and shipped["ok"] and base["ok"]
+            ship_ms.append(shipped["rpc"]["ship_ms"])
+            failover_ms.append(shipped["failover_ms"])
+            base_ms.append(base["failover_ms"])
+            shipped_bytes = shipped["rpc"]["shipped_bytes"]
+            chunks = shipped["rpc"]["ship_chunks"]
+        rows.append(
+            {
+                "n_sessions": int(n_sessions),
+                "workers": int(workers),
+                "transport": "tcp",
+                "ship_ms_median": round(float(np.median(ship_ms)), 3),
+                "ship_ms_std": round(float(np.std(ship_ms)), 3),
+                "failover_ms_median": round(
+                    float(np.median(failover_ms)), 3
+                ),
+                "baseline_failover_ms_median": round(
+                    float(np.median(base_ms)), 3
+                ),
+                "shipped_bytes": int(shipped_bytes),
+                "chunks": int(chunks),
+                "contract_ok": ok,
+            }
+        )
+    return rows
 
 
 def wire_failover_benchmark(

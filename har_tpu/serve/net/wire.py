@@ -38,9 +38,7 @@ import zlib
 
 import numpy as np
 
-from har_tpu.serve.engine import FleetEvent
 from har_tpu.serve.journal import _HDR, encode_record
-from har_tpu.serving import StreamEvent
 
 # hard per-frame ceiling: the biggest legitimate frame is a push of a
 # catch-up burst or a whole-partition poll response — megabytes, not
@@ -128,6 +126,67 @@ def decode_samples(meta: dict, payload: bytes) -> np.ndarray:
     )
 
 
+def encode_drift_reports(items) -> tuple[dict, bytes]:
+    """Per-session DriftReport codec: the verdict scalars and the
+    ``(generation, onset)`` episode id in the meta, the float64 z /
+    log-ratio vectors concatenated in the payload — what ships the
+    fleet-global retrain evidence across net workers
+    (``NetCluster.observe_drift``).  float64 ``tobytes`` round-trip is
+    exact, so the aggregator's thresholds and episode dedup see the
+    same numbers on either side of the wire.  Sessions without a
+    monitor (report ``None``) are skipped — same contract as
+    ``RetrainTrigger.observe_server``."""
+    metas: list = []
+    chunks: list = []
+    for sid, rep in items:
+        if rep is None:
+            continue
+        z = np.ascontiguousarray(rep.location_z, np.float64)
+        r = np.ascontiguousarray(rep.scale_log_ratio, np.float64)
+        metas.append(
+            {
+                "sid": sid,
+                "dr": bool(rep.drifting),
+                "n": int(rep.n_samples),
+                "on": None if rep.onset is None else int(rep.onset),
+                "gen": int(rep.generation),
+                "k": int(z.shape[0]),
+            }
+        )
+        chunks.append(z.tobytes())
+        chunks.append(r.tobytes())
+    return {"reports": metas}, b"".join(chunks)
+
+
+def decode_drift_reports(meta: dict, payload: bytes) -> list:
+    """Inverse of ``encode_drift_reports``: ``[(sid, DriftReport)]``."""
+    from har_tpu.monitoring import DriftReport
+
+    out = []
+    pos = 0
+    for em in meta.get("reports") or []:
+        k = int(em["k"])
+        z = np.frombuffer(payload[pos : pos + 8 * k], np.float64)
+        pos += 8 * k
+        r = np.frombuffer(payload[pos : pos + 8 * k], np.float64)
+        pos += 8 * k
+        onset = em.get("on")
+        out.append(
+            (
+                em["sid"],
+                DriftReport(
+                    drifting=bool(em["dr"]),
+                    location_z=z,
+                    scale_log_ratio=r,
+                    n_samples=int(em["n"]),
+                    onset=None if onset is None else int(onset),
+                    generation=int(em.get("gen", 0)),
+                ),
+            )
+        )
+    return out
+
+
 def encode_export(export: dict) -> tuple[dict, bytes]:
     """Session-export codec — the ``adopt`` journal record's layout:
     scalars/votes/monitor state in the meta, ring float32 then EMA
@@ -184,7 +243,12 @@ def encode_events(events: list) -> tuple[dict, bytes]:
     """FleetEvent-list codec — each event the ``ack`` record's shape:
     decision fields in the meta, the probability vector float64 in the
     payload.  Exact: the bit-identity pins compare
-    ``probability.tobytes()`` and float64 round-trips unchanged."""
+    ``probability.tobytes()`` and float64 round-trips unchanged.
+
+    The engine types are imported lazily: the framing half of this
+    module is also what the journal-ship agent (``net/ship.py``) rides,
+    and an agent process streams journal bytes without ever needing the
+    serving engine (or a jax backend) loaded."""
     metas = []
     chunks = []
     for fe in events:
@@ -208,6 +272,9 @@ def encode_events(events: list) -> tuple[dict, bytes]:
 
 
 def decode_events(meta: dict, payload: bytes) -> list:
+    from har_tpu.serve.engine import FleetEvent
+    from har_tpu.serving import StreamEvent
+
     out = []
     pos = 0
     for em in meta.get("events") or []:

@@ -197,6 +197,9 @@ class WorkerHost:
         def undrained(meta, payload):
             return ok({"r": shim.undrained()})
 
+        def drift_reports(meta, payload):
+            return wire.encode_drift_reports(shim.drift_reports())
+
         def note_failover_absorbed(meta, payload):
             shim.note_failover_absorbed()
             return ok()
@@ -233,6 +236,7 @@ class WorkerHost:
             "sessions": sessions,
             "generation": generation,
             "undrained": undrained,
+            "drift_reports": drift_reports,
             "note_failover_absorbed": note_failover_absorbed,
             "note_migration_ms": note_migration_ms,
             "stats_snapshot": stats_snapshot,

@@ -291,6 +291,19 @@ class FleetStats:
         self.rpc_retries = 0
         self.rpc_bytes_tx = 0
         self.rpc_bytes_rx = 0
+        # journal shipping (har_tpu.serve.net.ship): bytes and chunks
+        # pulled over the wire restoring dead partitions, and transfers
+        # that RESUMED from a prior attempt's durable chunk log — the
+        # shared-nothing failover's cost/robustness evidence, counted
+        # on the controller side like the rpc_* family
+        self.shipped_bytes = 0
+        self.ship_chunks = 0
+        self.ship_resumes = 0
+        # storage-fault containment (the journal write-error satellite):
+        # flush/fsync failures the engine absorbed as a declared
+        # degradation instead of dying — while non-zero since the last
+        # clean flush, acks may not be durable and snapshots are refused
+        self.journal_write_errors = 0
         # forward-compat guard (the runtime half of harlint HL002):
         # state keys a NEWER writer persisted that this version does
         # not know — counted and warned in load_state, never silently
@@ -418,6 +431,10 @@ class FleetStats:
             "rpc_retries": self.rpc_retries,
             "rpc_bytes_tx": self.rpc_bytes_tx,
             "rpc_bytes_rx": self.rpc_bytes_rx,
+            "shipped_bytes": self.shipped_bytes,
+            "ship_chunks": self.ship_chunks,
+            "ship_resumes": self.ship_resumes,
+            "journal_write_errors": self.journal_write_errors,
             "resizes": self.resizes,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
@@ -461,6 +478,8 @@ class FleetStats:
         "resizes", "scale_ups", "scale_downs",
         "fused_dispatches", "fetch_bytes", "fetch_bytes_saved",
         "rpc_sent", "rpc_retries", "rpc_bytes_tx", "rpc_bytes_rx",
+        "shipped_bytes", "ship_chunks", "ship_resumes",
+        "journal_write_errors",
         "unknown_state_keys",
     )
     _STAGES = (

@@ -265,6 +265,18 @@ def _wire_smoke() -> dict:
     return _run_smoke("har_tpu.serve.net.smoke", "wire_failover_smoke")
 
 
+def _journal_ship_smoke() -> dict:
+    """Shared-nothing failover smoke verdict (PR 14, har_tpu.serve.
+    net.ship): three subprocess workers with PRIVATE journal
+    directories (one per-host dir + ship agent each — the controller
+    never reads a worker's filesystem), one SIGKILLed mid-dispatch,
+    and the dead partition must arrive over the journal-shipping RPC —
+    chunked, per-chunk-acked, whole-file-digest-verified — before its
+    sessions migrate; the stamp carries ``{shipped_bytes, chunks,
+    resumes, windows_lost}``."""
+    return _run_smoke("har_tpu.serve.net.smoke", "journal_ship_smoke")
+
+
 def _host_plane_smoke() -> dict:
     """Host-plane smoke verdict (PR 12, the SoA session estate):
     batched-vs-sequential ingest bit-identity at N=64 with mid-chunk
@@ -401,6 +413,7 @@ def main(argv=None) -> int:
     harlint = None
     host_plane = None
     wire = None
+    ship = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -417,6 +430,7 @@ def main(argv=None) -> int:
             harlint = prior.get("harlint")
             host_plane = prior.get("host_plane")
             wire = prior.get("wire_failover")
+            ship = prior.get("journal_ship")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -427,6 +441,7 @@ def main(argv=None) -> int:
             harlint = None
             host_plane = None
             wire = None
+            ship = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -550,6 +565,18 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # shared-nothing gate: same kill, PRIVATE journal dirs — the
+        # dead partition must ship over the wire (digest-verified)
+        # before it migrates, stamping {shipped_bytes, chunks,
+        # resumes, windows_lost}
+        ship = _journal_ship_smoke()
+        if not ship.get("ok"):
+            print(
+                "\nrelease_gate: RED journal ship smoke "
+                f"({json.dumps(ship)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -568,6 +595,7 @@ def main(argv=None) -> int:
                 "elastic_smoke": elastic,
                 "host_plane": host_plane,
                 "wire_failover": wire,
+                "journal_ship": ship,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -602,6 +630,9 @@ def main(argv=None) -> int:
                 ),
                 "wire_failover_ok": (
                     None if wire is None else wire["ok"]
+                ),
+                "journal_ship_ok": (
+                    None if ship is None else ship["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

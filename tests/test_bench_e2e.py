@@ -85,7 +85,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
         "fleet_recovery", "cluster_failover", "wire_failover",
-        "elastic_traffic", "host_plane_scaling",
+        "journal_ship", "elastic_traffic", "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -220,6 +220,30 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == wire["failover_ms_median"]
         )
         assert extra["wire_failover_contract_ok"] is True
+    # r19 journal-ship lane: the shared-nothing failover (private
+    # journal dirs, the dead partition pulled over the ship RPC) vs
+    # the shared-dir restore baseline — ship_ms + failover_ms with
+    # contract_ok pinning both modes' full verdicts per measured run;
+    # or a deadline-skip marker; never silently absent
+    ship = extra["lanes"]["journal_ship"]
+    if "skipped" not in ship:
+        assert ship["transport"] == "tcp"
+        assert ship["private_dirs"] is True
+        assert ship["contract_ok"] is True
+        assert ship["ship_ms_median"] > 0
+        assert ship["failover_ms_median"] > 0
+        assert ship["baseline_failover_ms_median"] > 0
+        for row in ship["rows"]:
+            assert row["workers"] == 3
+            assert row["shipped_bytes"] > 0
+            assert row["chunks"] >= 1
+            assert row["contract_ok"] is True
+        assert "chip_state_probe" in ship
+        assert (
+            extra["journal_ship_ms_median"]
+            == ship["ship_ms_median"]
+        )
+        assert extra["journal_ship_contract_ok"] is True
     # r14 elastic-traffic lane: the autoscaled diurnal swing vs the
     # static floor/ceiling configurations under the deterministic
     # dispatch-cost model — the adaptive run must beat the best static

@@ -410,6 +410,7 @@ def test_hl003_acceptance_real_recover_minus_lost_handler():
         "har_tpu/serve/chaos.py",
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -439,6 +440,7 @@ def test_hl003_acceptance_cluster_handoff_handler_and_kill_points():
         "har_tpu/serve/chaos.py",
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -491,6 +493,61 @@ def test_hl003_acceptance_cluster_handoff_handler_and_kill_points():
     )
     assert "'mid_handoff'" in msgs3
     assert "absent from the chaos matrix" in msgs3
+
+
+def test_hl003_acceptance_ship_records_and_ship_kill_points():
+    """The journal-ship extension of the acceptance mutation: the ship
+    log's record family (written by the receiver in net/ship.py,
+    replayed by its own resume loop) and the SHIP_KILL_POINTS tuple
+    join HL003's bijections automatically — deleting the ship-chunk
+    replay handler from the REAL ship.py, or dropping `mid_ship_recv`
+    from the declared ship matrix, must each fail the gate."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JournalExhaustivenessRule()]) == []
+    # (1) deleting the ship-chunk replay handler orphans the record the
+    # receiver fsyncs for every landed chunk — a resumed transfer would
+    # silently forget its durable progress and re-pull from scratch
+    # (or worse, trust an unrecorded torn tail)
+    mutated = dict(sources)
+    mutated["har_tpu/serve/net/ship.py"] = sources[
+        "har_tpu/serve/net/ship.py"
+    ].replace('elif t == "ship_chunk":', 'elif t == "__deleted__":')
+    assert (
+        mutated["har_tpu/serve/net/ship.py"]
+        != sources["har_tpu/serve/net/ship.py"]
+    )
+    msgs = " | ".join(
+        f.message
+        for f in lint_sources(mutated, [JournalExhaustivenessRule()])
+    )
+    assert "'ship_chunk'" in msgs and "no replay handler" in msgs
+    assert "'__deleted__'" in msgs
+    # (2) dropping mid_ship_recv from the declared ship matrix leaves
+    # the receiver's between-chunks kill site un-exercised — flagged
+    mutated2 = dict(sources)
+    mutated2["har_tpu/serve/chaos.py"] = sources[
+        "har_tpu/serve/chaos.py"
+    ].replace('    "mid_ship_recv",\n', "")
+    assert (
+        mutated2["har_tpu/serve/chaos.py"]
+        != sources["har_tpu/serve/chaos.py"]
+    )
+    msgs2 = " | ".join(
+        f.message
+        for f in lint_sources(mutated2, [JournalExhaustivenessRule()])
+    )
+    assert "'mid_ship_recv'" in msgs2
+    assert "absent from the chaos matrix" in msgs2
 
 
 # --------------------------------------------------------------- HL004

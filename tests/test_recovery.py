@@ -978,3 +978,244 @@ def test_unflushed_resize_record_lost_with_pre_resize_capacity(tmp_path):
     assert restored.stats.resizes == 0
     acct = restored.stats.accounting()
     assert acct["balanced"]
+
+
+# -------------------------------- storage-fault containment (PR 14)
+
+
+def test_journal_fsync_fault_contained_and_heals(tmp_path):
+    """An fsync failure during poll() is a counted, declared
+    degradation — events still deliver, ``journal_write_errors``
+    counts, a RuntimeWarning fires — instead of an uncaught exception
+    killing the serving loop; a later clean flush restores full
+    durability with nothing lost (the records stayed buffered /
+    sync-pending), pinned by a crash + restore after the heal."""
+    import warnings as _warnings
+
+    from har_tpu.serve.faults import JournalFaults
+
+    server = FleetServer(
+        _StubModel(), window=100, hop=100, smoothing="ema",
+        config=FleetConfig(max_sessions=4, max_delay_ms=0.0),
+        journal=FleetJournal(
+            str(tmp_path / "j"),
+            JournalConfig(flush_every=512, snapshot_every=0),
+        ),
+    )
+    for i in range(4):
+        server.add_session(i)
+    rng = np.random.default_rng(0)
+    server.journal.fault = JournalFaults("fsync", at=1, times=2)
+    delivered = 0
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        for _ in range(3):
+            for i in range(4):
+                server.push(
+                    i, rng.normal(size=(100, 3)).astype(np.float32)
+                )
+            delivered += len(server.poll(force=True))
+    assert delivered == 12  # the loop never died, events delivered
+    assert server.stats.journal_write_errors == 2
+    assert not server._journal_degraded  # third flush healed
+    warns = [
+        w for w in caught if issubclass(w.category, RuntimeWarning)
+        and "NOT durable" in str(w.message)
+    ]
+    assert len(warns) == 2
+    # after the heal, everything is durable: SIGKILL + restore sees
+    # every ack exactly once, and the error counter rides the healed
+    # snapshot like any other stats counter
+    server.write_snapshot()
+    expected = server.stats.scored
+    server.journal.kill()
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["scored"] == expected
+    assert restored.stats.journal_write_errors == 2  # counter persists
+
+
+def test_journal_write_fault_enospc_contained(tmp_path):
+    """The ENOSPC flavor: the segment WRITE fails — the record buffer
+    is kept (FleetJournal's retry-safe flush), serving continues, and
+    once space 'frees' the buffered records land intact (no torn
+    middle, no duplicates) — pinned through a crash + replay."""
+    import warnings as _warnings
+
+    from har_tpu.serve.faults import JournalFaults
+
+    server = FleetServer(
+        _StubModel(), window=100, hop=100, smoothing="ema",
+        config=FleetConfig(max_sessions=2, max_delay_ms=0.0),
+        journal=FleetJournal(
+            str(tmp_path / "j"),
+            JournalConfig(flush_every=512, snapshot_every=0),
+        ),
+    )
+    for i in range(2):
+        server.add_session(i)
+    rng = np.random.default_rng(1)
+    server.journal.fault = JournalFaults("write", at=1, times=1)
+    with _warnings.catch_warnings(record=True):
+        _warnings.simplefilter("always")
+        for i in range(2):
+            server.push(
+                i, rng.normal(size=(100, 3)).astype(np.float32)
+            )
+        events = server.poll(force=True)  # flush fails, contained
+    assert len(events) == 2
+    assert server.stats.journal_write_errors == 1
+    assert server._journal_degraded
+    server.poll(force=True)  # clean flush: the buffered records land
+    assert not server._journal_degraded
+    expected = server.stats.scored
+    server.journal.kill()
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["scored"] == expected
+
+
+def test_journal_fsync_then_write_fault_loses_nothing(tmp_path):
+    """The COMPOUND storage fault: flush #1's write lands but its fsync
+    fails (records now live ONLY in the file — the buffer is cleared),
+    then flush #2's WRITE fails.  The failed-write rewind must truncate
+    back to the end of flush #1's records, not the last fsync-durable
+    offset — rewinding past write-landed-but-unsynced records would
+    silently drop their acks while a later clean flush reports the
+    journal fully healed.  Pinned through heal + crash + restore:
+    every ack exactly once."""
+    import warnings as _warnings
+
+    from har_tpu.serve.faults import JournalFaults
+
+    server = FleetServer(
+        _StubModel(), window=100, hop=100, smoothing="ema",
+        config=FleetConfig(max_sessions=2, max_delay_ms=0.0),
+        journal=FleetJournal(
+            str(tmp_path / "j"),
+            JournalConfig(flush_every=512, snapshot_every=0),
+        ),
+    )
+    for i in range(2):
+        server.add_session(i)
+    rng = np.random.default_rng(3)
+
+    def _round(fault_op):
+        server.journal.fault = (
+            JournalFaults(fault_op, at=1, times=1) if fault_op else None
+        )
+        for i in range(2):
+            server.push(
+                i, rng.normal(size=(100, 3)).astype(np.float32)
+            )
+        return len(server.poll(force=True))
+
+    with _warnings.catch_warnings(record=True):
+        _warnings.simplefilter("always")
+        delivered = _round("fsync")   # write lands, fsync fails
+        delivered += _round("write")  # write fails -> rewind
+        delivered += _round(None)     # heals: everything lands
+    assert delivered == 6  # the loop never died, events delivered
+    assert server.stats.journal_write_errors == 2
+    assert not server._journal_degraded
+    expected = server.stats.scored
+    server.journal.kill()
+    restored = FleetServer.restore(str(tmp_path / "j"), _StubModel())
+    acct = restored.stats.accounting()
+    # every ack exactly once (the counter itself rides SNAPSHOTS, and
+    # this test deliberately never writes one — see the fsync test for
+    # the counter round-trip pin)
+    assert acct["balanced"] and acct["scored"] == expected
+
+
+def test_snapshot_refused_while_journal_degraded(tmp_path):
+    """The acks-not-durable refusal: while a storage fault keeps the
+    flush failing, write_snapshot refuses (warning, no new snap dir —
+    a rotation would prune segments the un-flushed suffix still
+    needs); the refusal lifts with the fault."""
+    import warnings as _warnings
+
+    from har_tpu.serve.faults import JournalFaults
+
+    server = FleetServer(
+        _StubModel(), window=100, hop=100, smoothing="ema",
+        config=FleetConfig(max_sessions=1, max_delay_ms=0.0),
+        journal=FleetJournal(
+            str(tmp_path / "j"),
+            JournalConfig(flush_every=512, snapshot_every=0),
+        ),
+    )
+    server.add_session(0)
+    rng = np.random.default_rng(2)
+    server.journal.fault = JournalFaults("fsync", at=1, times=100)
+    with _warnings.catch_warnings(record=True):
+        _warnings.simplefilter("always")
+        server.push(0, rng.normal(size=(100, 3)).astype(np.float32))
+        server.poll(force=True)
+        assert server._journal_degraded
+        snaps_before = sorted(
+            n for n in os.listdir(tmp_path / "j")
+            if n.startswith("snap.")
+        )
+        with pytest.warns(RuntimeWarning, match="snapshot refused"):
+            server.write_snapshot()
+        snaps_after = sorted(
+            n for n in os.listdir(tmp_path / "j")
+            if n.startswith("snap.")
+        )
+        assert snaps_after == snaps_before  # refused: nothing rotated
+    server.journal.fault = None
+    server.poll(force=True)  # heals
+    server.write_snapshot()
+    snaps_final = sorted(
+        n for n in os.listdir(tmp_path / "j")
+        if n.startswith("snap.")
+    )
+    assert len(snaps_final) == 1 and snaps_final != snaps_before
+    server.journal.close()
+
+
+def test_stats_ship_and_journal_error_counters_roundtrip():
+    """The PR-14 counters (shipped_bytes / ship_chunks / ship_resumes
+    + journal_write_errors) round-trip through state()/load_state, and
+    a PRE-ship state dict missing them entirely loads with zero
+    defaults and no unknown-key warning — both directions pinned
+    (HL002's runtime contract)."""
+    s = FleetStats()
+    s.enqueued = 2
+    s.note_scored(2, "v1")
+    s.shipped_bytes = 12345
+    s.ship_chunks = 9
+    s.ship_resumes = 1
+    s.journal_write_errors = 3
+    state = json.loads(json.dumps(s.state()))
+    s2 = FleetStats()
+    s2.load_state(state)
+    assert s2.shipped_bytes == 12345
+    assert s2.ship_chunks == 9
+    assert s2.ship_resumes == 1
+    assert s2.journal_write_errors == 3
+    snap = s2.snapshot()
+    assert snap["shipped_bytes"] == 12345
+    assert snap["ship_chunks"] == 9
+    assert snap["ship_resumes"] == 1
+    assert snap["journal_write_errors"] == 3
+    # pre-ship state: the counters absent entirely — zero defaults,
+    # no unknown-key warning in either direction
+    old = json.loads(json.dumps(state))
+    for k in (
+        "shipped_bytes", "ship_chunks", "ship_resumes",
+        "journal_write_errors",
+    ):
+        old["counters"].pop(k)
+    s3 = FleetStats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        s3.load_state(old)
+    assert s3.shipped_bytes == 0
+    assert s3.ship_chunks == 0
+    assert s3.ship_resumes == 0
+    assert s3.journal_write_errors == 0
+    assert s3.accounting()["balanced"]

@@ -217,6 +217,31 @@ def test_gate_log_carries_wire_failover_verdict():
     assert wire["failover_ms"] >= 0
 
 
+def test_gate_log_carries_journal_ship_verdict():
+    """The shared-nothing counterpart of the wire verdict (PR 14,
+    har_tpu.serve.net.ship): the gate log must carry a green
+    journal-ship check with the {shipped_bytes, chunks, resumes,
+    windows_lost} stamp — three subprocess workers with PRIVATE
+    journal directories, one SIGKILLed mid-dispatch, the dead
+    partition shipped over the RPC transport (chunked, digest-
+    verified) before its sessions migrate, zero windows lost."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    ship = log.get("journal_ship")
+    assert ship, (
+        "artifacts/test_gate.json lacks the journal_ship verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in ("shipped_bytes", "chunks", "resumes", "windows_lost"):
+        assert key in ship
+    assert ship["ok"] is True
+    assert ship["private_dirs"] is True
+    assert ship["shipped_bytes"] > 0
+    assert ship["chunks"] >= 1
+    assert ship["windows_lost"] == 0
+
+
 def test_gate_log_carries_elastic_smoke_verdict():
     """The elastic counterpart of the cluster verdict: the gate log
     must carry a green elastic-traffic check with the {swing, resizes,

@@ -1,0 +1,895 @@
+"""Shared-nothing failover (har_tpu.serve.net.ship): the journal-
+shipping RPC, its fault matrix, and the drift-report wire.
+
+The load-bearing claims, all pinned here:
+
+  - the recovery currency CROSSES A PROCESS BOUNDARY intact: a dead
+    worker's journal, shipped chunk-by-chunk from its host's agent
+    into a private staging directory, restores bit-identically to an
+    in-place restore;
+  - every way a transfer can go wrong is REFUSED, never replayed:
+    truncated chunks, mis-sequenced (reordered) responses, duplicated
+    frames, torn receive-side tails, and whole-file digest mismatches
+    (bit rot / a lying peer) — a garbled ship re-ships, a provably
+    corrupt source raises, and a half-shipped directory cannot be
+    restored at all (``load_journal``'s digest-before-replay guard);
+  - a mid-ship crash on EITHER end resumes from the last durable
+    chunk (the ship log is the journal's own CRC record framing, so a
+    torn log tail is discarded exactly like a torn journal tail);
+  - the full failover chaos matrix holds with NO shared filesystem
+    between worker journal dirs (the ship-axis kill points run in
+    tests/test_net.py's matrix style here: the victim worker REALLY
+    SIGKILLed, then the agent / the controller killed mid-transfer);
+  - drift reports ride the same transport: ``NetCluster.observe_drift``
+    (refused before this PR) fires the fleet-global retrain trigger
+    for K sessions spread across worker processes, K−1 does not, and
+    re-delivery of the same stored reports is a no-op.
+"""
+
+import json
+import os
+import re
+import shutil
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from har_tpu.monitoring import DriftMonitor
+from har_tpu.serve.chaos import SHIP_KILL_POINTS, _DEFAULT_AT
+from har_tpu.serve.engine import FleetConfig, FleetServer
+from har_tpu.serve.journal import (
+    SHIP_DONE,
+    SHIP_LOG,
+    FleetJournal,
+    JournalConfig,
+    JournalError,
+)
+from har_tpu.serve.loadgen import AnalyticDemoModel
+from har_tpu.serve.net.chaos import (
+    _net_cluster_config,
+    run_net_kill_point,
+)
+from har_tpu.serve.net.controller import NetCluster, launch_workers
+from har_tpu.serve.net.rpc import LinkFaults, RpcServer
+from har_tpu.serve.net.ship import (
+    ShipAgent,
+    ShipClient,
+    ShipError,
+    ShipFaults,
+    ShipTorn,
+    ShipUnavailable,
+    fetch_journal,
+    journal_manifest,
+    replay_ship_log,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+MODEL = AnalyticDemoModel()
+
+
+# ------------------------------------------------------------ fixtures
+
+
+def _journaled_fleet(jdir, *, sessions=4, rounds=6, seed=0,
+                     snapshot_every=30):
+    """A journaled fleet with real traffic, killed (SIGKILL model) so
+    the directory is exactly what a dead worker leaves: a snapshot, a
+    segment suffix, a torn-tail-free ack history."""
+    server = FleetServer(
+        MODEL, window=100, hop=50, channels=3, smoothing="ema",
+        config=FleetConfig(max_sessions=sessions),
+        journal=FleetJournal(
+            jdir, JournalConfig(flush_every=8, snapshot_every=snapshot_every)
+        ),
+    )
+    rng = np.random.default_rng(seed)
+    for i in range(sessions):
+        server.add_session(i)
+    events = []
+    for _ in range(rounds):
+        for i in range(sessions):
+            server.push(i, rng.normal(size=(50, 3)).astype(np.float32))
+        events.extend(server.poll(force=True))
+    server.journal.kill()
+    return events
+
+
+class _AgentThread:
+    """An in-process ShipAgent on a background thread — the unit tests'
+    stand-in for the agent subprocess (the subprocess path is covered
+    by the smoke + matrix tests below)."""
+
+    def __init__(self, root):
+        self.agent = ShipAgent(root)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.agent.rpc.step(0.02)
+
+    def client(self, **kw) -> ShipClient:
+        return ShipClient(self.agent.rpc.host, self.agent.rpc.port, **kw)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.agent.close()
+
+
+@pytest.fixture()
+def shipped_env(tmp_path):
+    """(client, host_root, jdir) over a killed journaled fleet."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    _journaled_fleet(str(jdir))
+    srv = _AgentThread(str(host_root))
+    client = srv.client()
+    try:
+        yield client, str(host_root), str(jdir)
+    finally:
+        client.close()
+        srv.close()
+
+
+def _dir_digest(root):
+    """{relpath: bytes} of a journal dir's manifest file set."""
+    out = {}
+    for entry in journal_manifest(root):
+        with open(os.path.join(root, entry["f"]), "rb") as f:
+            out[entry["f"]] = f.read()
+    return out
+
+
+# ----------------------------------------------------- the happy path
+
+
+def test_ship_roundtrip_is_byte_exact_and_restores(shipped_env, tmp_path):
+    client, host_root, jdir = shipped_env
+    dest = str(tmp_path / "staged" / "w0")
+    out = fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    assert out["chunks"] > 1 and out["bytes"] > 0
+    assert out["resumes"] == 0 and out["reshipped"] == 0
+    # the shipped copy is the source, byte for byte
+    assert _dir_digest(dest) == _dir_digest(jdir)
+    # and the restored engine is the in-place restore, state for state
+    shipped = FleetServer.restore(dest, MODEL)
+    inplace = FleetServer.restore(jdir, MODEL)
+    assert (
+        shipped.stats.accounting() == inplace.stats.accounting()
+    )
+    assert sorted(shipped.sessions) == sorted(inplace.sessions)
+    for sid in shipped.sessions:
+        assert (
+            shipped.export_session(sid)["ring"].tobytes()
+            == inplace.export_session(sid)["ring"].tobytes()
+        )
+
+
+def test_ship_is_idempotent_after_done(shipped_env, tmp_path):
+    """A re-issued fetch of a completed transfer is a no-op — the done
+    marker short-circuits before a single RPC."""
+    client, _, _ = shipped_env
+    dest = str(tmp_path / "w0")
+    fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    before = _dir_digest(dest)
+    again = fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    assert again == {
+        "bytes": 0, "chunks": 0, "resumes": 0, "reshipped": 0,
+        "files": 0,
+    }
+    assert _dir_digest(dest) == before
+
+
+def test_manifest_is_the_load_journal_file_set(shipped_env):
+    """The manifest ships exactly what a restore reads: the newest
+    complete snapshot's files + segments at/after its rotation."""
+    client, _, jdir = shipped_env
+    names = {e["f"] for e in client.manifest("w0")}
+    snaps = sorted(
+        n for n in os.listdir(jdir) if n.startswith("snap.")
+    )
+    newest = snaps[-1]
+    base = int(newest.split(".")[1])
+    expect = {f"{newest}/state.json", f"{newest}/arrays.npz"}
+    expect |= {
+        n
+        for n in os.listdir(jdir)
+        if n.startswith("wal.") and int(n.split(".")[1]) >= base
+    }
+    assert names == expect
+
+
+# --------------------------------------------- adversarial transfers
+
+
+def _lying_chunk_server(jdir, mutate):
+    """An RpcServer speaking the ship surface whose ship_chunk response
+    is rewritten by ``mutate(meta, payload) -> (meta, payload)`` — the
+    adversarial / buggy peer the receiver must refuse."""
+    agent = ShipAgent(os.path.dirname(jdir))
+    handlers = dict(agent.rpc.handlers)
+    real = handlers["ship_chunk"]
+
+    def ship_chunk(meta, payload):
+        rmeta, rpayload = real(meta, payload)
+        return mutate(dict(rmeta), rpayload)
+
+    handlers["ship_chunk"] = ship_chunk
+    agent.rpc.close()
+    srv = RpcServer(handlers)
+    return srv
+
+
+class _LyingThread:
+    def __init__(self, srv):
+        self.srv = srv
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.srv.step(0.02)
+
+    def close(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+        self.srv.close()
+
+
+@pytest.mark.parametrize(
+    "name,mutate",
+    [
+        # a chunk shorter than its declared length (truncated in the
+        # peer's read path) — the length echo refuses it
+        ("truncated", lambda m, p: (m, p[: max(0, len(p) - 3)])),
+        # a response for the WRONG offset (reordering surviving the
+        # rpc dedup) — landing it would interleave file regions
+        ("reordered", lambda m, p: ({**m, "off": m["off"] + 1}, p)),
+        # a response for the wrong file entirely
+        ("wrong_file", lambda m, p: ({**m, "f": "wal.999.log"}, p)),
+    ],
+)
+def test_mis_sequenced_chunk_responses_are_refused(
+    tmp_path, name, mutate
+):
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    _journaled_fleet(str(jdir))
+    srv = _LyingThread(_lying_chunk_server(str(jdir), mutate))
+    client = ShipClient(srv.srv.host, srv.srv.port)
+    dest = str(tmp_path / "staged")
+    try:
+        with pytest.raises(ShipError, match="mis-sequenced|short read"):
+            fetch_journal(client, "w0", dest, chunk_bytes=512)
+        # nothing half-applied is restorable
+        with pytest.raises(JournalError, match="partially shipped"):
+            FleetServer.restore(dest, MODEL)
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_duplicated_chunk_frames_are_idempotent(shipped_env, tmp_path):
+    """Every ship_chunk frame delivered twice (LinkFaults dup): the
+    server's request-id dedup answers the duplicate from cache, the
+    pull-by-offset protocol is idempotent anyway, and the shipped copy
+    stays byte-exact."""
+    client, _, jdir = shipped_env
+    client._client.faults = LinkFaults("dup", method="ship_chunk",
+                                       times=10**9)
+    dest = str(tmp_path / "w0")
+    out = fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    assert out["chunks"] > 1
+    assert _dir_digest(dest) == _dir_digest(jdir)
+    assert FleetServer.restore(dest, MODEL).stats.accounting()[
+        "balanced"
+    ]
+
+
+def test_garbled_chunk_refused_by_digest_and_reshipped(
+    shipped_env, tmp_path
+):
+    """Silent corruption past the wire CRC (a byte flipped between
+    receive and disk): the whole-file digest refuses the ship BEFORE
+    any replay, the file re-ships from zero, and the final copy is
+    byte-exact — 'refused and re-shipped rather than replayed'."""
+    client, _, jdir = shipped_env
+    dest = str(tmp_path / "w0")
+    out = fetch_journal(
+        client, "w0", dest, chunk_bytes=1024,
+        faults=ShipFaults("garble", at=2),
+    )
+    assert out["reshipped"] == 1
+    assert _dir_digest(dest) == _dir_digest(jdir)
+
+
+def test_corrupt_source_is_refused_never_replayed(tmp_path):
+    """A source whose manifest digest can never be satisfied (bit rot
+    on the dead host, a lying peer): the re-ship budget exhausts into
+    a loud ShipError and the staging dir stays un-restorable."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    _journaled_fleet(str(jdir))
+
+    def garble_digest(meta, payload):
+        return meta, payload
+
+    srv_raw = _lying_chunk_server(str(jdir), garble_digest)
+    # rewrite the MANIFEST digests instead: every shipped file then
+    # fails its whole-file check no matter how often it re-ships
+    real_manifest = srv_raw.handlers["ship_manifest"]
+
+    def ship_manifest(meta, payload):
+        rmeta, rpayload = real_manifest(meta, payload)
+        for entry in rmeta["files"]:
+            entry["sha256"] = "0" * 64
+        return rmeta, rpayload
+
+    srv_raw.handlers["ship_manifest"] = ship_manifest
+    srv = _LyingThread(srv_raw)
+    client = ShipClient(srv.srv.host, srv.srv.port)
+    dest = str(tmp_path / "staged")
+    try:
+        with pytest.raises(ShipError, match="digest"):
+            fetch_journal(client, "w0", dest, chunk_bytes=512,
+                          reships=1)
+        assert not os.path.exists(os.path.join(dest, SHIP_DONE))
+        with pytest.raises(JournalError, match="partially shipped"):
+            FleetServer.restore(dest, MODEL)
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_agent_unreachable_is_ship_unavailable():
+    client = ShipClient("127.0.0.1", 1)  # nobody listens on port 1
+    with pytest.raises(ShipUnavailable):
+        client.manifest("w0")
+    client.close()
+
+
+# ------------------------------------------------- resume / ship log
+
+
+def test_ship_log_records_pinned_against_their_handlers():
+    """The ship record family's writer/handler bijection, pinned at
+    the source level like the wire codec fuzz pins recover.py: every
+    ``ship_journal.append({"t": ...})`` type has a ``t == "..."``
+    branch in the resume replay, and vice versa (harlint HL003 checks
+    the same sets statically)."""
+    src = (REPO / "har_tpu" / "serve" / "net" / "ship.py").read_text()
+    written = set(re.findall(r'append\(\s*\{"t": "(ship_\w+)"', src))
+    handled = set(re.findall(r't == "(ship_\w+)"', src))
+    assert written == handled == {
+        "ship_begin", "ship_chunk", "ship_void", "ship_file",
+        "ship_done",
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_resume_mid_ship_property(shipped_env, tmp_path, seed):
+    """THE resume property: kill the transfer at a random chunk (torn
+    receive — the written-but-unrecorded tail must be discarded),
+    resume, and the shipped copy is byte-exact with genuinely partial
+    progress carried over; the restored engine replays with zero
+    double-scored events (accounting balanced, scored == in-place)."""
+    client, _, jdir = shipped_env
+    rng = np.random.default_rng((seed, 0x5417))
+    dest = str(tmp_path / f"w0_{seed}")
+    kill_at = int(rng.integers(2, 12))
+    with pytest.raises(ShipTorn):
+        fetch_journal(
+            client, "w0", dest, chunk_bytes=768,
+            faults=ShipFaults("torn", at=kill_at),
+        )
+    prog = replay_ship_log(dest)
+    assert not prog.done
+    carried = sum(prog.offsets.values())
+    out = fetch_journal(client, "w0", dest, chunk_bytes=768)
+    assert out["resumes"] == 1
+    # durable pre-crash chunks were NOT re-shipped
+    total = sum(e["size"] for e in client.manifest("w0"))
+    assert out["bytes"] == total - carried
+    assert _dir_digest(dest) == _dir_digest(jdir)
+    shipped = FleetServer.restore(dest, MODEL)
+    inplace = FleetServer.restore(jdir, MODEL)
+    assert shipped.stats.accounting() == inplace.stats.accounting()
+    assert shipped.stats.accounting()["balanced"]
+
+
+def test_crash_between_done_record_and_marker_resumes_clean(
+    shipped_env, tmp_path
+):
+    """The last crash window: every digest verified, the ship_done
+    record durable, but the process died before the SHIP_DONE marker
+    landed.  The resume must re-land the marker from the log's verdict
+    (zero re-pulled chunks) — otherwise the fully-verified copy would
+    stay refused by the digest-before-replay guard forever."""
+    client, _, _ = shipped_env
+    dest = str(tmp_path / "w0")
+    fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    os.remove(os.path.join(dest, SHIP_DONE))  # the crash window
+    with pytest.raises(JournalError, match="digest|partially"):
+        FleetServer.restore(dest, MODEL)
+    out = fetch_journal(client, "w0", dest, chunk_bytes=1024)
+    assert out["chunks"] == 0  # nothing re-pulled
+    assert os.path.exists(os.path.join(dest, SHIP_DONE))
+    assert FleetServer.restore(dest, MODEL).stats.accounting()[
+        "balanced"
+    ]
+
+
+def test_half_shipped_directory_cannot_be_restored(
+    shipped_env, tmp_path
+):
+    """The digest-before-replay rule, enforced at the REPLAY layer: a
+    staging dir holding ship.log without ship.done refuses
+    load_journal no matter which caller asks — a torn ship cannot be
+    replayed by accident."""
+    client, _, _ = shipped_env
+    dest = str(tmp_path / "w0")
+    with pytest.raises(ShipTorn):
+        fetch_journal(client, "w0", dest, chunk_bytes=512,
+                      faults=ShipFaults("torn", at=3))
+    assert os.path.exists(os.path.join(dest, SHIP_LOG))
+    assert not os.path.exists(os.path.join(dest, SHIP_DONE))
+    with pytest.raises(JournalError, match="digest"):
+        FleetServer.restore(dest, MODEL)
+
+
+# ------------------------------------ the shared-nothing chaos matrix
+
+
+def test_ship_kill_points_declared_and_calibrated():
+    assert SHIP_KILL_POINTS == (
+        "mid_ship_send", "mid_ship_recv", "post_ship_pre_drain",
+    )
+    for p in SHIP_KILL_POINTS:
+        assert p in _DEFAULT_AT
+
+
+@pytest.mark.parametrize("point", SHIP_KILL_POINTS)
+def test_ship_axis_kill_matrix(point):
+    """THE shared-nothing acceptance pin: the victim worker REALLY
+    SIGKILLed with its journal in a private per-host directory, and
+    the transfer itself killed at the chosen boundary — the sending
+    agent (restarted, the failover resumes from the last durable
+    chunk), the receiving controller (takeover resumes the staged
+    transfer), or post-verify pre-drain (takeover restores the
+    complete copy).  Migrated streams bit-identical to the un-killed
+    in-process run, zero double-scored, zero lost, conservation in
+    every observable snapshot — and the mid-ship kills must prove a
+    genuine RESUME (ship_resumes >= 1)."""
+    out = run_net_kill_point(point)
+    assert out["ok"], (point, out["why"])
+    assert out["windows_lost"] == 0
+    assert out["failovers"] >= 1
+    assert out["migrated_sessions"] >= 1
+    assert out["shipped_bytes"] > 0
+    if point in ("mid_ship_send", "mid_ship_recv"):
+        assert out["ship_resumes"] >= 1
+
+
+def test_journal_ship_smoke_verdict_green():
+    """The release gate's shared-nothing stage, run in-tier: 3 workers
+    with private journal dirs + agents, one SIGKILLed mid-dispatch,
+    failover entirely via the shipped journal — the stamp keys the
+    gate log carries must be present and green."""
+    from har_tpu.serve.net.smoke import journal_ship_smoke
+
+    out = journal_ship_smoke()
+    assert out["ok"], out["why"]
+    assert out["private_dirs"] is True
+    assert out["shipped_bytes"] > 0
+    assert out["chunks"] >= 1
+    assert out["resumes"] == 0  # no mid-ship kill in the smoke
+    assert out["windows_lost"] == 0
+    json.dumps(out)  # gate-stamp JSON-serializable
+
+
+# ------------------------------------------- drift over the wire
+
+
+def _drifted_net_fleet(root, priv, *, n_sessions, drifted):
+    """A 2-process net cluster with monitored sessions, ``drifted`` of
+    them pushed a +25 population shift."""
+    workers = launch_workers(root, 2, window=100, hop=100,
+                             journal_root=priv)
+    cluster = NetCluster(
+        MODEL, root, _workers=workers,
+        config=_net_cluster_config(), loader=lambda ver: MODEL,
+    )
+    rng = np.random.default_rng(7)
+    for i in range(n_sessions):
+        cluster.add_session(
+            i,
+            monitor=DriftMonitor(
+                np.zeros(3), np.ones(3), halflife=50.0, patience=2
+            ),
+        )
+    for _ in range(4):
+        for i in range(n_sessions):
+            chunk = rng.normal(size=(100, 3)).astype(np.float32)
+            if i < drifted:
+                chunk = chunk + 25.0
+            cluster.push(i, chunk)
+        cluster.poll(force=True)
+    return cluster, [w.process for w in workers]
+
+
+def test_observe_drift_fires_across_net_workers_and_dedups(tmp_path):
+    """Both directions of the fleet-global escalation over the wire,
+    plus re-delivery safety: K sessions drifting on a common channel
+    ACROSS worker processes fire the trigger; K−1 do not; and pulling
+    the same stored reports again (engine cadence, RPC re-delivery)
+    neither double-fires nor refreshes dead evidence — the
+    ``(generation, onset)`` episode ids and the n_samples stale guard
+    survive the codec."""
+    from collections import Counter
+
+    from har_tpu.adapt.trigger import RetrainTrigger, TriggerConfig
+
+    K = 4
+    root = str(tmp_path / "root")
+    priv = str(tmp_path / "priv")
+    cluster, procs = _drifted_net_fleet(
+        root, priv, n_sessions=K + 2, drifted=K
+    )
+    try:
+        spread = Counter(
+            cluster._placement[i] for i in range(K)
+        )
+        assert len(spread) == 2, (
+            "harness assumption: the drifted cohort must span both "
+            f"workers (got {spread})"
+        )
+        cfg = TriggerConfig(
+            min_sessions=K, window_s=1e9, cooldown_s=0.0,
+            recovery_patience=1,
+        )
+        # K drifted across workers -> fires, with the drifted cohort
+        trigger = RetrainTrigger(cfg)
+        cluster.observe_drift(trigger)
+        job = trigger.poll()
+        assert job is not None
+        assert sorted(job.session_ids) == list(range(K))
+        # re-delivery: the same stored reports pulled again are stale
+        # no-ops — no re-fire even with cooldown 0 (episodes alerted,
+        # evidence not re-counted)
+        cluster.observe_drift(trigger)
+        assert trigger.poll() is None
+        cluster.shutdown_workers()
+        cluster.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)
+
+
+def test_observe_drift_below_threshold_does_not_fire(tmp_path):
+    K = 4
+    root = str(tmp_path / "root")
+    priv = str(tmp_path / "priv")
+    cluster, procs = _drifted_net_fleet(
+        root, priv, n_sessions=K + 2, drifted=K - 1
+    )
+    try:
+        from har_tpu.adapt.trigger import RetrainTrigger, TriggerConfig
+
+        trigger = RetrainTrigger(
+            TriggerConfig(
+                min_sessions=K, window_s=1e9, cooldown_s=0.0,
+                recovery_patience=1,
+            )
+        )
+        cluster.observe_drift(trigger)
+        assert trigger.poll() is None
+        cluster.shutdown_workers()
+        cluster.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(priv, ignore_errors=True)
+
+
+def test_drift_report_codec_is_float64_exact():
+    from har_tpu.serve.net import wire
+
+    rng = np.random.default_rng(11)
+    mon = DriftMonitor(np.zeros(3), np.ones(3), halflife=20.0,
+                       patience=1)
+    for _ in range(3):
+        rep = mon.update(rng.normal(size=(50, 3)) + 9.0)
+    meta, payload = wire.encode_drift_reports(
+        [("s0", rep), ("s1", None)]
+    )
+    decoded = wire.decode_drift_reports(meta, payload)
+    assert len(decoded) == 1  # monitor-less session skipped
+    sid, back = decoded[0]
+    assert sid == "s0"
+    assert back.location_z.tobytes() == np.asarray(
+        rep.location_z, np.float64
+    ).tobytes()
+    assert back.scale_log_ratio.tobytes() == np.asarray(
+        rep.scale_log_ratio, np.float64
+    ).tobytes()
+    assert (back.drifting, back.n_samples, back.onset,
+            back.generation) == (
+        rep.drifting, rep.n_samples, rep.onset, rep.generation
+    )
+
+
+# --------------------------------- parked failover (agent down)
+
+
+def test_failover_parks_when_agent_down_and_resumes_on_restart(
+    tmp_path,
+):
+    """A dead worker whose host agent is ALSO down parks the failover
+    (survivors keep serving; PartitionUnavailable is not a failure)
+    and completes after ``register_agent`` points at a live one."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    _journaled_fleet(str(jdir))
+    srv = _AgentThread(str(host_root))
+    dead_client = ShipClient("127.0.0.1", 1)  # refused
+    root = str(tmp_path / "ctl")
+    os.makedirs(root)
+
+    class _DeadWorker:
+        worker_id = "w0"
+        journal_dir = str(jdir)
+
+        def kill(self):
+            pass
+
+    from har_tpu.serve.cluster.controller import PartitionUnavailable
+
+    # drive the seam directly: NetCluster._fetch_partition with a dead
+    # agent raises PartitionUnavailable; with a live one it stages a
+    # verified copy under <root>/_shipped/w0
+    cluster = NetCluster.__new__(NetCluster)
+    cluster.root = root
+    from har_tpu.serve.stats import FleetStats
+
+    cluster.net_stats = FleetStats()
+    cluster._agents = {"w0": dead_client}
+    cluster._ship_quarantine = {}
+    cluster._ship_chunk_bytes = 1024
+    cluster.ship_ms = 0.0
+    cluster.ship_transfers = []
+    cluster.chaos = None
+    try:
+        with pytest.raises(PartitionUnavailable):
+            cluster._fetch_partition(_DeadWorker())
+        cluster.register_agent("w0", srv.client())
+        dest = cluster._fetch_partition(_DeadWorker())
+        assert dest == os.path.join(root, "_shipped", "w0")
+        assert os.path.exists(os.path.join(dest, SHIP_DONE))
+        assert cluster.net_stats.shipped_bytes > 0
+        restored = FleetServer.restore(dest, MODEL)
+        assert restored.stats.accounting()["balanced"]
+    finally:
+        srv.close()
+
+
+def test_torn_ship_log_tail_truncated_on_resume(shipped_env, tmp_path):
+    """Double-fault safety: a crash mid-append leaves a torn record at
+    the END of ship.log — the resumed transfer must truncate it before
+    appending, because the log reader stops at the first torn record
+    and an interior tear would make every later record unreachable
+    (silently degrading the NEXT resume to a from-scratch re-pull)."""
+    client, _, jdir = shipped_env
+    dest = str(tmp_path / "w0")
+    with pytest.raises(ShipTorn):
+        fetch_journal(client, "w0", dest, chunk_bytes=768,
+                      faults=ShipFaults("torn", at=4))
+    log = os.path.join(dest, SHIP_LOG)
+    with open(log, "ab") as f:
+        f.write(b"\x40\x00\x00\x00garbage-torn-record")  # half a record
+    # a SECOND torn abort on the resumed transfer: its chunk records
+    # must land AFTER the truncated tear (reachable), not after it
+    with pytest.raises(ShipTorn):
+        fetch_journal(client, "w0", dest, chunk_bytes=768,
+                      faults=ShipFaults("torn", at=3))
+    prog = replay_ship_log(dest)
+    # progress from BOTH attempts is visible to the replay — appending
+    # past an un-truncated interior tear would have hidden attempt 2
+    assert sum(prog.offsets.values()) > 0
+    out = fetch_journal(client, "w0", dest, chunk_bytes=768)
+    assert out["resumes"] == 1
+    assert _dir_digest(dest) == _dir_digest(jdir)
+
+
+def test_torn_log_then_resume_counts_progress(shipped_env, tmp_path):
+    client, _, jdir = shipped_env
+    dest = str(tmp_path / "w0")
+    with pytest.raises(ShipTorn):
+        fetch_journal(client, "w0", dest, chunk_bytes=768,
+                      faults=ShipFaults("torn", at=4))
+    with open(os.path.join(dest, SHIP_LOG), "ab") as f:
+        f.write(b"\x40\x00\x00\x00torn-tail")
+    prog_before = replay_ship_log(dest)
+    carried = sum(prog_before.offsets.values())
+    assert carried > 0
+    out = fetch_journal(client, "w0", dest, chunk_bytes=768)
+    assert out["resumes"] == 1
+    total = sum(e["size"] for e in client.manifest("w0"))
+    assert out["bytes"] == total - carried  # durable progress honored
+    assert _dir_digest(dest) == _dir_digest(jdir)
+
+
+def test_corrupt_source_quarantines_not_crash_loops(tmp_path):
+    """A partition whose digests can NEVER verify must degrade that one
+    partition — PartitionUnavailable + a loud quarantine warning —
+    never crash the control plane's poll with a raw ShipError (which
+    would also crash every takeover forever); register_agent lifts the
+    quarantine without a retry storm in between."""
+    import warnings as _warnings
+
+    from har_tpu.serve.cluster.controller import PartitionUnavailable
+    from har_tpu.serve.stats import FleetStats
+
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    _journaled_fleet(str(jdir))
+    srv_raw = _lying_chunk_server(str(jdir), lambda m, p: (m, p))
+    real_manifest = srv_raw.handlers["ship_manifest"]
+
+    def bad_manifest(meta, payload):
+        rmeta, rpayload = real_manifest(meta, payload)
+        for entry in rmeta["files"]:
+            entry["sha256"] = "0" * 64
+        return rmeta, rpayload
+
+    srv_raw.handlers["ship_manifest"] = bad_manifest
+    srv = _LyingThread(srv_raw)
+    root = str(tmp_path / "ctl")
+    os.makedirs(root)
+
+    class _DeadWorker:
+        worker_id = "w0"
+        journal_dir = str(jdir)
+
+    cluster = NetCluster.__new__(NetCluster)
+    cluster.root = root
+    cluster.net_stats = FleetStats()
+    cluster._agents = {"w0": ShipClient(srv.srv.host, srv.srv.port)}
+    cluster._ship_quarantine = {}
+    cluster._ship_chunk_bytes = 1024
+    cluster.ship_ms = 0.0
+    cluster.ship_transfers = []
+    cluster.chaos = None
+    try:
+        with pytest.warns(RuntimeWarning, match="REFUSED"):
+            with pytest.raises(PartitionUnavailable):
+                cluster._fetch_partition(_DeadWorker())
+        assert "w0" in cluster._ship_quarantine
+        # parked, not retried: the next attempt refuses WITHOUT a ship
+        chunks_before = cluster.net_stats.ship_chunks
+        with pytest.raises(PartitionUnavailable, match="quarantined"):
+            cluster._fetch_partition(_DeadWorker())
+        assert cluster.net_stats.ship_chunks == chunks_before
+        # a fixed source (honest agent) registered lifts the quarantine
+        srv_raw.handlers["ship_manifest"] = real_manifest
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")  # no more refusal warnings
+            cluster.register_agent(
+                "w0", ShipClient(srv.srv.host, srv.srv.port)
+            )
+            dest = cluster._fetch_partition(_DeadWorker())
+        assert dest is not None
+        assert FleetServer.restore(dest, MODEL).stats.accounting()[
+            "balanced"
+        ]
+    finally:
+        for client in cluster._agents.values():
+            client.close()
+        srv.close()
+
+
+def test_fetch_queue_survives_a_mid_retry_crash(tmp_path):
+    """A crash while retrying the FIRST parked failover must not drop
+    the not-yet-retried rest of the fetch queue — only the in-flight
+    entry is at risk (the controller-crash model; takeover re-derives
+    it)."""
+    from har_tpu.serve.cluster.controller import FleetCluster
+
+    cluster = FleetCluster(MODEL, str(tmp_path / "c"), workers=1,
+                           window=100, hop=100)
+
+    class _Stub:
+        def __init__(self, wid):
+            self.worker_id = wid
+            self.journal_dir = str(tmp_path / wid)
+
+    a, b = _Stub("wA"), _Stub("wB")
+    cluster._fetch_queue = [("wA", a), ("wB", b)]
+
+    def boom(dead_wid, worker):
+        raise RuntimeError(f"mid-retry crash on {dead_wid}")
+
+    cluster._continue_failover = boom
+    with pytest.raises(RuntimeError, match="wA"):
+        cluster.poll(force=True)
+    # wB's parked failover survived the crash; wA is the in-flight loss
+    assert [wid for wid, _ in cluster._fetch_queue] == ["wB"]
+    cluster.close()
+
+
+def test_snapshot_rotation_failure_keeps_journal_usable(tmp_path):
+    """Fix-ordered rotation: when the NEW segment cannot open (full
+    disk at the worst instant), write_snapshot fails atomically — the
+    old snapshot + old segment + the live handle all stay intact, the
+    engine's containment absorbs the OSError, and later appends/
+    flushes/snapshots work; a crash in the window replays cleanly."""
+    import warnings as _warnings
+
+    server = FleetServer(
+        MODEL, window=100, hop=100, channels=3, smoothing="ema",
+        config=FleetConfig(max_sessions=2),
+        journal=FleetJournal(
+            str(tmp_path / "j"),
+            JournalConfig(flush_every=4, snapshot_every=0),
+        ),
+    )
+    rng = np.random.default_rng(5)
+    for i in range(2):
+        server.add_session(i)
+    for i in range(2):
+        server.push(i, rng.normal(size=(100, 3)).astype(np.float32))
+    server.poll(force=True)
+    j = server.journal
+    real_path = j._segment_path
+
+    def broken_path(k):
+        return os.path.join(str(tmp_path), "nope", f"wal.{k}.log")
+
+    j._segment_path = broken_path
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        server.write_snapshot()  # contained, not fatal
+    assert server.stats.journal_write_errors == 1
+    assert any("snapshot" in str(w.message) for w in caught)
+    # the journal is still fully usable: append + flush + a real
+    # snapshot once the "disk" recovers
+    server.push(0, rng.normal(size=(100, 3)).astype(np.float32))
+    server.poll(force=True)
+    j._segment_path = real_path
+    server.write_snapshot()
+    expected = server.stats.scored
+    server.journal.kill()
+    restored = FleetServer.restore(str(tmp_path / "j"), MODEL)
+    acct = restored.stats.accounting()
+    assert acct["balanced"] and acct["scored"] == expected
+
+
+# --------------------------------------------------- agent hygiene
+
+
+def test_agent_refuses_unsafe_paths(shipped_env):
+    client, _, _ = shipped_env
+    from har_tpu.serve.net.rpc import RpcRemoteError
+
+    for evil in ("../w0", "..", "./w0", "/etc", "a/b/c"):
+        with pytest.raises((ShipError, RpcRemoteError)):
+            client.manifest(evil)
+
+
+def test_agent_lists_and_marks_retired(shipped_env, tmp_path):
+    client, host_root, _ = shipped_env
+    assert client.list() == [{"name": "w0", "retired": False}]
+    assert client.retired("w0") is False
+    client.retire("w0", {"worker_id": "w0", "accounting": {}})
+    assert client.retired("w0") is True
+    with open(os.path.join(host_root, "w0", "retired.json")) as f:
+        assert json.load(f)["worker_id"] == "w0"
