@@ -1324,6 +1324,50 @@ def main() -> None:
         "journal_ship", 60, _journal_ship_lane
     )
 
+    # Wire-ingest lane (r20 tentpole, har_tpu.serve.net.gateway): the
+    # elastic diurnal swing driven through the ingest front door over
+    # real sockets — one batched push_many frame per delivery round,
+    # edge admission judged at the frame header, group-commit ``acks``
+    # journal records — against the SAME seeded trace run in-process.
+    # contract_ok pins the tentpole's whole claim per run: per-session
+    # event streams bit-identical at equal shed declarations, zero
+    # undeclared drops, conservation balanced.  The journal columns
+    # (coalesced vs reconstructed per-record bytes per window) are
+    # deterministic per trace; windows/s and event p99 are wall time,
+    # sockets vs in-process.
+    def _wire_ingest_lane():
+        from har_tpu.serve.net.smoke import wire_ingest_benchmark
+
+        # the coalesce ratio improves with retire batch size: 64 is the
+        # smallest point where the ≤0.5 acceptance holds with margin,
+        # so even the smoke draw's single point is judged against it
+        session_counts = [64] if smoke else [24, 96]
+        rows = wire_ingest_benchmark(
+            session_counts, n_runs=1 if smoke else lane_runs
+        )
+        return None, {
+            "model": "analytic_demo",
+            "transport": "tcp",
+            "n_runs": 1 if smoke else lane_runs,
+            "rows": rows,
+            "windows_per_sec_median": rows[-1]["windows_s_median"],
+            "inproc_windows_per_sec_median": rows[-1][
+                "inproc_windows_s_median"
+            ],
+            "event_p99_ms": rows[-1]["event_p99_ms"],
+            "ack_bytes_per_window": rows[-1]["ack_bytes_per_window"],
+            "per_record_bytes_per_window": rows[-1][
+                "per_record_bytes_per_window"
+            ],
+            "ack_coalesce_ratio": rows[-1]["ack_coalesce_ratio"],
+            "contract_ok": all(r["contract_ok"] for r in rows),
+            "chip_state_probe": chip_probe,
+        }
+
+    _, ingest_stats = deadline_lane(
+        "wire_ingest", 60, _wire_ingest_lane
+    )
+
     # Elastic-traffic lane (r14 tentpole, har_tpu.serve.traffic): the
     # same seeded 10x diurnal swing (overnight-cohort storm, slow
     # clients, mixed rates) served three ways — static floor batch,
@@ -1637,6 +1681,23 @@ def main() -> None:
             "baseline_failover_ms_median"
         ),
         "journal_ship_contract_ok": ship_stats.get("contract_ok"),
+        # ingest front door (har_tpu.serve.net.gateway): the batched-
+        # frame socket path's throughput and event p99 read against the
+        # in-process run of the same trace, plus the group-commit ack
+        # journal's bytes/window against the reconstructed per-record
+        # layout (the coalescing claim as a measured ratio, ≤ 0.5 by
+        # the gate's acceptance)
+        "wire_ingest_windows_per_sec_median": ingest_stats.get(
+            "windows_per_sec_median"
+        ),
+        "wire_ingest_event_p99_ms": ingest_stats.get("event_p99_ms"),
+        "wire_ingest_ack_bytes_per_window": ingest_stats.get(
+            "ack_bytes_per_window"
+        ),
+        "wire_ingest_ack_coalesce_ratio": ingest_stats.get(
+            "ack_coalesce_ratio"
+        ),
+        "wire_ingest_contract_ok": ingest_stats.get("contract_ok"),
         # elastic traffic (har_tpu.serve.traffic): the autoscaled run's
         # numbers across the 10x swing, and whether it beat the best
         # static configuration on p99 or shed rate at equal windows/s
@@ -1737,6 +1798,7 @@ def main() -> None:
         "cluster_failover": cluster_stats,
         "wire_failover": wire_stats,
         "journal_ship": ship_stats,
+        "wire_ingest": ingest_stats,
         "elastic_traffic": elastic_stats,
         "host_plane_scaling": host_plane_stats,
     }

@@ -153,6 +153,9 @@ class JournalExhaustivenessRule(Rule):
         declared_node = None
         default_at: set[str] = set()
         matrix_points: set[str] = set()
+        retired: set[str] = set()
+        retired_node = None
+        recover_ctx = None
 
         for ctx in ctxs:
             base = ctx.rel.rsplit("/", 1)[-1]
@@ -166,6 +169,16 @@ class JournalExhaustivenessRule(Rule):
             if base in ("recover.py", "ship.py"):
                 for t, node in _replay_handlers(ctx):
                     handled.setdefault(t, (ctx, node))
+            if base == "recover.py":
+                # record types whose writer was superseded (per-event
+                # `ack` → group-committed `acks`) but whose journals
+                # are still in the field: the handler stays forever,
+                # declared — and the declaration is itself pinned both
+                # ways below
+                recover_ctx = ctx
+                retired, retired_node = _string_tuple(
+                    ctx.tree, "RETIRED_RECORD_TYPES"
+                )
             if base == "chaos.py":
                 chaos_ctx = ctx
                 kp, kp_node = _string_tuple(ctx.tree, "KILL_POINTS")
@@ -215,7 +228,7 @@ class JournalExhaustivenessRule(Rule):
                         "same-version exhaustiveness is this check)",
                     )
                 )
-            for t in sorted(set(handled) - set(written)):
+            for t in sorted(set(handled) - set(written) - retired):
                 ctx, node = handled[t]
                 findings.append(
                     ctx.finding(
@@ -224,7 +237,36 @@ class JournalExhaustivenessRule(Rule):
                         f"replay handler for record type {t!r} matches "
                         "no journaled write in the fleet stack — dead "
                         "recovery code, or the writer was removed "
-                        "without its handler",
+                        "without its handler (a deliberately kept "
+                        "back-compat handler belongs in "
+                        "RETIRED_RECORD_TYPES)",
+                    )
+                )
+            # the retirement declaration is pinned both ways: a type
+            # with a live writer must not hide behind it, and a retired
+            # type that loses its handler breaks every journal still in
+            # the field
+            for t in sorted(retired & set(written)):
+                ctx, node = written[t]
+                findings.append(
+                    ctx.finding(
+                        self.rule_id,
+                        node,
+                        f"record type {t!r} is declared retired in "
+                        "serve/recover.py but is still written here — "
+                        "a stale retirement hides a real bijection "
+                        "break; drop it from RETIRED_RECORD_TYPES",
+                    )
+                )
+            for t in sorted(retired - set(handled)):
+                findings.append(
+                    recover_ctx.finding(
+                        self.rule_id,
+                        retired_node or recover_ctx.tree,
+                        f"retired record type {t!r} has no replay "
+                        "handler — old journals carrying it would "
+                        "silently lose acked state on restore; retired "
+                        "types keep their handlers forever",
                     )
                 )
 

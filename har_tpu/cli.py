@@ -477,6 +477,19 @@ def _parser() -> argparse.ArgumentParser:
     )
 
     # same stub pattern as serve-worker: the real parser lives in
+    # har_tpu.serve.net.gateway (main() forwards before this parser runs)
+    sub.add_parser(
+        "serve-gateway",
+        add_help=False,
+        help="the fleet's ingest front door "
+             "(har_tpu.serve.net.gateway): one process clients speak "
+             "the wire protocol to — batched push frames, header-only "
+             "edge admission/shedding, multiplexed onto running "
+             "`har serve-worker` processes; `har serve-gateway --help` "
+             "for flags",
+    )
+
+    # same stub pattern as serve-worker: the real parser lives in
     # har_tpu.serve.net.ship (main() forwards before this parser runs)
     sub.add_parser(
         "serve-agent",
@@ -527,6 +540,12 @@ def main(argv=None) -> int:
         from har_tpu.serve.net.worker import main as _worker_main
 
         return _worker_main(argv[1:])
+    if argv[:1] == ["serve-gateway"]:
+        # forwarding contract as above: the gateway fronts workers —
+        # it parses its own flags and starts without the CLI surface
+        from har_tpu.serve.net.gateway import main as _gateway_main
+
+        return _gateway_main(argv[1:])
     if argv[:1] == ["serve-agent"]:
         # same forwarding contract as serve-worker: the ship agent is
         # a byte server — it must start without the CLI (or a jax

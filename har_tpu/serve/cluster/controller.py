@@ -362,6 +362,38 @@ class FleetCluster:
         self._membership.note_ok(wid)
         return n
 
+    def push_many(self, session_ids, chunks) -> int:
+        """Route one delivery ROUND (``FleetServer.push_many``'s
+        signature — the load generators already speak it): pairs in
+        delivery order, grouped by owning worker so each worker sees
+        ONE batched ``push_many`` call (over the wire: one frame)
+        instead of one per session.  Per-worker delivery order is the
+        argument order, so windows enqueue exactly as the equivalent
+        per-session ``push`` sequence would.  Fails fast like ``push``:
+        an unreachable worker raises after earlier groups have landed —
+        the transport re-delivers the failed partition from
+        ``watermark(sid)`` once failover lands."""
+        by_worker: dict = {}
+        for sid, samples in zip(session_ids, chunks):
+            wid = self.worker_of(sid)
+            group = by_worker.setdefault(wid, ([], []))
+            group[0].append(sid)
+            group[1].append(samples)
+        total = 0
+        for wid, (ids, payloads) in by_worker.items():
+            worker = self._workers.get(wid)
+            if worker is None:
+                raise WorkerUnavailable(
+                    f"worker {wid!r} is failing over"
+                )
+            try:
+                total += worker.push_many(ids, payloads)
+            except WorkerUnavailable as exc:
+                self._note_worker_failure(wid, exc)
+                raise
+            self._membership.note_ok(wid)
+        return total
+
     def poll(self, *, force: bool = False) -> list:
         """Poll every responsive worker (the poll doubles as its
         heartbeat), run the failure detector, fail over any declared

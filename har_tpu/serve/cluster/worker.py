@@ -51,6 +51,15 @@ class ClusterWorker:
         self._guard()
         return self.server.push(session_id, samples)
 
+    def push_many(self, session_ids, chunks) -> int:
+        """Batched multi-session delivery in delivery order —
+        semantically a sequence of ``push`` calls
+        (``FleetServer.push_many``'s contract), one call instead of N.
+        Over the wire this is what collapses a round's N push RPCs
+        into one frame."""
+        self._guard()
+        return self.server.push_many(session_ids, chunks)
+
     def poll(self, *, force: bool = False) -> list:
         self._guard()
         return self.server.poll(force=force)

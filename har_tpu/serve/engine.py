@@ -52,6 +52,7 @@ import dataclasses
 import gc
 import time
 import warnings
+import zlib
 from collections import deque
 from typing import Callable, Hashable, Sequence
 
@@ -2566,35 +2567,50 @@ class FleetServer:
                     release(pi)
                 else:
                     self._unlink_scored(sess, pi)
-            # the scored-event ack: carries the probabilities so replay
-            # re-steps the smoother to the exact pre-crash state
-            # without re-scoring (and `shed` so a frozen smoother stays
-            # frozen); durable at the end-of-poll flush, BEFORE the
-            # consumer can observe the event.  (Journal presence checked
-            # HERE like push's record: the dict + tobytes copy are
-            # per-EVENT allocations a journal-less fleet must not pay.)
-            if journal_live:
-                try:
-                    self._journal.append(
-                        {
-                            "t": "ack",
-                            "sid": sess.sid,
-                            "ti": t_idx_col[j],
-                            "ver": ticket.version,
-                            "shed": shed,
-                        },
-                        np.asarray(probs[i], np.float64).tobytes(),
-                    )
-                except OSError as exc:
-                    # contained like the push append: the ack stays
-                    # buffered; the end-of-poll flush (or a later one)
-                    # lands it, and the degradation is declared
-                    self._note_journal_error("ack append", exc)
             fe = new(FleetEvent)
             fe.__dict__.update(
                 session_id=sess.sid, event=ev, degraded=shed
             )
             emit(fe)
+        # the scored-event acks, group-committed: ONE batched journal
+        # record per retire instead of m per-event records — session
+        # ids in the meta, the raw probability rows (float64,
+        # pre-smoothing, so replay re-steps each smoother itself)
+        # packed back-to-back in the payload.  The per-entry t_indices
+        # are NOT stored: replay re-derives each one from the pending
+        # queue the push records rebuilt (the session's oldest live
+        # window), and "tic" — one crc32 over the int64 column — is
+        # the divergence guard, 4 bytes per record instead of 8 per
+        # entry.  One meta dict, one CRC frame, one buffered write;
+        # entry order is the emit-loop order above, so replay consumes
+        # them through the same per-event _consume_ack sequence
+        # bit-identically.  The flush/fsync cadence is untouched: acks
+        # are durable at the end-of-poll flush BEFORE the consumer can
+        # observe the events, so the ack boundary and the conservation
+        # law hold verbatim.
+        if journal_live and m:
+            try:
+                self._journal.append(
+                    {
+                        "t": "acks",
+                        "n": m,
+                        "sids": [by_slot[s].sid for s in slot_col],
+                        "ver": ticket.version,
+                        "shed": shed,
+                        "tic": zlib.crc32(
+                            np.asarray(t_idx_col, np.int64).tobytes()
+                        )
+                        & 0xFFFFFFFF,
+                    },
+                    np.ascontiguousarray(
+                        probs[pos_col], np.float64
+                    ).tobytes(),
+                )
+            except OSError as exc:
+                # contained like the push append: the record stays
+                # buffered; the end-of-poll flush (or a later one)
+                # lands it, and the degradation is declared
+                self._note_journal_error("ack append", exc)
         self.stats.smooth.record((self._clock() - t_smooth0) * 1e3)
         if self._dispatch_tap is not None:
             # mirrored sample for shadow evaluation — after the events

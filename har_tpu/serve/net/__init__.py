@@ -25,6 +25,15 @@ This package puts a real wire behind that seam:
                 registered, failover is SHARED-NOTHING: the dead
                 worker's journal ships over the wire into a private
                 staging dir and is digest-verified before restore)
+  ingest.py     edge admission for the front door: the shed ladder that
+                judges a batched push frame from its HEADER (session
+                count, byte length, staleness watermark) before any
+                payload decode or allocation
+  gateway.py    ``har serve-gateway`` — the fleet's ingest front door:
+                clients speak the wire protocol to ONE gateway process
+                which multiplexes batched push frames (one per delivery
+                round) onto the workers, shedding at the edge with
+                declared receipts
   ship.py       the journal-shipping RPC (``har serve-agent``): one
                 agent per worker host streams journal dirs as chunked,
                 manifest-digested, resumable transfers — the failover
@@ -50,6 +59,12 @@ from har_tpu.serve.net.controller import (
     launch_workers,
 )
 from har_tpu.serve.net.election import ControllerReplica, LeaderLease
+from har_tpu.serve.net.gateway import (
+    GatewayClient,
+    IngestGateway,
+    launch_gateway,
+)
+from har_tpu.serve.net.ingest import EdgeAdmission, IngestConfig
 from har_tpu.serve.net.ship import (
     ShipAgent,
     ShipClient,
@@ -80,8 +95,12 @@ from har_tpu.serve.net.wire import (
 __all__ = [
     "AgentHandle",
     "ControllerReplica",
+    "EdgeAdmission",
     "FrameBuffer",
     "FrameError",
+    "GatewayClient",
+    "IngestConfig",
+    "IngestGateway",
     "LeaderLease",
     "LinkFaults",
     "MAX_FRAME_BYTES",
@@ -103,6 +122,7 @@ __all__ = [
     "encode_export",
     "fetch_journal",
     "launch_agents",
+    "launch_gateway",
     "launch_workers",
     "wire_failover_smoke",
 ]

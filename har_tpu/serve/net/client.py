@@ -131,6 +131,18 @@ class NetWorker:
         resp, _ = self._call("push", meta, payload)
         return int(resp["r"])
 
+    def push_many(self, session_ids, chunks) -> int:
+        """One batched push frame for a whole delivery round
+        (``FleetServer.push_many``'s signature) — the pairs ride the
+        chunk-batch codec in delivery order, one RPC instead of one
+        per session.  The per-session ``push`` above stays
+        (single-session compat, test-pinned equivalent)."""
+        meta, payload = wire.encode_chunk_batch(
+            zip(session_ids, chunks)
+        )
+        resp, _ = self._call("push_many", meta, payload)
+        return int(resp["r"])
+
     def poll(self, *, force: bool = False) -> list:
         resp, payload = self._call("poll", {"force": bool(force)})
         return wire.decode_events(resp, payload)

@@ -1,0 +1,483 @@
+"""``har serve-gateway`` — the fleet's wire-rate ingest front door.
+
+Clients do not talk to workers.  They talk to ONE gateway process
+speaking the same journal-frame wire protocol the workers do, and the
+gateway multiplexes them onto the fleet:
+
+  - a client buffers its per-session ``push`` calls and ships each
+    delivery round as ONE batched push frame (``wire.encode_chunk_batch``
+    — one frame carrying every session's chunk for the round, in
+    delivery order), collapsing a round's N push RPCs into one;
+
+  - admission control and the shed ladder run AT THE EDGE, before the
+    frame's payload is even assembled: the RpcServer's admission hook
+    judges each push frame from its header alone (session count,
+    declared byte length, staleness watermark — ``ingest.EdgeAdmission``)
+    and a refused frame is answered ``{"shed": reason}`` without a
+    payload decode, a numpy array, or a worker RPC.  Refusals are
+    DECLARED — the client counts them against its own cursors, so the
+    conservation law extends to the edge: every sample a client sends
+    is refused-with-a-receipt or lands in fleet accounting;
+
+  - admitted frames decode to zero-copy views over the received
+    payload (``wire.decode_chunk_batch``) and route through
+    ``FleetCluster.push_many`` — grouped per owning worker, one batched
+    RPC per worker, landing in each engine's reserved ``StagingArena``
+    slots in delivery order.
+
+The gateway is a FRONT DOOR, not a second control plane: it owns no
+placement, no membership, no journal.  Failover, leases and the ledger
+stay in the NetCluster it fronts; the gateway's only state is the
+admission ladder's backlog estimate, resynced from fleet accounting.
+
+Engine-free at import: the heavy imports (engine, cluster controller)
+happen inside ``main``/handlers, so the admission path stays cheap to
+import and the module is testable without a jax backend behind it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from har_tpu.serve.net import wire
+from har_tpu.serve.net.ingest import EdgeAdmission, IngestConfig
+from har_tpu.serve.net.rpc import RpcClient, RpcServer
+
+
+class IngestGateway:
+    """One RpcServer fronting a cluster (in-process ``FleetCluster`` or
+    a ``NetCluster`` of worker processes — the gateway is transport-
+    blind, same seam as the controller itself).
+
+    The admission hook only judges ``push_many`` frames; the control
+    surface (add_session, poll, accounting, ...) is never shed — a
+    client that cannot deliver data can still drain events and settle.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        *,
+        config: IngestConfig | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.cluster = cluster
+        self.admission = EdgeAdmission(config)
+        self.rounds = 0
+        self._shutdown = False
+        self.rpc = RpcServer(
+            self._handlers(),
+            host=host,
+            port=port,
+            admission=self._admit,
+        )
+
+    # ------------------------------------------------------- admission
+
+    def _admit(self, meta: dict, payload_len: int) -> str | None:
+        # only data-plane push frames face the ladder: shedding a poll
+        # would wedge the very drain that lowers the backlog
+        if meta.get("m") != "push_many":
+            return None
+        return self.admission.admit(meta, payload_len)
+
+    # ------------------------------------------------------- handlers
+
+    def _handlers(self) -> dict:
+        cluster = self.cluster
+        adm = self.admission
+
+        def ok(meta=None, payload=b""):
+            return dict(meta or {}), payload
+
+        def heartbeat(meta, payload):
+            return ok()
+
+        def geometry(meta, payload):
+            # the one datum a front-door client needs to chunk its
+            # stream: the fleet's hop (frames are sliced client-side)
+            return ok({"hop": int(cluster.hop)})
+
+        def add_session(meta, payload):
+            from har_tpu.serve.journal import monitor_from_state
+
+            cluster.add_session(
+                meta["sid"],
+                monitor=monitor_from_state(meta.get("mon")),
+            )
+            return ok()
+
+        def push_many(meta, payload):
+            # the admission hook already said yes (header-only); the
+            # decode below yields zero-copy views over the payload and
+            # the cluster routes them per owning worker in delivery
+            # order
+            items = wire.decode_chunk_batch(meta, payload)
+            n = cluster.push_many(
+                [sid for sid, _ in items], [c for _, c in items]
+            )
+            adm.note_enqueued(n)
+            self.rounds += 1
+            return ok({"r": int(n)})
+
+        def poll(meta, payload):
+            events = cluster.poll(force=bool(meta.get("force")))
+            adm.note_retired(len(events))
+            return wire.encode_events(events)
+
+        def disconnect(meta, payload):
+            events = cluster.disconnect_sessions(meta["sids"])
+            adm.note_retired(len(events))
+            return wire.encode_events(events)
+
+        def flush(meta, payload):
+            events = cluster.flush()
+            adm.note_retired(len(events))
+            return wire.encode_events(events)
+
+        def watermark(meta, payload):
+            return ok({"r": int(cluster.watermark(meta["sid"]))})
+
+        def accounting(meta, payload):
+            acct = cluster.accounting()
+            # engine-side declared sheds retire windows the gateway
+            # never sees come back as events — pin the ladder's backlog
+            # estimate to the fleet's true pending count
+            adm.resync_backlog(acct.get("pending", 0))
+            return ok({"r": acct})
+
+        def gateway_stats(meta, payload):
+            return ok({"r": {**adm.snapshot(), "rounds": self.rounds}})
+
+        def shutdown(meta, payload):
+            self._shutdown = True
+            return ok()
+
+        return {
+            "heartbeat": heartbeat,
+            "geometry": geometry,
+            "add_session": add_session,
+            "push_many": push_many,
+            "poll": poll,
+            "disconnect": disconnect,
+            "flush": flush,
+            "watermark": watermark,
+            "accounting": accounting,
+            "gateway_stats": gateway_stats,
+            "shutdown": shutdown,
+        }
+
+    # ----------------------------------------------------------- loop
+
+    def serve_forever(self, *, max_idle_s: float = 0.0) -> int:
+        try:
+            while not self._shutdown:
+                self.rpc.step(0.05)
+                if (
+                    max_idle_s
+                    and time.monotonic() - self.rpc.last_activity
+                    > max_idle_s
+                ):
+                    return 2  # orphaned: the client side went away
+            return 0
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        # the cluster (and its worker processes) belong to whoever
+        # built them; the gateway only closes its own listener
+        self.rpc.close()
+
+
+class GatewayClient:
+    """The front-door client — ``drive_trace``-compatible, so every
+    traffic harness that drives an engine or a cluster in-process
+    drives the gateway over real sockets unchanged.
+
+    ``push`` BUFFERS (returns 0); the round's buffered chunks leave as
+    one batched push frame at the next ``poll``/``flush``/``disconnect``
+    — the same before-the-poll delivery point the in-process loop has,
+    so per-session arrival order (and therefore every scored event) is
+    bit-identical to an in-process run.  The frame's header carries the
+    client's sample watermark; a ``{"shed": reason}`` answer is counted
+    against the client's own cursors (``edge_sheds`` / ``shed_samples``
+    / ``shed_by_reason``) — the declared-refusal receipt the
+    conservation law at the edge is pinned on.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        deadline_s: float = 10.0,
+        retries: int = 2,
+    ):
+        self._client = RpcClient(
+            host, port, deadline_s=deadline_s, retries=retries
+        )
+        resp, _ = self._client.call("geometry")
+        self.hop = int(resp["hop"])
+        self._pending: list = []  # [(sid, float32 chunk)] this round
+        self._wm = 0  # samples pushed so far: the frame watermark
+        self.windows_enqueued = 0
+        self.frames_sent = 0
+        self.edge_sheds = 0
+        self.shed_sessions = 0
+        self.shed_samples = 0
+        self.shed_by_reason: dict[str, int] = {}
+
+    # -------------------------------------------------- the data plane
+
+    def add_session(self, session_id, *, monitor=None) -> None:
+        from har_tpu.serve.journal import monitor_state
+
+        self._client.call(
+            "add_session",
+            {"sid": session_id, "mon": monitor_state(monitor)},
+        )
+
+    def push(self, session_id, samples) -> int:
+        """Buffer one session's chunk for this round's batched frame.
+        Returns 0 — enqueue receipts arrive with the frame's response
+        (``windows_enqueued``); a drive-loop that sums push returns
+        reads the true count from gateway accounting instead."""
+        arr = np.ascontiguousarray(samples, np.float32)
+        self._pending.append((session_id, arr))
+        self._wm += int(arr.shape[0])
+        return 0
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        meta, payload = wire.encode_chunk_batch(batch)
+        meta["wm"] = self._wm
+        resp, _ = self._client.call("push_many", meta, payload)
+        self.frames_sent += 1
+        if "shed" in resp:
+            reason = resp["shed"]
+            self.edge_sheds += 1
+            self.shed_sessions += len(batch)
+            self.shed_samples += sum(
+                int(a.shape[0]) for _, a in batch
+            )
+            self.shed_by_reason[reason] = (
+                self.shed_by_reason.get(reason, 0) + 1
+            )
+        else:
+            self.windows_enqueued += int(resp["r"])
+
+    def poll(self, *, force: bool = False) -> list:
+        self._flush_pending()
+        resp, payload = self._client.call("poll", {"force": bool(force)})
+        return wire.decode_events(resp, payload)
+
+    def disconnect_sessions(self, session_ids) -> list:
+        self._flush_pending()
+        resp, payload = self._client.call(
+            "disconnect", {"sids": list(session_ids)}
+        )
+        return wire.decode_events(resp, payload)
+
+    def flush(self) -> list:
+        self._flush_pending()
+        resp, payload = self._client.call("flush")
+        return wire.decode_events(resp, payload)
+
+    def watermark(self, session_id) -> int:
+        resp, _ = self._client.call("watermark", {"sid": session_id})
+        return int(resp["r"])
+
+    # ----------------------------------------------------- observation
+
+    def accounting(self) -> dict:
+        resp, _ = self._client.call("accounting")
+        return resp["r"]
+
+    def gateway_stats(self) -> dict:
+        resp, _ = self._client.call("gateway_stats")
+        return resp["r"]
+
+    # ------------------------------------------------------- lifecycle
+
+    def shutdown(self) -> None:
+        try:
+            self._client.call("shutdown")
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        self._client.close()
+
+
+# --------------------------------------------------------- entrypoint
+
+
+def build_parser() -> argparse.ArgumentParser:
+    dflt = IngestConfig()
+    ap = argparse.ArgumentParser(
+        prog="har serve-gateway",
+        description=(
+            "the fleet's ingest front door (har_tpu.serve.net.gateway) "
+            "— one process speaking the journal-frame wire protocol to "
+            "clients, multiplexing batched push frames onto already-"
+            "running `har serve-worker` processes with header-only edge "
+            "admission; prints one JSON ready line {host, port, pid}"
+        ),
+    )
+    ap.add_argument("--root", required=True,
+                    help="cluster root directory (failover staging)")
+    ap.add_argument("--workers-json", required=True,
+                    help="JSON list of running workers: "
+                         '[{"id", "host", "port", "journal"}, ...]')
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral; the ready line reports it")
+    ap.add_argument("--model", default="demo")
+    ap.add_argument("--deadline-s", type=float, default=2.0,
+                    help="per-RPC deadline toward the workers")
+    ap.add_argument("--soft-backlog", type=int, default=dflt.soft_backlog)
+    ap.add_argument("--hard-backlog", type=int, default=dflt.hard_backlog)
+    ap.add_argument("--max-frame-sessions", type=int,
+                    default=dflt.max_frame_sessions)
+    ap.add_argument("--max-frame-bytes", type=int,
+                    default=dflt.max_frame_bytes)
+    ap.add_argument("--max-watermark-lag", type=int,
+                    default=dflt.max_watermark_lag)
+    ap.add_argument("--max-idle-s", type=float, default=120.0,
+                    help="exit when no RPC arrives for this long "
+                         "(orphan protection); 0 disables")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from har_tpu.serve.net.client import NetWorker
+    from har_tpu.serve.net.controller import NetCluster
+    from har_tpu.serve.net.worker import model_pool
+
+    models = model_pool(args.model)
+    net_workers = [
+        NetWorker(
+            spec["id"],
+            spec["host"],
+            int(spec["port"]),
+            spec["journal"],
+            deadline_s=args.deadline_s,
+        )
+        for spec in json.loads(args.workers_json)
+    ]
+    # the fleet's geometry is the workers' geometry — ask one instead
+    # of trusting a default: the client slices its stream by the hop
+    # the gateway advertises, and a mismatch would silently starve (or
+    # flood) every window assembler behind the front door
+    geo = net_workers[0].geometry()
+    cluster = NetCluster(
+        models["A"],
+        args.root,
+        window=int(geo["window"]),
+        hop=int(geo["hop"]),
+        channels=int(geo["channels"]),
+        smoothing=geo["smoothing"],
+        loader=lambda ver: models.get(ver, models["A"]),
+        _workers=net_workers,
+    )
+    gw = IngestGateway(
+        cluster,
+        config=IngestConfig(
+            soft_backlog=args.soft_backlog,
+            hard_backlog=args.hard_backlog,
+            max_frame_sessions=args.max_frame_sessions,
+            max_frame_bytes=args.max_frame_bytes,
+            max_watermark_lag=args.max_watermark_lag,
+        ),
+        host=args.host,
+        port=args.port,
+    )
+    print(
+        json.dumps(
+            {"host": gw.rpc.host, "port": gw.rpc.port, "pid": os.getpid()}
+        ),
+        flush=True,
+    )
+    try:
+        return gw.serve_forever(max_idle_s=args.max_idle_s)
+    finally:
+        for w in net_workers:
+            w.close()
+
+
+def launch_gateway(
+    root: str,
+    workers,
+    *,
+    model: str = "demo",
+    host: str = "127.0.0.1",
+    deadline_s: float = 2.0,
+    config: IngestConfig | None = None,
+    max_idle_s: float = 120.0,
+    ready_timeout_s: float = 30.0,
+):
+    """Spawn one ``har serve-gateway`` subprocess fronting already-
+    running workers (``NetWorker`` proxies from ``launch_workers``) and
+    return ``(proc, host, port)`` once its ready line lands.  Stderr is
+    captured to ``<root>/gateway.stderr.log`` for post-mortems."""
+    from har_tpu.serve.net.controller import _read_ready_line
+
+    cfg = config or IngestConfig()
+    specs = [
+        {
+            "id": w.worker_id,
+            "host": w.host,
+            "port": w.port,
+            "journal": w.journal_dir,
+        }
+        for w in workers
+    ]
+    os.makedirs(root, exist_ok=True)
+    cmd = [
+        sys.executable, "-m", "har_tpu.serve.net.gateway",
+        "--root", root,
+        "--workers-json", json.dumps(specs),
+        "--host", host,
+        "--model", model,
+        "--deadline-s", str(deadline_s),
+        "--soft-backlog", str(cfg.soft_backlog),
+        "--hard-backlog", str(cfg.hard_backlog),
+        "--max-frame-sessions", str(cfg.max_frame_sessions),
+        "--max-frame-bytes", str(cfg.max_frame_bytes),
+        "--max-watermark-lag", str(cfg.max_watermark_lag),
+        "--max-idle-s", str(max_idle_s),
+    ]
+    err = open(os.path.join(root, "gateway.stderr.log"), "wb")
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=err, text=True
+        )
+    finally:
+        err.close()
+    try:
+        ready = _read_ready_line(
+            proc, "gateway", root, ready_timeout_s,
+            log_name="gateway.stderr.log",
+        )
+    except BaseException:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        raise
+    return proc, ready["host"], ready["port"]
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entrypoint
+    sys.exit(main(sys.argv[1:]))

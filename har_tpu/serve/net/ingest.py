@@ -1,0 +1,162 @@
+"""Edge admission for the ingest gateway — shed from the header.
+
+The gateway sits between untrusted client connections and the fleet's
+workers.  Its admission control runs BEFORE a frame's payload is
+assembled or decoded (``FrameBuffer.peek_header`` /
+``FrameBuffer.skip_frame``): the batched push frame's header already
+carries everything a shed decision needs —
+
+  - ``s``     the session count in the frame (chunk-batch codec);
+  - the declared payload byte length (the frame's own length field);
+  - ``wm``    the client's sample watermark — how far its stream has
+              advanced; a frame whose watermark lags the newest one
+              seen on the connection is STALE traffic (a catch-up
+              replay of data whose scoring window has passed).
+
+A refused frame costs the edge exactly one header parse: no payload
+bytes object, no numpy array, no arena reservation, no worker RPC.
+The refusal is DECLARED — the client gets a ``{"shed": reason}``
+response addressed to its request id and keeps its delivery cursors,
+so every sample it sent is either refused-with-a-receipt at the edge
+or lands in the fleet's window accounting.  Zero undeclared drops is
+the test-pinned contract.
+
+The shed LADDER mirrors the engine's own (pressure escalates, recovery
+de-escalates), driven by the gateway's outstanding-window backlog:
+
+  level 0  (backlog < soft_backlog)   admit everything within the
+           static bounds (frame sessions / bytes / max staleness);
+  level 1  (backlog >= soft_backlog)  additionally refuse ANY frame
+           whose watermark lags the connection's newest — under
+           pressure, stale catch-up traffic is the first to go;
+  level 2  (backlog >= hard_backlog)  refuse every push frame until
+           the backlog drains — the queue, not the allocator, is the
+           thing being protected.
+
+Engine-free by design: this module imports nothing from the serving
+engine, so the gateway's admission path stays importable (and
+testable) without a jax backend behind it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Edge-admission bounds.  Defaults are sized for the loopback
+    smoke fleets; a production gateway tunes them to its workers'
+    ``max_queue_windows``."""
+
+    # backlog ladder thresholds, in outstanding (enqueued-but-not-yet-
+    # returned) windows across the fleet the gateway fronts
+    soft_backlog: int = 4096
+    hard_backlog: int = 16384
+    # static per-frame bounds, enforceable at any ladder level
+    max_frame_sessions: int = 4096
+    # a SOFT byte ceiling below wire.MAX_FRAME_BYTES: past the wire
+    # ceiling the connection dies (protocol violation); past this one
+    # the frame is shed with a receipt (a well-formed but oversized
+    # burst)
+    max_frame_bytes: int = 8 << 20
+    # how many samples a frame's watermark may lag the connection's
+    # newest before it is stale (level 0; level 1 tightens this to 0)
+    max_watermark_lag: int = 4096
+
+
+class EdgeAdmission:
+    """The gateway's shed ladder + its accounting.
+
+    ``admit(meta, payload_len)`` returns ``None`` to admit or a shed
+    reason string; it reads ONLY the frame header.  The backlog the
+    ladder rides is the gateway's own estimate — ``note_enqueued`` on
+    every admitted round's enqueued windows, ``note_retired`` on every
+    event returned — resynced to the fleet's true pending count
+    whenever the gateway reads ``accounting()`` (engine-side declared
+    sheds shrink the real backlog without passing through the gateway).
+    """
+
+    def __init__(self, config: IngestConfig | None = None):
+        self.config = config or IngestConfig()
+        self.backlog = 0
+        self.latest_wm = 0
+        self.admitted_frames = 0
+        self.admitted_sessions = 0
+        self.admitted_bytes = 0
+        self.shed_frames = 0
+        self.shed_sessions = 0
+        self.shed_bytes = 0
+        self.shed_by_reason: dict[str, int] = {}
+
+    # ------------------------------------------------------- pressure
+
+    @property
+    def level(self) -> int:
+        if self.backlog >= self.config.hard_backlog:
+            return 2
+        if self.backlog >= self.config.soft_backlog:
+            return 1
+        return 0
+
+    def note_enqueued(self, n_windows: int) -> None:
+        self.backlog += int(n_windows)
+
+    def note_retired(self, n_events: int) -> None:
+        self.backlog = max(0, self.backlog - int(n_events))
+
+    def resync_backlog(self, pending: int) -> None:
+        """Pin the estimate to the fleet's true pending count (from
+        ``accounting()``): engine-side declared sheds retire windows
+        the gateway never sees come back as events."""
+        self.backlog = max(0, int(pending))
+
+    # ------------------------------------------------------ admission
+
+    def admit(self, meta: dict, payload_len: int) -> str | None:
+        """Header-only admission for one batched push frame.  The
+        ladder's checks run cheapest-first; the FIRST breached bound
+        names the shed (one declared reason per refused frame)."""
+        cfg = self.config
+        sessions = int(meta.get("s", 0))
+        wm = int(meta.get("wm", self.latest_wm))
+        reason = None
+        if sessions > cfg.max_frame_sessions:
+            reason = "frame_sessions"
+        elif payload_len > cfg.max_frame_bytes:
+            reason = "frame_bytes"
+        elif self.level >= 2:
+            reason = "hard_backlog"
+        else:
+            lag = self.latest_wm - wm
+            allowed = 0 if self.level >= 1 else cfg.max_watermark_lag
+            if lag > allowed:
+                reason = "stale" if self.level == 0 else "soft_backlog"
+        if reason is not None:
+            self.shed_frames += 1
+            self.shed_sessions += sessions
+            self.shed_bytes += int(payload_len)
+            self.shed_by_reason[reason] = (
+                self.shed_by_reason.get(reason, 0) + 1
+            )
+            return reason
+        self.latest_wm = max(self.latest_wm, wm)
+        self.admitted_frames += 1
+        self.admitted_sessions += sessions
+        self.admitted_bytes += int(payload_len)
+        return None
+
+    # ------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "backlog": self.backlog,
+            "admitted_frames": self.admitted_frames,
+            "admitted_sessions": self.admitted_sessions,
+            "admitted_bytes": self.admitted_bytes,
+            "shed_frames": self.shed_frames,
+            "shed_sessions": self.shed_sessions,
+            "shed_bytes": self.shed_bytes,
+            "shed_by_reason": dict(self.shed_by_reason),
+        }

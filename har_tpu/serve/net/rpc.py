@@ -317,6 +317,17 @@ class RpcServer:
     + message), never a dead server.  Responses are cached per
     ``(cid, id)`` in a bounded table so duplicated frames (link retry,
     fault injection) are answered without re-executing the handler.
+
+    ``admission`` is the gateway's edge-shed hook: called with
+    ``(meta, payload_len)`` from the frame HEADER as soon as it has
+    arrived — before the payload is assembled, CRC-checked or decoded.
+    Returning a reason string refuses the frame: its payload bytes are
+    discarded as they arrive (``FrameBuffer.skip_frame``) and the
+    client is answered ``{"shed": reason}`` addressed to its request
+    id.  Frames whose ``(cid, id)`` already sits in the dedup cache
+    bypass admission and are re-answered from the cache — a RETRY of
+    an executed request must never be re-judged into a shed (the
+    client would re-deliver what the fleet already holds).
     """
 
     DEDUP_CAP = 512
@@ -327,10 +338,12 @@ class RpcServer:
         host: str = "127.0.0.1",
         port: int = 0,
         stats=None,
+        admission=None,
     ):
         import selectors
 
         self.handlers = dict(handlers)
+        self.admission = admission
         # worker-side transport counters (FleetStats): requests are
         # bytes_rx, responses are sent/bytes_tx — the mirror of the
         # controller-side client's view
@@ -346,6 +359,10 @@ class RpcServer:
         self._sel.register(self._listener, selectors.EVENT_READ, None)
         self.host, self.port = self._listener.getsockname()
         self._bufs: dict = {}
+        # connections whose HEAD frame already passed admission but is
+        # still assembling its payload (torn across recvs) — judged
+        # once, not once per recv
+        self._admitted: dict = {}
         # (cid, rid) -> encoded response frame, insertion-ordered so
         # eviction drops the oldest (dict preserves insertion order)
         self._dedup: dict = {}
@@ -399,9 +416,46 @@ class RpcServer:
         handled = 0
         try:
             while True:
+                if self.admission is not None and not self._admitted.get(
+                    sock
+                ):
+                    # the edge: judge the frame from its HEADER, before
+                    # the payload exists as anything but socket bytes
+                    head = buf.peek_header()
+                    if head is None:
+                        break
+                    hmeta, plen = head
+                    key = (hmeta.get("cid"), hmeta.get("id"))
+                    cached = self._dedup.get(key)
+                    if cached is not None and key[0] is not None:
+                        # a retried frame the fleet already executed:
+                        # answered from the cache, payload discarded —
+                        # never re-judged into a shed
+                        buf.skip_frame()
+                        self._send(sock, cached)
+                        handled += 1
+                        continue
+                    reason = self.admission(hmeta, plen)
+                    if reason is not None:
+                        buf.skip_frame()
+                        frame = encode_frame(
+                            {"id": hmeta.get("id"), "shed": reason}
+                        )
+                        self.requests_served += 1
+                        if key[0] is not None and key[1] is not None:
+                            self._dedup[key] = frame
+                            while len(self._dedup) > self.DEDUP_CAP:
+                                self._dedup.pop(next(iter(self._dedup)))
+                        self._send(sock, frame)
+                        handled += 1
+                        continue
+                    # admitted: remember it so a torn payload arriving
+                    # over several recvs is never judged twice
+                    self._admitted[sock] = True
                 got = buf.next_frame()
                 if got is None:
                     break
+                self._admitted.pop(sock, None)
                 self._dispatch(sock, *got)
                 handled += 1
         except FrameError:
@@ -416,6 +470,7 @@ class RpcServer:
         except (KeyError, ValueError):
             pass
         self._bufs.pop(sock, None)
+        self._admitted.pop(sock, None)
         try:
             sock.close()
         except OSError:

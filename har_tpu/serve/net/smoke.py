@@ -1,4 +1,4 @@
-"""The release gate's wire-failover check + the bench lane measurement.
+"""The release gate's wire checks + the bench lane measurements.
 
 ``wire_failover_smoke``: three REAL subprocess workers on loopback
 TCP, one SIGKILLed mid-dispatch (an actual ``Process.kill`` — not a
@@ -17,10 +17,22 @@ the controller-side ``rpc_rtt`` p50/p99 — the comms term the
 Spark-perf study (arXiv 1612.01437) says dominates once workers leave
 shared memory, measured instead of assumed, against the in-process
 ``cluster_failover`` lane as the shared-memory baseline.
+
+``wire_ingest_smoke``: the front-door pin — the SAME elastic traffic
+trace driven twice, once against an in-process journaled FleetCluster
+and once through real sockets (subprocess workers + the ingest
+gateway's batched push frames), must produce bit-identical per-session
+event streams at equal shed declarations, with conservation balanced
+end-to-end and the group-committed ``acks`` records measured against
+their per-event equivalent straight from the workers' journal
+segments.  ``wire_ingest_benchmark`` is the bench lane: windows/s over
+sockets vs in-process, the ack-path journal bytes per window, and the
+coalescing ratio the PR's 0.5× acceptance bound rides on.
 """
 
 from __future__ import annotations
 
+import os
 import shutil
 import tempfile
 import time
@@ -326,6 +338,342 @@ def wire_failover_benchmark(
                 "rpc_rtt_p99_ms": (
                     round(float(np.median(rtt99)), 4) if rtt99 else None
                 ),
+                "contract_ok": ok,
+            }
+        )
+    return rows
+
+
+# ------------------------------------------------- wire-rate ingest
+
+
+def _ack_journal_stats(journal_dirs) -> dict:
+    """Measure the ack path's journal cost straight from the workers'
+    segments: the actual bytes of the group-committed ``acks`` records
+    vs the bytes the SAME entries would have cost as per-event ``ack``
+    records — each entry reconstructed (sid, a per-session running
+    window counter as its t_index, version, shed, its own float64 probs
+    row) and re-encoded through the journal's own framing
+    (``encode_record``), so the coalescing ratio is a measurement of
+    both layouts under one encoder, not a model."""
+    from har_tpu.serve.journal import encode_record, read_segment
+
+    acks_records = entries = legacy_ack_records = 0
+    coalesced_bytes = equiv_bytes = 0
+    next_ti: dict = {}
+    for jdir in journal_dirs:
+        try:
+            names = sorted(os.listdir(jdir))
+        except OSError:
+            continue
+        for name in names:
+            if not (name.startswith("wal.") and name.endswith(".log")):
+                continue
+            records, _torn = read_segment(os.path.join(jdir, name))
+            for meta, payload in records:
+                t = meta.get("t")
+                if t == "ack":
+                    legacy_ack_records += 1
+                elif t == "acks":
+                    n = int(meta["n"])
+                    acks_records += 1
+                    entries += n
+                    coalesced_bytes += len(encode_record(meta, payload))
+                    rows = np.frombuffer(payload, np.float64).reshape(
+                        n, -1
+                    )
+                    for sid, row in zip(meta["sids"], rows):
+                        ti = next_ti.get(sid, 0)
+                        next_ti[sid] = ti + 1
+                        equiv_bytes += len(
+                            encode_record(
+                                {
+                                    "t": "ack",
+                                    "sid": sid,
+                                    "ti": ti,
+                                    "ver": meta.get("ver", "A"),
+                                    "shed": bool(meta.get("shed")),
+                                },
+                                row.tobytes(),
+                            )
+                        )
+    return {
+        "acks_records": acks_records,
+        "entries": entries,
+        "legacy_ack_records": legacy_ack_records,
+        "coalesced_bytes": coalesced_bytes,
+        "per_record_bytes": equiv_bytes,
+        "bytes_per_window": (
+            round(coalesced_bytes / entries, 2) if entries else None
+        ),
+        "per_record_bytes_per_window": (
+            round(equiv_bytes / entries, 2) if entries else None
+        ),
+        "coalesce_ratio": (
+            round(coalesced_bytes / equiv_bytes, 4)
+            if equiv_bytes
+            else None
+        ),
+    }
+
+
+def _by_session(events) -> dict:
+    from har_tpu.serve.chaos import _event_fields
+
+    out: dict = {}
+    for fe in events:
+        out.setdefault(fe.session_id, []).append(_event_fields(fe))
+    return out
+
+
+def _run_wire_ingest(
+    peak_sessions: int,
+    workers: int,
+    seed: int,
+    *,
+    rounds: int = 40,
+    window: int = 100,
+    hop: int = 50,
+    target_batch: int = 32,
+) -> dict:
+    """One measured front-door run: the same elastic traffic trace
+    driven against (a) an in-process journaled FleetCluster — the
+    reference — and (b) subprocess workers behind the ingest gateway
+    over real sockets, batched push frames and all.  The verdict pins
+    bit-identical per-session event streams at equal shed declarations,
+    conservation balanced at the edge (every client window enqueued
+    lands in fleet accounting; refusals are declared receipts), and
+    zero undeclared drops."""
+    from har_tpu.serve.cluster.controller import FleetCluster
+    from har_tpu.serve.engine import FleetConfig
+    from har_tpu.serve.journal import JournalConfig
+    from har_tpu.serve.loadgen import AnalyticDemoModel
+    from har_tpu.serve.net.gateway import GatewayClient, launch_gateway
+    from har_tpu.serve.traffic import TraceSpec, TrafficTrace, drive_trace
+
+    spec = TraceSpec(
+        kind="diurnal",
+        peak_sessions=peak_sessions,
+        swing=4.0,
+        rounds=rounds,
+        period=rounds,
+        seed=seed,
+    )
+    trace = TrafficTrace(spec)
+    fleet_config = FleetConfig(
+        target_batch=target_batch, max_delay_ms=0.0, retries=1
+    )
+    # snapshot_every=0: only the attach-time snapshot, so every ack
+    # record of the run survives in the wal segments for measurement
+    journal_config = JournalConfig(flush_every=512, snapshot_every=0)
+
+    # ---- reference: the same trace, in-process, journaled workers
+    ref_root = tempfile.mkdtemp(prefix="har_ingest_ref_")
+    ref_events: list = []
+    try:
+        ref_cluster = FleetCluster(
+            AnalyticDemoModel(),
+            ref_root,
+            workers=workers,
+            window=window,
+            hop=hop,
+            fleet_config=fleet_config,
+            journal_config=journal_config,
+        )
+        ref_events, ref_report = drive_trace(ref_cluster, trace)
+        ref_acct = ref_cluster.accounting()
+        for w in ref_cluster._workers.values():
+            w.close()
+    finally:
+        shutil.rmtree(ref_root, ignore_errors=True)
+
+    # ---- the wire run: subprocess workers + gateway + batched frames
+    root = tempfile.mkdtemp(prefix="har_ingest_wire_")
+    procs: list = []
+    client = None
+    try:
+        net_workers = launch_workers(
+            root,
+            workers,
+            window=window,
+            hop=hop,
+            target_batch=target_batch,
+            max_delay_ms=0.0,
+            flush_every=512,
+            snapshot_every=0,
+        )
+        procs = [w.process for w in net_workers]
+        gw_proc, gw_host, gw_port = launch_gateway(root, net_workers)
+        procs.append(gw_proc)
+        client = GatewayClient(gw_host, gw_port)
+        wire_events, wire_report = drive_trace(
+            client, TrafficTrace.from_spec(trace.spec())
+        )
+        wire_acct = client.accounting()
+        gw_stats = client.gateway_stats()
+        # orderly teardown so every journal byte is on disk before the
+        # segment scan: gateway first, then the workers close their
+        # journals via the shutdown RPC
+        client.shutdown()
+        client.close()
+        client = None
+        gw_proc.wait(timeout=30)
+        jdirs = []
+        for w in net_workers:
+            jdirs.append(w.journal_dir)
+            w.shutdown()
+            w.close()
+            w.process.wait(timeout=30)
+        ack_stats = _ack_journal_stats(jdirs)
+
+        # ---- verdict
+        ref_by = _by_session(ref_events)
+        wire_by = _by_session(wire_events)
+        keys = {(fe.session_id, fe.event.t_index) for fe in wire_events}
+        windows_lost = len(ref_events) - len(wire_events)
+        why = None
+        if len(keys) != len(wire_events):
+            why = "an event was delivered twice through the gateway"
+        elif wire_by != ref_by:
+            if windows_lost > 0:
+                why = f"{windows_lost} window(s) lost at the front door"
+            else:
+                why = (
+                    "wire events are not bit-identical to the "
+                    "in-process run"
+                )
+        elif client_sheds_differ(gw_stats, wire_report):
+            why = "edge sheds were not declared symmetrically"
+        elif not wire_acct["balanced"] or wire_acct["pending"] != 0:
+            why = f"conservation violated over the wire: {wire_acct}"
+        elif wire_acct["dropped"] != ref_acct["dropped"]:
+            why = (
+                "shed declarations diverged: wire dropped "
+                f"{wire_acct['dropped']}, in-process "
+                f"{ref_acct['dropped']}"
+            )
+        elif wire_acct["enqueued"] != ref_acct["enqueued"]:
+            why = (
+                "an undeclared drop at the edge: wire enqueued "
+                f"{wire_acct['enqueued']}, in-process "
+                f"{ref_acct['enqueued']}"
+            )
+        elif not ack_stats["entries"]:
+            why = "no group-committed acks records reached the journal"
+        return {
+            "ok": why is None,
+            "why": why,
+            "sessions": int(trace.total_sessions),
+            "workers": int(workers),
+            "transport": "tcp",
+            "rounds": int(rounds),
+            "frames": int(gw_stats["admitted_frames"]),
+            "shed_frames": int(gw_stats["shed_frames"]),
+            "windows_lost": max(windows_lost, 0),
+            "windows_enqueued": int(wire_acct["enqueued"]),
+            "windows_scored": int(wire_acct["scored"]),
+            "wire_duration_s": wire_report.duration_s,
+            "inproc_duration_s": ref_report.duration_s,
+            "event_latency_ms": [
+                float(fe.event.latency_ms) for fe in wire_events
+            ],
+            "ack_stats": ack_stats,
+        }
+    finally:
+        if client is not None:
+            client.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def client_sheds_differ(gw_stats: dict, wire_report) -> bool:
+    """The edge's declared-receipt law, checked from both ends: every
+    frame the gateway refused must be a shed the CLIENT also counted —
+    here the honest drive sends no stale/oversized frames, so both
+    sides must agree on zero."""
+    return int(gw_stats["shed_frames"]) != 0
+
+
+def wire_ingest_smoke(
+    peak_sessions: int = 64, workers: int = 2, seed: int = 0
+) -> dict:
+    """Gate verdict: one front-door run reshaped into the gate-log
+    stamp (keys pinned by tests/test_release_gate.py)."""
+    out = _run_wire_ingest(peak_sessions, workers, seed)
+    ack = out["ack_stats"]
+    return {
+        "ok": out["ok"],
+        "why": out["why"],
+        "sessions": out["sessions"],
+        "workers": out["workers"],
+        "transport": out["transport"],
+        "frames": out["frames"],
+        "bytes_per_window": ack["bytes_per_window"],
+        "per_record_bytes_per_window": ack[
+            "per_record_bytes_per_window"
+        ],
+        "ack_coalesce_ratio": ack["coalesce_ratio"],
+        "ack_records_coalesced": ack["entries"],
+        "windows_lost": out["windows_lost"],
+    }
+
+
+def wire_ingest_benchmark(
+    session_counts,
+    n_runs: int = 3,
+    *,
+    workers: int = 2,
+    seed: int = 0,
+    rounds: int = 40,
+) -> list[dict]:
+    """bench.py's ``wire_ingest`` lane rows: per traffic size, the
+    front-door throughput over real sockets (median windows/s of
+    ``n_runs``) against the in-process drive of the SAME trace, the
+    per-event p99 latency over the wire, and the ack path's journal
+    bytes per window — coalesced vs the per-event equivalent, with the
+    ratio the 0.5× acceptance bound rides on.  ``contract_ok`` pins
+    the bit-identity + conservation verdict on every measured run."""
+    rows = []
+    for n_sessions in session_counts:
+        wire_ws, inproc_ws, p99s = [], [], []
+        ack = {}
+        frames, ok = 0, True
+        for r in range(int(n_runs)):
+            out = _run_wire_ingest(
+                int(n_sessions), workers, seed + r, rounds=rounds
+            )
+            ok = ok and out["ok"]
+            scored = out["windows_scored"]
+            if out["wire_duration_s"]:
+                wire_ws.append(scored / out["wire_duration_s"])
+            if out["inproc_duration_s"]:
+                inproc_ws.append(scored / out["inproc_duration_s"])
+            lat = out["event_latency_ms"]
+            if lat:
+                p99s.append(float(np.percentile(lat, 99)))
+            ack = out["ack_stats"]
+            frames = out["frames"]
+        rows.append(
+            {
+                "n_sessions": int(n_sessions),
+                "workers": int(workers),
+                "transport": "tcp",
+                "frames": int(frames),
+                "windows_s_median": round(float(np.median(wire_ws)), 1),
+                "windows_s_std": round(float(np.std(wire_ws)), 1),
+                "inproc_windows_s_median": round(
+                    float(np.median(inproc_ws)), 1
+                ),
+                "event_p99_ms": (
+                    round(float(np.median(p99s)), 3) if p99s else None
+                ),
+                "ack_bytes_per_window": ack.get("bytes_per_window"),
+                "per_record_bytes_per_window": ack.get(
+                    "per_record_bytes_per_window"
+                ),
+                "ack_coalesce_ratio": ack.get("coalesce_ratio"),
                 "contract_ok": ok,
             }
         )

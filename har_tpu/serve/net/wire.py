@@ -75,16 +75,71 @@ class FrameBuffer:
     peer that wrote garbage.
     """
 
-    __slots__ = ("_buf",)
+    __slots__ = ("_buf", "_skip")
 
     def __init__(self):
         self._buf = bytearray()
+        # bytes of a skipped frame still in flight: dropped at feed()
+        # time so a refused payload never accumulates in the buffer
+        self._skip = 0
 
     def feed(self, chunk: bytes) -> None:
+        if self._skip:
+            if len(chunk) <= self._skip:
+                self._skip -= len(chunk)
+                return
+            chunk = memoryview(chunk)[self._skip :]
+            self._skip = 0
         self._buf.extend(chunk)
 
     def __len__(self) -> int:
         return len(self._buf)
+
+    def peek_header(self):
+        """The edge-admission view: ``(meta, payload_len)`` as soon as
+        the fixed header + meta bytes have arrived, WITHOUT waiting for
+        (or touching) the payload.  This is what lets a gateway refuse
+        a frame from its header alone — session count, byte length,
+        staleness watermark all ride the meta — before any payload
+        decode or allocation happens.  The CRC spans meta+payload and
+        therefore cannot be checked yet; an admitted frame still goes
+        through ``next_frame``'s full CRC verification, a refused one
+        is discarded unverified (worst case a corrupt frame is refused
+        as a shed instead of a FrameError — either way it never lands).
+        Oversized declared lengths and garbled meta raise FrameError
+        exactly like ``next_frame``."""
+        buf = self._buf
+        if len(buf) < _HDR.size:
+            return None
+        meta_len, payload_len, _crc = _HDR.unpack_from(buf, 0)
+        total = _HDR.size + meta_len + payload_len
+        if total > MAX_FRAME_BYTES:
+            raise FrameError(
+                f"declared frame of {total} bytes exceeds "
+                f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})"
+            )
+        if len(buf) < _HDR.size + meta_len:
+            return None
+        try:
+            meta = json.loads(
+                bytes(buf[_HDR.size : _HDR.size + meta_len]).decode()
+            )
+        except (ValueError, UnicodeDecodeError) as exc:
+            raise FrameError(f"undecodable frame meta: {exc}")
+        return meta, payload_len
+
+    def skip_frame(self) -> None:
+        """Discard the frame at the head of the buffer without ever
+        assembling its payload: bytes already buffered are deleted,
+        bytes still in flight are dropped as ``feed`` receives them.
+        Only valid after ``peek_header`` returned a header — a refused
+        frame costs the edge its header parse, never an allocation."""
+        buf = self._buf
+        meta_len, payload_len, _crc = _HDR.unpack_from(buf, 0)
+        total = _HDR.size + meta_len + payload_len
+        have = min(len(buf), total)
+        del buf[:have]
+        self._skip += total - have
 
     def next_frame(self):
         buf = self._buf
@@ -124,6 +179,49 @@ def decode_samples(meta: dict, payload: bytes) -> np.ndarray:
     return np.frombuffer(payload, np.float32).reshape(
         int(meta["n"]), int(meta["c"])
     )
+
+
+def encode_chunk_batch(items) -> tuple[dict, bytes]:
+    """Multi-session push codec — one frame per delivery round instead
+    of one RPC per session chunk: per-chunk ``{sid, n, c}`` dicts in
+    the meta list (the ``push`` record's fields), the float32 sample
+    rows concatenated in the payload in delivery order.  The meta's
+    ``s`` (session count) and the frame's payload length are exactly
+    what the gateway's edge admission reads from the header — a shed
+    frame is refused before this payload is ever decoded."""
+    metas: list = []
+    chunks: list = []
+    for sid, samples in items:
+        arr = np.ascontiguousarray(samples, np.float32)
+        metas.append(
+            {"sid": sid, "n": int(arr.shape[0]), "c": int(arr.shape[1])}
+        )
+        chunks.append(arr.tobytes())
+    return {"chunks": metas, "s": len(metas)}, b"".join(chunks)
+
+
+def decode_chunk_batch(meta: dict, payload: bytes) -> list:
+    """Inverse of ``encode_chunk_batch``: ``[(sid, samples)]`` in
+    delivery order.  The sample arrays are zero-copy ``frombuffer``
+    views over the received payload — the only copy between the socket
+    and the device is the engine's own staging write into its reserved
+    ``StagingArena`` slot."""
+    out = []
+    pos = 0
+    view = memoryview(payload)  # slices below are views, not copies
+    for em in meta.get("chunks") or []:
+        n, c = int(em["n"]), int(em["c"])
+        nb = 4 * n * c
+        out.append(
+            (
+                em["sid"],
+                np.frombuffer(view[pos : pos + nb], np.float32).reshape(
+                    n, c
+                ),
+            )
+        )
+        pos += nb
+    return out
 
 
 def encode_drift_reports(items) -> tuple[dict, bytes]:

@@ -120,6 +120,17 @@ class WorkerHost:
             n = shim.push(meta["sid"], wire.decode_samples(meta, payload))
             return ok({"r": int(n)})
 
+        def push_many(meta, payload):
+            # one frame per delivery round: the chunk-batch codec's
+            # sample arrays are zero-copy views over the received
+            # payload; the engine stages them straight into its
+            # reserved StagingArena slots in delivery order
+            items = wire.decode_chunk_batch(meta, payload)
+            n = shim.push_many(
+                [sid for sid, _ in items], [c for _, c in items]
+            )
+            return ok({"r": int(n)})
+
         def poll(meta, payload):
             events = shim.poll(force=bool(meta.get("force")))
             return wire.encode_events(events)
@@ -218,6 +229,7 @@ class WorkerHost:
         return {
             "heartbeat": heartbeat,
             "push": push,
+            "push_many": push_many,
             "poll": poll,
             "add_session": add_session,
             "disconnect": disconnect,

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import zlib
 from collections import deque
 from typing import Callable
 
@@ -53,6 +54,17 @@ class RecoveryError(RuntimeError):
     """Journal contents inconsistent with the engine's invariants (an
     ack for a window replay never completed, a record for an unknown
     session) — corruption, not a normal crash signature."""
+
+
+# Record types whose WRITER has been superseded but whose journals are
+# still in the field.  Per-event `ack` records were replaced by the
+# group-committed `acks` record (one batched write per retire); the
+# `ack` handler below stays forever — old journals never migrate, and a
+# mixed log (old `ack` + new `acks`) restores through both handlers in
+# record order.  HL003 pins this declaration both ways: a retired type
+# must keep its handler, and a type with a live writer must not hide
+# here.
+RETIRED_RECORD_TYPES = ("ack",)
 
 
 def _oldest_live(server, sess):
@@ -252,6 +264,54 @@ def restore_server(
                     bool(meta.get("shed")),
                     np.frombuffer(payload, np.float64),
                 )
+            elif t == "acks":
+                # group-committed acks (one record per retire): the
+                # entries ride in the retire loop's emit order, so
+                # replaying them through the same per-event
+                # _consume_ack sequence re-steps each smoother
+                # bit-identically to a per-record `ack` log.  The
+                # per-record handler above stays — old and mixed logs
+                # replay without migration.  Each entry's t_index is
+                # NOT stored (the push records already determine it:
+                # it's the session's oldest live pending); the record
+                # carries one crc32 over the expected int64 column
+                # ("tic") so a journal that diverged from the engine's
+                # ack order still refuses to recover, at 4 bytes per
+                # RECORD instead of 8 per entry.
+                n = int(meta["n"])
+                ver = meta.get("ver", "v0")
+                a_shed = bool(meta.get("shed"))
+                rows = np.frombuffer(payload, np.float64).reshape(n, -1)
+                pq = server._pending
+                tis = np.empty(n, np.int64)
+                for j, (sid, row) in enumerate(
+                    zip(meta["sids"], rows)
+                ):
+                    sess = server._sessions.get(sid)
+                    if sess is None:
+                        raise RecoveryError(
+                            f"ack for unknown session {sid!r}"
+                        )
+                    p = _oldest_live(server, sess)
+                    if p is None:
+                        raise RecoveryError(
+                            f"ack for session {sid!r} but no window "
+                            "was recovered pending — a window would "
+                            "be double-scored; refusing to recover "
+                            "from this journal"
+                        )
+                    tis[j] = int(pq.t_index[p])
+                    _consume_ack(
+                        server, sess, int(tis[j]), ver, a_shed, row
+                    )
+                crc = zlib.crc32(tis.tobytes()) & 0xFFFFFFFF
+                if int(meta.get("tic", crc)) != crc:
+                    raise RecoveryError(
+                        "acks record t_index checksum mismatch "
+                        f"(recorded {meta['tic']}, replayed {crc}) — "
+                        "the journal's ack order diverged from the "
+                        "recovered pending queue; refusing to recover"
+                    )
             elif t == "drop":
                 sess = server._sessions.get(meta["sid"])
                 if sess is None:

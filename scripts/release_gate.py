@@ -277,6 +277,19 @@ def _journal_ship_smoke() -> dict:
     return _run_smoke("har_tpu.serve.net.smoke", "journal_ship_smoke")
 
 
+def _wire_ingest_smoke() -> dict:
+    """Ingest front-door smoke verdict (PR 16, har_tpu.serve.net.
+    gateway): an elastic-traffic swing driven through a REAL gateway
+    subprocess over loopback TCP — batched push_many frames, edge
+    admission judged at the frame header, group-commit ``acks``
+    journal records — must match the in-process run's event streams
+    bit-identically at equal shed declarations, and the coalesced ack
+    journal must cost at most half the per-record layout's bytes per
+    window; the stamp carries ``{sessions, frames, bytes_per_window,
+    ack_records_coalesced, windows_lost}``."""
+    return _run_smoke("har_tpu.serve.net.smoke", "wire_ingest_smoke")
+
+
 def _host_plane_smoke() -> dict:
     """Host-plane smoke verdict (PR 12, the SoA session estate):
     batched-vs-sequential ingest bit-identity at N=64 with mid-chunk
@@ -414,6 +427,7 @@ def main(argv=None) -> int:
     host_plane = None
     wire = None
     ship = None
+    ingest = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -431,6 +445,7 @@ def main(argv=None) -> int:
             host_plane = prior.get("host_plane")
             wire = prior.get("wire_failover")
             ship = prior.get("journal_ship")
+            ingest = prior.get("wire_ingest")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -442,6 +457,7 @@ def main(argv=None) -> int:
             host_plane = None
             wire = None
             ship = None
+            ingest = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -577,6 +593,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # ingest gate: the same elastic swing through a real gateway
+        # subprocess — batched frames, edge admission, group-commit
+        # acks — must be bit-identical to the in-process run at equal
+        # shed declarations, with the coalesced ack journal ≤ 0.5x the
+        # per-record bytes per window, stamping {sessions, frames,
+        # bytes_per_window, ack_records_coalesced, windows_lost}
+        ingest = _wire_ingest_smoke()
+        if not ingest.get("ok"):
+            print(
+                "\nrelease_gate: RED wire ingest smoke "
+                f"({json.dumps(ingest)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -596,6 +626,7 @@ def main(argv=None) -> int:
                 "host_plane": host_plane,
                 "wire_failover": wire,
                 "journal_ship": ship,
+                "wire_ingest": ingest,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -633,6 +664,9 @@ def main(argv=None) -> int:
                 ),
                 "journal_ship_ok": (
                     None if ship is None else ship["ok"]
+                ),
+                "wire_ingest_ok": (
+                    None if ingest is None else ingest["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

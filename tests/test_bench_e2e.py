@@ -85,7 +85,8 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
         "fleet_recovery", "cluster_failover", "wire_failover",
-        "journal_ship", "elastic_traffic", "host_plane_scaling",
+        "journal_ship", "wire_ingest", "elastic_traffic",
+        "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -244,6 +245,35 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == ship["ship_ms_median"]
         )
         assert extra["journal_ship_contract_ok"] is True
+    # r20 wire-ingest lane: the elastic swing through the gateway
+    # front door (batched push_many frames, edge admission, group-
+    # commit acks) vs the same trace in-process — contract_ok pins
+    # bit-identical event streams at equal shed declarations, and the
+    # coalesced ack journal must cost at most half the reconstructed
+    # per-record layout's bytes per window at the largest measured
+    # point; or a deadline-skip marker; never silently absent
+    ingest = extra["lanes"]["wire_ingest"]
+    if "skipped" not in ingest:
+        assert ingest["transport"] == "tcp"
+        assert ingest["contract_ok"] is True
+        assert ingest["windows_per_sec_median"] > 0
+        assert ingest["inproc_windows_per_sec_median"] > 0
+        assert ingest["event_p99_ms"] >= 0
+        assert ingest["ack_coalesce_ratio"] <= 0.5
+        for row in ingest["rows"]:
+            assert row["frames"] > 0
+            assert row["ack_bytes_per_window"] > 0
+            assert (
+                row["ack_bytes_per_window"]
+                < row["per_record_bytes_per_window"]
+            )
+            assert row["contract_ok"] is True
+        assert "chip_state_probe" in ingest
+        assert (
+            extra["wire_ingest_ack_coalesce_ratio"]
+            == ingest["ack_coalesce_ratio"]
+        )
+        assert extra["wire_ingest_contract_ok"] is True
     # r14 elastic-traffic lane: the autoscaled diurnal swing vs the
     # static floor/ceiling configurations under the deterministic
     # dispatch-cost model — the adaptive run must beat the best static

@@ -550,6 +550,91 @@ def test_hl003_acceptance_ship_records_and_ship_kill_points():
     assert "absent from the chaos matrix" in msgs2
 
 
+def test_hl003_acceptance_acks_handler_and_retirement_pins():
+    """The ack-coalescing extension of the acceptance mutation: the
+    group-committed `acks` record joins HL003's bijection
+    automatically, and the RETIRED_RECORD_TYPES declaration that keeps
+    the per-event `ack` handler alive is pinned both ways — deleting
+    the `acks` replay handler, declaring a live type retired, or
+    un-declaring `ack`'s retirement must each fail the gate."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JournalExhaustivenessRule()]) == []
+    # (1) deleting the `acks` replay handler orphans the record every
+    # retire group-commits — a crash would silently drop every acked
+    # score since the last snapshot
+    mutated = dict(sources)
+    mutated["har_tpu/serve/recover.py"] = sources[
+        "har_tpu/serve/recover.py"
+    ].replace('elif t == "acks":', 'elif t == "__deleted__":')
+    assert (
+        mutated["har_tpu/serve/recover.py"]
+        != sources["har_tpu/serve/recover.py"]
+    )
+    msgs = " | ".join(
+        f.message
+        for f in lint_sources(mutated, [JournalExhaustivenessRule()])
+    )
+    assert "'acks'" in msgs and "no replay handler" in msgs
+    assert "'__deleted__'" in msgs
+    # (2) a type with a LIVE writer cannot hide behind the retirement
+    # declaration — retiring `acks` while the engine still writes it
+    # would mask a future bijection break
+    mutated2 = dict(sources)
+    mutated2["har_tpu/serve/recover.py"] = sources[
+        "har_tpu/serve/recover.py"
+    ].replace(
+        'RETIRED_RECORD_TYPES = ("ack",)',
+        'RETIRED_RECORD_TYPES = ("ack", "acks")',
+    )
+    assert (
+        mutated2["har_tpu/serve/recover.py"]
+        != sources["har_tpu/serve/recover.py"]
+    )
+    msgs2 = " | ".join(
+        f.message
+        for f in lint_sources(mutated2, [JournalExhaustivenessRule()])
+    )
+    assert "'acks'" in msgs2
+    assert "declared retired" in msgs2 and "still written" in msgs2
+    # (3) un-declaring `ack`'s retirement flags its handler as dead
+    # code — the no-migration promise (old journals replay forever) is
+    # enforced, not assumed
+    mutated3 = dict(sources)
+    mutated3["har_tpu/serve/recover.py"] = sources[
+        "har_tpu/serve/recover.py"
+    ].replace(
+        'RETIRED_RECORD_TYPES = ("ack",)', "RETIRED_RECORD_TYPES = ()"
+    )
+    msgs3 = " | ".join(
+        f.message
+        for f in lint_sources(mutated3, [JournalExhaustivenessRule()])
+    )
+    assert "'ack'" in msgs3
+    assert "matches no journaled write" in msgs3
+    # (4) a retired type that loses its handler breaks every journal
+    # still in the field — both edits at once are still a finding
+    mutated4 = dict(sources)
+    mutated4["har_tpu/serve/recover.py"] = (
+        sources["har_tpu/serve/recover.py"]
+        .replace('elif t == "ack":', 'elif t == "__gone__":')
+    )
+    msgs4 = " | ".join(
+        f.message
+        for f in lint_sources(mutated4, [JournalExhaustivenessRule()])
+    )
+    assert "retired record type 'ack' has no replay handler" in msgs4
+
+
 # --------------------------------------------------------------- HL004
 
 

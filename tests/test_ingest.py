@@ -1,0 +1,586 @@
+"""Edge admission at the ingest front door (har_tpu.serve.net.ingest /
+gateway + the RpcServer admission hook).
+
+Pins the contracts the gateway ships on:
+  1. the shed LADDER — level escalation/recovery on the backlog
+     estimate, cheapest-check-first refusal reasons, receipts counted
+     per reason, watermark advance only on admitted frames;
+  2. header-only judgment — ``FrameBuffer.peek_header`` /
+     ``skip_frame`` refuse a frame before its payload is assembled; a
+     torn payload is judged ONCE; a retried executed request is
+     answered from the dedup cache, never re-judged into a shed;
+  3. the lying client — malformed, oversized or torn frames die at the
+     header (connection hangup, protocol violation) without a handler
+     call, an arena touch or a phantom shed receipt, and the server
+     keeps serving honest clients;
+  4. declared sheds only — every refusal carries a ``{"shed": reason}``
+     receipt the client counts against its own cursors, and the fleet's
+     conservation law balances with ZERO undeclared drops;
+  5. the batched path — driving a cluster through the gateway's
+     push_many frames scores bit-identically to the same trace pushed
+     per-session in-process (push vs push_many equivalence at the
+     FleetCluster seam rides the same drive).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from har_tpu.serve import FleetConfig
+from har_tpu.serve.cluster import ClusterConfig, FleetCluster
+from har_tpu.serve.journal import _HDR
+from har_tpu.serve.loadgen import AnalyticDemoModel
+from har_tpu.serve.net.gateway import GatewayClient, IngestGateway
+from har_tpu.serve.net.ingest import EdgeAdmission, IngestConfig
+from har_tpu.serve.net.rpc import RpcClient, RpcServer
+from har_tpu.serve.net.wire import (
+    MAX_FRAME_BYTES,
+    FrameBuffer,
+    FrameError,
+    encode_chunk_batch,
+    encode_frame,
+)
+
+MODEL = AnalyticDemoModel()
+
+
+def _decision_fields(fe):
+    ev = fe.event
+    return (ev.t_index, ev.label, ev.raw_label, ev.drift,
+            ev.probability.tobytes())
+
+
+def _by_session(events):
+    out = {}
+    for e in events:
+        out.setdefault(e.session_id, []).append(_decision_fields(e))
+    return out
+
+
+# ------------------------------------------------------- shed ladder
+
+
+def test_ladder_levels_follow_the_backlog_estimate():
+    adm = EdgeAdmission(IngestConfig(soft_backlog=10, hard_backlog=20))
+    assert adm.level == 0
+    adm.note_enqueued(10)
+    assert adm.level == 1
+    adm.note_enqueued(10)
+    assert adm.level == 2
+    # drain de-escalates; the estimate never goes negative
+    adm.note_retired(15)
+    assert adm.level == 0 and adm.backlog == 5
+    adm.note_retired(50)
+    assert adm.backlog == 0
+    # resync pins the estimate to the fleet's true pending count
+    adm.note_enqueued(100)
+    adm.resync_backlog(3)
+    assert adm.backlog == 3 and adm.level == 0
+
+
+def test_admission_reasons_cheapest_check_first():
+    adm = EdgeAdmission(
+        IngestConfig(
+            soft_backlog=10, hard_backlog=20, max_frame_sessions=4,
+            max_frame_bytes=1000, max_watermark_lag=50,
+        )
+    )
+    # level 0: static bounds + staleness
+    assert adm.admit({"s": 5, "wm": 0}, 10) == "frame_sessions"
+    assert adm.admit({"s": 2, "wm": 0}, 2000) == "frame_bytes"
+    assert adm.admit({"s": 2, "wm": 100}, 10) is None
+    assert adm.admit({"s": 2, "wm": 40}, 10) == "stale"  # lag 60 > 50
+    assert adm.admit({"s": 2, "wm": 60}, 10) is None  # lag 40 <= 50
+    # level 1: ANY lag is refused, named for the pressure not the lag
+    adm.note_enqueued(10)
+    assert adm.admit({"s": 2, "wm": 99}, 10) == "soft_backlog"
+    assert adm.admit({"s": 2, "wm": 100}, 10) is None
+    # level 2: every push frame is refused until the backlog drains
+    adm.note_enqueued(10)
+    assert adm.admit({"s": 2, "wm": 100}, 10) == "hard_backlog"
+    adm.note_retired(15)
+    assert adm.admit({"s": 2, "wm": 100}, 10) is None
+
+
+def test_admission_receipts_and_watermark_advance():
+    adm = EdgeAdmission(IngestConfig(max_frame_sessions=4))
+    assert adm.admit({"s": 3, "wm": 30}, 100) is None
+    assert adm.admit({"s": 9, "wm": 60}, 200) == "frame_sessions"
+    # a refused frame must NOT advance the connection's newest
+    # watermark: its samples never landed
+    assert adm.latest_wm == 30
+    assert adm.admit({"s": 2, "wm": 60}, 50) is None
+    assert adm.latest_wm == 60
+    snap = adm.snapshot()
+    assert snap["admitted_frames"] == 2
+    assert snap["admitted_sessions"] == 5
+    assert snap["admitted_bytes"] == 150
+    assert snap["shed_frames"] == 1
+    assert snap["shed_sessions"] == 9
+    assert snap["shed_bytes"] == 200
+    assert snap["shed_by_reason"] == {"frame_sessions": 1}
+    # every frame judged is admitted or receipted — nothing silent
+    assert (
+        snap["admitted_frames"] + snap["shed_frames"] == 3
+    )
+
+
+# -------------------------------------- header peek / skip mechanics
+
+
+def _chunk_frame(n_sessions=2, rows=40, **extra):
+    items = [
+        (i, np.full((rows, 3), float(i), np.float32))
+        for i in range(n_sessions)
+    ]
+    meta, payload = encode_chunk_batch(items)
+    meta.update(extra)
+    return meta, payload
+
+
+def test_peek_header_sees_meta_before_payload():
+    meta, payload = _chunk_frame(wm=80)
+    frame = encode_frame(
+        {**meta, "m": "push_many", "id": 1, "cid": "t.0"}, payload
+    )
+    buf = FrameBuffer()
+    # header alone: not judgeable yet
+    buf.feed(frame[: _HDR.size - 1])
+    assert buf.peek_header() is None
+    # header + meta, ZERO payload bytes: the full admission view
+    split = len(frame) - len(payload)
+    buf.feed(frame[_HDR.size - 1 : split])
+    head = buf.peek_header()
+    assert head is not None
+    hmeta, plen = head
+    assert hmeta["s"] == 2 and hmeta["wm"] == 80
+    assert plen == len(payload)
+    # peek never consumed anything: the frame still decodes whole
+    buf.feed(frame[split:])
+    got = buf.next_frame()
+    assert got is not None and got[1] == payload
+
+
+def test_skip_frame_drops_in_flight_payload_bytes():
+    meta, payload = _chunk_frame()
+    refused = encode_frame({**meta, "m": "push_many", "id": 1}, payload)
+    after = encode_frame({"m": "heartbeat", "id": 2})
+    buf = FrameBuffer()
+    split = len(refused) - len(payload) + 7  # header+meta+partial payload
+    buf.feed(refused[:split])
+    assert buf.peek_header() is not None
+    buf.skip_frame()
+    assert len(buf) == 0  # buffered part of the refusal is gone
+    # the rest of the refused payload arrives INTERLEAVED with the next
+    # frame: feed drops exactly the in-flight remainder
+    buf.feed(refused[split:] + after)
+    got = buf.next_frame()
+    assert got is not None and got[0]["m"] == "heartbeat"
+
+
+def test_peek_header_raises_on_oversized_and_garbled_frames():
+    buf = FrameBuffer()
+    buf.feed(_HDR.pack(10, MAX_FRAME_BYTES, 0) + b"x" * 10)
+    with pytest.raises(FrameError):
+        buf.peek_header()
+    buf2 = FrameBuffer()
+    buf2.feed(_HDR.pack(4, 0, 0) + b"\xff\xfe{!")
+    with pytest.raises(FrameError):
+        buf2.peek_header()
+
+
+# ------------------------------- the RpcServer admission hook, live
+
+
+class _Pump:
+    """Background stepper for an RpcServer under test (the lying-
+    client harness idiom from test_ship)."""
+
+    def __init__(self, srv):
+        self.srv = srv
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.srv.step(0.02)
+
+    def close(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+        self.srv.close()
+
+
+def test_refused_frame_answers_shed_without_running_the_handler():
+    executed = []
+
+    def push_many(meta, payload):
+        executed.append(len(payload))
+        return {"r": 1}, b""
+
+    adm = EdgeAdmission(IngestConfig(max_frame_sessions=1))
+    srv = RpcServer(
+        {"push_many": push_many},
+        admission=lambda m, p: (
+            adm.admit(m, p) if m.get("m") == "push_many" else None
+        ),
+    )
+    pump = _Pump(srv)
+    client = RpcClient(srv.host, srv.port, deadline_s=5.0)
+    try:
+        meta, payload = _chunk_frame(n_sessions=3)
+        resp, _ = client.call("push_many", meta, payload)
+        assert resp["shed"] == "frame_sessions"
+        assert executed == []  # payload never decoded, never dispatched
+        meta, payload = _chunk_frame(n_sessions=1)
+        resp, _ = client.call("push_many", meta, payload)
+        assert "shed" not in resp and resp["r"] == 1
+        assert executed == [len(payload)]
+    finally:
+        client.close()
+        pump.close()
+
+
+def _raw_request(sock, srv, frame, *, pieces=1):
+    """Send ``frame`` over a raw socket in ``pieces`` sends, stepping
+    the server between them, and return the decoded response."""
+    step = max(1, len(frame) // pieces)
+    for off in range(0, len(frame), step):
+        sock.sendall(frame[off : off + step])
+        for _ in range(4):
+            srv.step(0.02)
+    buf = FrameBuffer()
+    sock.settimeout(5.0)
+    deadline = time.monotonic() + 5.0
+    while True:
+        got = buf.next_frame()
+        if got is not None:
+            return got
+        srv.step(0.02)
+        if time.monotonic() > deadline:
+            raise AssertionError("no response frame")
+        try:
+            chunk = sock.recv(1 << 16)
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise AssertionError("server hung up mid-request")
+        buf.feed(chunk)
+
+
+def test_torn_payload_is_judged_once():
+    judged = []
+    executed = []
+
+    def push_many(meta, payload):
+        executed.append(len(payload))
+        return {"r": 1}, b""
+
+    srv = RpcServer(
+        {"push_many": push_many},
+        admission=lambda m, p: judged.append(m.get("id")),
+    )
+    try:
+        meta, payload = _chunk_frame(rows=200)
+        frame = encode_frame(
+            {**meta, "m": "push_many", "id": 1, "cid": "raw.1"}, payload
+        )
+        sock = socket.create_connection((srv.host, srv.port))
+        try:
+            srv.step(0.02)  # accept
+            resp, _ = _raw_request(sock, srv, frame, pieces=5)
+            assert resp["r"] == 1
+        finally:
+            sock.close()
+        # the payload arrived over several recvs AFTER the header was
+        # admitted; the admission hook saw the frame exactly once
+        assert judged == [1]
+        assert executed == [len(payload)]
+    finally:
+        srv.close()
+
+
+def test_retried_executed_request_bypasses_admission():
+    judged = []
+    executed = []
+
+    def push_many(meta, payload):
+        executed.append(1)
+        return {"r": 7}, b""
+
+    # an admission that would refuse anything after its first yes: the
+    # duplicate must never reach it
+    def admission(meta, plen):
+        judged.append(meta.get("id"))
+        return None if len(judged) == 1 else "late"
+
+    srv = RpcServer({"push_many": push_many}, admission=admission)
+    try:
+        meta, payload = _chunk_frame()
+        frame = encode_frame(
+            {**meta, "m": "push_many", "id": 9, "cid": "raw.2"}, payload
+        )
+        sock = socket.create_connection((srv.host, srv.port))
+        try:
+            srv.step(0.02)  # accept
+            r1, _ = _raw_request(sock, srv, frame)
+            r2, _ = _raw_request(sock, srv, frame)  # retry, same id
+        finally:
+            sock.close()
+        # the retry was answered from the dedup cache: executed once,
+        # judged once, and NOT re-judged into a shed
+        assert r1["r"] == 7 and r2["r"] == 7
+        assert "shed" not in r2
+        assert executed == [1]
+        assert judged == [9]
+    finally:
+        srv.close()
+
+
+# --------------------------------------------- lying clients, edge on
+
+
+def _gateway_fixture(tmp_path, config=None, *, n_sessions=0):
+    cluster = FleetCluster(
+        MODEL,
+        str(tmp_path / "fleet"),
+        workers=2,
+        window=100,
+        hop=50,
+        smoothing="ema",
+        fleet_config=FleetConfig(max_sessions=64, max_delay_ms=0.0),
+        config=ClusterConfig(),
+    )
+    for i in range(n_sessions):
+        cluster.add_session(i)
+    gw = IngestGateway(cluster, config=config)
+    return cluster, gw
+
+
+@pytest.mark.parametrize(
+    "name,frame_bytes",
+    [
+        # undecodable garbage where a header should be
+        ("garbage", b"\x00" * 4 + b"not a frame at all" * 4),
+        # declared payload length past the wire ceiling — refused at
+        # the header, before any payload could be assembled
+        (
+            "oversized",
+            _HDR.pack(2, MAX_FRAME_BYTES, 0) + b"{}",
+        ),
+        # valid header whose meta bytes are not JSON
+        ("bad_meta", _HDR.pack(8, 0, 0) + b"\xff" * 8),
+    ],
+)
+def test_lying_frames_die_at_the_header(tmp_path, name, frame_bytes):
+    cluster, gw = _gateway_fixture(tmp_path, n_sessions=2)
+    try:
+        liar = socket.create_connection((gw.rpc.host, gw.rpc.port))
+        try:
+            gw.rpc.step(0.02)  # accept
+            liar.sendall(frame_bytes)
+            for _ in range(5):
+                gw.rpc.step(0.02)
+            # protocol violation: the connection is DEAD, not answered
+            liar.settimeout(2.0)
+            assert liar.recv(1 << 16) == b""
+        finally:
+            liar.close()
+        # nothing ran, nothing landed, nothing was receipted as a shed
+        # (a violation is not a declared refusal), and the fleet's
+        # arena was never touched
+        assert gw.rounds == 0
+        snap = gw.admission.snapshot()
+        assert snap["shed_frames"] == 0
+        assert snap["admitted_frames"] == 0
+        assert cluster.accounting()["enqueued"] == 0
+        # the server survived the liar: an honest frame still lands
+        pump = _Pump(gw.rpc)
+        try:
+            honest = GatewayClient(gw.rpc.host, gw.rpc.port)
+            honest.push(0, np.zeros((50, 3), np.float32))
+            honest.poll(force=True)
+            assert honest.frames_sent == 1 and honest.edge_sheds == 0
+            honest.close()
+        finally:
+            pump._stop.set()
+            pump._t.join(timeout=5)
+    finally:
+        gw.close()
+        cluster.close()
+
+
+def test_torn_frame_then_hangup_leaves_no_trace(tmp_path):
+    cluster, gw = _gateway_fixture(tmp_path, n_sessions=1)
+    try:
+        meta, payload = _chunk_frame()
+        frame = encode_frame(
+            {**meta, "m": "push_many", "id": 1, "cid": "liar.1"}, payload
+        )
+        liar = socket.create_connection((gw.rpc.host, gw.rpc.port))
+        gw.rpc.step(0.02)
+        liar.sendall(frame[: len(frame) // 2])
+        for _ in range(5):
+            gw.rpc.step(0.02)
+        liar.close()  # dies mid-frame
+        for _ in range(5):
+            gw.rpc.step(0.02)
+        assert gw.rounds == 0
+        assert cluster.accounting()["enqueued"] == 0
+    finally:
+        gw.close()
+        cluster.close()
+
+
+# ------------------------- declared sheds + conservation at the edge
+
+
+def test_edge_sheds_are_declared_and_conservation_balances(tmp_path):
+    cluster, gw = _gateway_fixture(
+        tmp_path,
+        # max_watermark_lag=0: any lagging frame is stale at level 0 —
+        # the deliberate-replay shed this test forces
+        IngestConfig(max_watermark_lag=0),
+    )
+    pump = _Pump(gw.rpc)
+    rng = np.random.default_rng(5)
+    client = GatewayClient(gw.rpc.host, gw.rpc.port)
+    try:
+        for i in range(4):
+            client.add_session(i)
+        chunks = {
+            i: rng.normal(size=(400, 3)).astype(np.float32)
+            for i in range(4)
+        }
+        for start in range(0, 400, client.hop):
+            for i in range(4):
+                client.push(i, chunks[i][start : start + client.hop])
+            client.poll(force=True)
+        # a lying/laggy replay: re-send an old round with a STALE
+        # watermark; the edge refuses it with a receipt and the
+        # samples never enter the fleet
+        meta, payload = encode_chunk_batch(
+            [(i, chunks[i][:50]) for i in range(4)]
+        )
+        meta["wm"] = 1  # far behind the connection's newest
+        for _ in range(2):
+            resp, _ = client._client.call("push_many", meta, payload)
+            assert resp["shed"] == "stale"
+        drained = client.flush()
+        acct = client.accounting()
+        stats = client.gateway_stats()
+
+        # declared sheds ONLY: every refused frame has a reason bucket
+        assert stats["shed_frames"] == 2
+        assert stats["shed_by_reason"] == {"stale": 2}
+        assert stats["shed_sessions"] == 8
+        # everything admitted landed in fleet accounting — zero
+        # undeclared drops anywhere in the path
+        assert stats["admitted_frames"] == client.frames_sent
+        assert acct["enqueued"] == client.windows_enqueued
+        assert acct["dropped"] == 0
+        assert acct["balanced"] and acct["pending"] == 0
+        assert acct["scored"] == client.windows_enqueued
+        assert drained == []  # poll-per-round already drained them
+    finally:
+        client.close()
+        pump.close()
+        cluster.close()
+
+
+def test_gateway_batched_frames_score_bit_identical_to_inprocess(
+    tmp_path,
+):
+    """The equivalence pin, in-process edition (the release gate's
+    wire_ingest_smoke re-proves it against subprocess workers): the
+    same per-round deliveries through (a) per-session ``push`` on a
+    FleetCluster, (b) batched ``push_many`` on an identical cluster,
+    and (c) the gateway's batched frames over a real socket must score
+    identical event streams — push vs push_many equivalence and the
+    front door's bit-identity in one drive."""
+    rng = np.random.default_rng(7)
+    n, rounds, hop = 6, 8, 50
+    chunks = {
+        i: rng.normal(size=(rounds * hop, 3)).astype(np.float32)
+        for i in range(n)
+    }
+
+    def drive(push_round, poll, flush):
+        events = []
+        for r in range(rounds):
+            push_round(r)
+            events.extend(poll())
+        events.extend(flush())
+        return events
+
+    def mk(root):
+        return FleetCluster(
+            MODEL, str(root), workers=2, window=100, hop=hop,
+            smoothing="ema",
+            fleet_config=FleetConfig(max_sessions=64, max_delay_ms=0.0),
+        )
+
+    seq = mk(tmp_path / "a")
+    for i in range(n):
+        seq.add_session(i)
+    ev_seq = drive(
+        lambda r: [
+            seq.push(i, chunks[i][r * hop : (r + 1) * hop])
+            for i in range(n)
+        ],
+        lambda: seq.poll(force=True),
+        seq.flush,
+    )
+    seq.close()
+
+    bat = mk(tmp_path / "b")
+    for i in range(n):
+        bat.add_session(i)
+    ev_bat = drive(
+        lambda r: bat.push_many(
+            list(range(n)),
+            [chunks[i][r * hop : (r + 1) * hop] for i in range(n)],
+        ),
+        lambda: bat.poll(force=True),
+        bat.flush,
+    )
+    acct_bat = bat.accounting()
+    bat.close()
+
+    gw_cluster = mk(tmp_path / "c")
+    gw = IngestGateway(gw_cluster)
+    pump = _Pump(gw.rpc)
+    client = GatewayClient(gw.rpc.host, gw.rpc.port)
+    try:
+        assert client.hop == hop  # geometry came from the cluster
+        for i in range(n):
+            client.add_session(i)
+        ev_gw = drive(
+            lambda r: [
+                client.push(i, chunks[i][r * hop : (r + 1) * hop])
+                for i in range(n)
+            ],
+            lambda: client.poll(force=True),
+            client.flush,
+        )
+        stats = client.gateway_stats()
+        acct_gw = client.accounting()
+    finally:
+        client.close()
+        pump.close()
+        gw.close()
+        gw_cluster.close()
+
+    ref = _by_session(ev_seq)
+    assert ref and _by_session(ev_bat) == ref
+    assert _by_session(ev_gw) == ref
+    # one frame per round, none shed, every window accounted
+    assert stats["admitted_frames"] == rounds
+    assert stats["shed_frames"] == 0
+    assert acct_gw["enqueued"] == acct_bat["enqueued"]
+    assert acct_gw["balanced"] and acct_gw["pending"] == 0

@@ -190,6 +190,12 @@ def _representative_records():
                  samples.tobytes()),
         "ack": ({"t": "ack", "sid": 3, "ti": 200, "ver": "A",
                  "shed": False}, probs.tobytes()),
+        # the group-committed form: m entries per record — sids in the
+        # meta, the float64 prob rows packed in the payload, one crc32
+        # over the (re-derived at replay) int64 t_index column
+        "acks": ({"t": "acks", "n": 2, "sids": [3, 9], "ver": "A",
+                  "shed": False, "tic": 0xDEADBEEF},
+                 np.concatenate([probs, probs[::-1]]).tobytes()),
         "drop": ({"t": "drop", "sid": 3, "ti": 250,
                   "reason": "backpressure"}, b""),
         "add": ({"t": "add", "sid": 4, "mon": mon}, b""),

@@ -1219,3 +1219,168 @@ def test_stats_ship_and_journal_error_counters_roundtrip():
     assert s3.ship_resumes == 0
     assert s3.journal_write_errors == 0
     assert s3.accounting()["balanced"]
+
+
+# ------------------------------------- ack-coalescing back-compat
+
+
+def _decompose_acks(src_dir, dst_dir, *, window, hop, every=1):
+    """Rewrite a journal directory, expanding each ``acks``
+    group-commit record (every ``every``-th when > 1, for mixed logs)
+    into the retired per-event ``ack`` layout the pre-coalescing
+    writer produced — the fixture generator for the no-migration pin.
+    Valid for drop-free logs: each session's acked t_index sequence is
+    then window, window+hop, ... in consumption order."""
+    import shutil
+
+    os.makedirs(dst_dir, exist_ok=True)
+    next_ti = {}
+    k = 0
+    for name in sorted(os.listdir(src_dir)):
+        src = os.path.join(src_dir, name)
+        if name.startswith("snap."):
+            shutil.copytree(src, os.path.join(dst_dir, name))
+            continue
+        if not name.startswith("wal."):
+            continue
+        records, torn = read_segment(src)
+        assert not torn
+        out = []
+        for meta, payload in records:
+            if meta.get("t") != "acks":
+                out.append(encode_record(meta, payload))
+                continue
+            k += 1
+            if (k - 1) % every:
+                out.append(encode_record(meta, payload))
+                # the skipped group still consumes its sessions' tis
+                for sid in meta["sids"]:
+                    next_ti[sid] = next_ti.get(sid, window) + hop
+                continue
+            rows = np.frombuffer(payload, np.float64).reshape(
+                int(meta["n"]), -1
+            )
+            for sid, row in zip(meta["sids"], rows):
+                ti = next_ti.get(sid, window)
+                next_ti[sid] = ti + hop
+                out.append(
+                    encode_record(
+                        {
+                            "t": "ack",
+                            "sid": sid,
+                            "ti": int(ti),
+                            "ver": meta.get("ver", "v0"),
+                            "shed": bool(meta.get("shed")),
+                        },
+                        row.tobytes(),
+                    )
+                )
+        with open(os.path.join(dst_dir, name), "wb") as fh:
+            fh.write(b"".join(out))
+
+
+def _drive_acked_journal(tmp_path, name):
+    """A drop-free journaled run with several retires: 4 sessions x
+    500 samples in hop-sized chunks, polled every round, killed with
+    a pending tail — the coalesced-``acks`` source log the back-compat
+    fixtures decompose."""
+    rng = np.random.default_rng(11)
+    server = FleetServer(
+        _StubModel(), window=100, hop=50, smoothing="ema",
+        config=FleetConfig(
+            max_sessions=16, target_batch=8, max_delay_ms=0.0
+        ),
+        journal=FleetJournal(
+            str(tmp_path / name),
+            JournalConfig(flush_every=4, snapshot_every=0),
+        ),
+    )
+    recs = [rng.normal(size=(500, 3)).astype(np.float32) for _ in range(4)]
+    for i in range(4):
+        server.add_session(i)
+    for start in range(0, 450, 50):
+        for i in range(4):
+            server.push(i, recs[i][start : start + 50])
+        server.poll(force=True)
+    # last chunk enqueued but never polled → pending at the kill
+    for i in range(4):
+        server.push(i, recs[i][450:])
+    server.journal.kill()
+    return str(tmp_path / name)
+
+
+def _drain_fields(server):
+    """Accounting + the drained tail's full event fields — the
+    bit-identity currency the fixture restores are compared on."""
+    events = [
+        (
+            e.session_id,
+            e.event.t_index,
+            e.event.label,
+            e.event.raw_label,
+            e.event.probability.tobytes(),
+        )
+        for e in server.flush()
+    ]
+    return events, server.stats.accounting()
+
+
+def test_pre_coalescing_ack_journal_restores_bit_identical(tmp_path):
+    """The no-migration pin, old half: a journal written in the
+    RETIRED per-event ``ack`` layout (the pre-coalescing fixture,
+    decomposed record-for-record from a real run's ``acks`` groups)
+    restores bit-identically to the group-committed log — same
+    accounting, same scored count, same drained tail to the byte —
+    and restore leaves the old log's bytes untouched (read-side
+    compat forever, never a rewrite)."""
+    src = _drive_acked_journal(tmp_path, "new")
+    old = str(tmp_path / "old")
+    _decompose_acks(src, old, window=100, hop=50)
+    before = {
+        n: (tmp_path / "old" / n).read_bytes()
+        for n in os.listdir(old)
+        if n.startswith("wal.")
+    }
+
+    a = FleetServer.restore(src, _StubModel(), reattach=False)
+    b = FleetServer.restore(old, _StubModel(), reattach=False)
+    assert b.stats.recoveries == 1
+    ev_a, acct_a = _drain_fields(a)
+    ev_b, acct_b = _drain_fields(b)
+    assert ev_b == ev_a and ev_b
+    assert acct_b == acct_a
+    assert acct_b["balanced"] and acct_b["pending"] == 0
+    assert acct_b["scored"] > 0
+    # no migration ever: the retired-layout log is byte-identical
+    # after the restore read it
+    after = {
+        n: (tmp_path / "old" / n).read_bytes()
+        for n in os.listdir(old)
+        if n.startswith("wal.")
+    }
+    assert after == before
+
+
+def test_mixed_ack_and_acks_journal_restores_bit_identical(tmp_path):
+    """The no-migration pin, mixed half: a log alternating retired
+    per-event ``ack`` runs with group-committed ``acks`` records (what
+    a journal looks like mid-history, written before and after the
+    coalescing change) replays through BOTH handlers in record order
+    to the same state as the uniform log."""
+    src = _drive_acked_journal(tmp_path, "new")
+    mixed = str(tmp_path / "mixed")
+    _decompose_acks(src, mixed, window=100, hop=50, every=2)
+    kinds = set()
+    for n in sorted(os.listdir(mixed)):
+        if n.startswith("wal."):
+            records, _ = read_segment(os.path.join(mixed, n))
+            kinds.update(m["t"] for m, _ in records)
+    assert {"ack", "acks"} <= kinds  # genuinely mixed
+
+    a = FleetServer.restore(src, _StubModel(), reattach=False)
+    b = FleetServer.restore(mixed, _StubModel(), reattach=False)
+    ev_a, acct_a = _drain_fields(a)
+    ev_b, acct_b = _drain_fields(b)
+    assert ev_b == ev_a and ev_b
+    assert acct_b == acct_a
+    assert acct_b["balanced"] and acct_b["pending"] == 0

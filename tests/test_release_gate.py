@@ -242,6 +242,41 @@ def test_gate_log_carries_journal_ship_verdict():
     assert ship["windows_lost"] == 0
 
 
+def test_gate_log_carries_wire_ingest_verdict():
+    """The front-door counterpart of the wire verdict (PR 16,
+    har_tpu.serve.net.gateway): the gate log must carry a green
+    wire-ingest check with the {sessions, frames, bytes_per_window,
+    ack_records_coalesced, windows_lost} stamp — an elastic swing
+    driven through a real gateway subprocess (batched push_many
+    frames, header-judged edge admission, group-commit acks),
+    bit-identical to the in-process run with zero windows lost, and
+    the coalesced ack journal at most half the per-record layout's
+    bytes per window."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    ingest = log.get("wire_ingest")
+    assert ingest, (
+        "artifacts/test_gate.json lacks the wire_ingest verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "sessions",
+        "frames",
+        "bytes_per_window",
+        "ack_records_coalesced",
+        "windows_lost",
+    ):
+        assert key in ingest
+    assert ingest["ok"] is True
+    assert ingest["transport"] == "tcp"
+    assert ingest["windows_lost"] == 0
+    assert ingest["frames"] > 0
+    assert ingest["ack_records_coalesced"] > 0
+    assert ingest["bytes_per_window"] > 0
+    assert ingest["ack_coalesce_ratio"] <= 0.5
+
+
 def test_gate_log_carries_elastic_smoke_verdict():
     """The elastic counterpart of the cluster verdict: the gate log
     must carry a green elastic-traffic check with the {swing, resizes,
