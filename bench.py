@@ -1297,6 +1297,11 @@ def main() -> None:
     # moving the recovery currency across a process boundary is a
     # number, not an assumption.  ship_ms is the wall time inside
     # fetch_journal; failover_ms the whole restore+drain+hand-off.
+    # The replicated arm (r21 tentpole, har_tpu.serve.replica) rides
+    # in-lane: the same kill with a warm standby tail-following every
+    # worker, so the failover path moves ZERO journal bytes — its
+    # failover_ms against the ship arm's is what continuous
+    # replication buys, per fleet size.
     def _journal_ship_lane():
         from har_tpu.serve.net.smoke import journal_ship_benchmark
 
@@ -1314,6 +1319,15 @@ def main() -> None:
             "failover_ms_median": rows[-1]["failover_ms_median"],
             "baseline_failover_ms_median": rows[-1][
                 "baseline_failover_ms_median"
+            ],
+            "replicated_failover_ms_median": rows[-1][
+                "replicated_failover_ms_median"
+            ],
+            "replicated_failover_path_bytes": rows[-1][
+                "replicated_failover_path_bytes"
+            ],
+            "replicated_steady_lag_records": rows[-1][
+                "replicated_steady_lag_records"
             ],
             "shipped_bytes": rows[-1]["shipped_bytes"],
             "contract_ok": all(r["contract_ok"] for r in rows),
@@ -1679,6 +1693,19 @@ def main() -> None:
         ),
         "journal_ship_baseline_ms_median": ship_stats.get(
             "baseline_failover_ms_median"
+        ),
+        # continuous replication (har_tpu.serve.replica): the same
+        # kill failing over from a warm standby's already-local bytes
+        # — zero journal bytes on the failover path, and the lag the
+        # tail was carrying at steady state
+        "replicated_failover_ms_median": ship_stats.get(
+            "replicated_failover_ms_median"
+        ),
+        "replicated_failover_path_bytes": ship_stats.get(
+            "replicated_failover_path_bytes"
+        ),
+        "replicated_steady_lag_records": ship_stats.get(
+            "replicated_steady_lag_records"
         ),
         "journal_ship_contract_ok": ship_stats.get("contract_ok"),
         # ingest front door (har_tpu.serve.net.gateway): the batched-

@@ -19,8 +19,8 @@ the shape that drifts:
      must be static);
   2. every replay handler must correspond to a written record type;
   3. the kill-point names the chaos matrix enumerates
-     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` + the cluster and ship
-     tuples in ``serve/chaos.py``) must biject with the
+     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` + the cluster, ship and
+     replication-tail tuples in ``serve/chaos.py``) must biject with the
      ``chaos_point("...")`` / ``_chaos("...")`` call sites across the
      stack, and every matrix point needs a ``_DEFAULT_AT`` occurrence
      calibration — a stage boundary without a matrix entry is a crash
@@ -196,8 +196,15 @@ class JournalExhaustivenessRule(Rule):
                 # tuple orphans its call site, deleting a call site
                 # orphans the matrix entry
                 skp, _ = _string_tuple(ctx.tree, "SHIP_KILL_POINTS")
-                declared = kp | ekp | ckp | skp
-                matrix_points = kp | ckp | skp
+                # the continuous-replication tail's stage boundaries
+                # (mid_tail_recv / mid_tail_remanifest /
+                # post_tail_verify, fired inside net/tail.py's pull and
+                # finalize loops, run by run_tail_kill_point): same
+                # bijection — a tail boundary outside the matrix is a
+                # standby-death window no chaos run exercises
+                tkp, _ = _string_tuple(ctx.tree, "TAIL_KILL_POINTS")
+                declared = kp | ekp | ckp | skp | tkp
+                matrix_points = kp | ckp | skp | tkp
                 declared_node = kp_node
                 default_at = _dict_keys(ctx.tree, "_DEFAULT_AT")
             for node in ast.walk(ctx.tree):

@@ -498,8 +498,10 @@ def _parser() -> argparse.ArgumentParser:
              "(har_tpu.serve.net.ship): serves that host's journal "
              "directories to an adopting controller as chunked, "
              "digest-manifested, resumable transfers — the shared-"
-             "nothing failover's hand-off currency; `har serve-agent "
-             "--help` for flags",
+             "nothing failover's hand-off currency; with `--follow "
+             "WID=HOST:PORT` it becomes a warm standby that tail-"
+             "replicates live workers continuously so failover ships "
+             "nothing; `har serve-agent --help` for flags",
     )
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")

@@ -55,11 +55,13 @@ from har_tpu.serve.chaos import (
     ENGINE_KILL_POINTS,
     KILL_POINTS,
     SHIP_KILL_POINTS,
+    TAIL_KILL_POINTS,
     KillPlan,
     SimulatedCrash,
     run_cluster_kill_point,
     run_kill_point,
     run_random_kill,
+    run_tail_kill_point,
 )
 from har_tpu.serve.arena import PendingArena, SessionArena
 from har_tpu.serve.dispatch import (
@@ -100,6 +102,7 @@ from har_tpu.serve.recover import (
     recovery_smoke,
     restore_server,
 )
+from har_tpu.serve.replica import StandbyAgent, StandbyHost, WarmReplica
 from har_tpu.serve.slo import (
     events_equal,
     fleet_pipeline_smoke,
@@ -147,12 +150,15 @@ __all__ = [
     "JournalError",
     "KILL_POINTS",
     "SHIP_KILL_POINTS",
+    "TAIL_KILL_POINTS",
     "KillPlan",
     "LoadReport",
     "PendingArena",
     "RecoveryError",
     "SessionArena",
     "SimulatedCrash",
+    "StandbyAgent",
+    "StandbyHost",
     "StageHistogram",
     "StagingArena",
     "drive_fleet",
@@ -167,5 +173,7 @@ __all__ = [
     "restore_server",
     "run_kill_point",
     "run_random_kill",
+    "run_tail_kill_point",
     "synthetic_sessions",
+    "WarmReplica",
 ]

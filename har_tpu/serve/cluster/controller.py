@@ -204,6 +204,23 @@ class FleetCluster:
         # chaos hook (serve.chaos): raises a simulated crash at the two
         # migration stage boundaries the kill matrix exercises
         self.chaos: Callable[[str], None] | None = None
+        # warm standbys (har_tpu.serve.replica.StandbyAgent): cycled at
+        # the top of every poll so their tails stay caught up; a
+        # failover consults them FIRST — a standby that holds the dead
+        # worker's tail finalizes it (verify already-local bytes, pull
+        # only the missing suffix) and the cold ship/shared-disk path
+        # becomes the fallback.  name -> (standby, prefer_wid)
+        self._standbys: dict = {}
+        # bytes moved ON the failover path (finalize_tail pulls) — 0
+        # for a caught-up tail, vs NetCluster.ship_ms/shipped_bytes
+        # which count the steady-state tail + cold ships
+        self.failover_path_bytes = 0
+        self.standby_fetches = 0
+        # dead_wid -> original journal dir for failovers restored from
+        # a standby tail: the retired marker lands in the REPLICA dir
+        # (the restore source), so commit propagates it back to the
+        # original home a takeover/resume scan reads
+        self._standby_origin: dict = {}
         if _workers is not None:
             for w in _workers:
                 self._adopt_worker(w)
@@ -411,6 +428,12 @@ class FleetCluster:
         events = self._pending_events
         self._pending_events = []
         try:
+            # warm standbys tail first: the cycle that runs in the same
+            # poll that declares a death sees the (now static) journal
+            # in full, which is what makes the failover-path transfer
+            # deterministically zero for a registered standby
+            for standby, _prefer in self._standbys.values():
+                standby.cycle()
             while self._handoff_queue:
                 dead_wid, restored = self._handoff_queue[0]
                 self._complete_failover(dead_wid, restored)
@@ -554,7 +577,53 @@ class FleetCluster:
         marker = os.path.join(worker.journal_dir, RETIRED_MARKER)
         if os.path.exists(marker):
             return None
+        dest = self._standby_partition(worker.worker_id)
+        if dest is not None:
+            self._standby_origin[worker.worker_id] = worker.journal_dir
+            return dest
         return worker.journal_dir
+
+    # ------------------------------------------------- warm standbys
+
+    def register_standby(self, standby, *, name: str = "sb0",
+                         prefer=None) -> None:
+        """Attach a ``StandbyAgent`` whose tails this controller drives
+        from its poll loop and consults first at failover.  ``prefer``
+        names the worker co-located with the standby's replicas:
+        failover hand-offs of a partition this standby holds are
+        steered there ahead of the ring owner (warm placement — the
+        adopter next to the already-local bytes)."""
+        self._standbys[name] = (standby, prefer)
+
+    def _standby_partition(self, dead_wid) -> str | None:
+        """The warm path of a partition fetch: a standby holding the
+        dead worker's tail finalizes it — whole-file sha256 on
+        already-local bytes plus the missing suffix (zero bytes when
+        the tail was caught up).  Any ship failure here falls back to
+        the cold path (``None``): a broken standby must never make a
+        failover WORSE than PR-14's ship-at-failover."""
+        from har_tpu.serve.net.ship import ShipError
+
+        for name, (standby, _prefer) in self._standbys.items():
+            if not standby.holds(dead_wid):
+                continue
+            try:
+                fin = standby.finalize(dead_wid)
+            except ShipError:
+                continue
+            self.standby_fetches += 1
+            self.failover_path_bytes += int(fin.get("bytes", 0))
+            return standby.dest(dead_wid)
+        return None
+
+    def _warm_adopter(self, dead_wid):
+        """The worker failover hand-offs should prefer for sessions of
+        ``dead_wid`` — the one registered next to a standby that holds
+        its replica; None when no standby claims it."""
+        for standby, prefer in self._standbys.values():
+            if prefer is not None and standby.holds(dead_wid):
+                return prefer
+        return None
 
     @property
     def pending_failovers(self) -> int:
@@ -574,8 +643,11 @@ class FleetCluster:
         # the hand-off (export_session/evict_session) — one evict body,
         # not a parallel wrapper that could drift from it
         source = ClusterWorker(dead_wid, restored, restored.journal.root)
+        prefer = self._warm_adopter(dead_wid)
         for sid in restored.sessions:
-            target_wid = self._hand_off(source, sid, dead_wid)
+            target_wid = self._hand_off(
+                source, sid, dead_wid, prefer=prefer
+            )
             if target_wid not in receivers:
                 receivers.append(target_wid)
             self._chaos("mid_migration")
@@ -602,10 +674,20 @@ class FleetCluster:
         restored.journal.close()
 
     def _commit_retired(self, dead_wid, entry: dict) -> None:
-        """Transport hook (no-op in-process): propagate a consumed
-        partition's retired marker back to its source host."""
+        """Transport hook: propagate a consumed partition's retired
+        marker back to its source home.  In-process this only matters
+        for a standby-sourced failover (the marker above landed in the
+        REPLICA dir; a resume/takeover scan reads the original home);
+        the wire transport overrides this with the agent's retire
+        RPC."""
+        origin = self._standby_origin.pop(dead_wid, None)
+        if origin is not None and os.path.isdir(origin):
+            atomic_write(
+                os.path.join(origin, RETIRED_MARKER), json.dumps(entry)
+            )
 
-    def _hand_off(self, source, sid, source_wid, target_wid=None):
+    def _hand_off(self, source, sid, source_wid, target_wid=None,
+                  prefer=None):
         """Move one drained session from ``source`` to its ring owner
         (or the explicit ``target_wid`` of a planned move):
         adopt-first (durable on the target), chaos point in the
@@ -623,6 +705,14 @@ class FleetCluster:
             candidates = [primary] + [
                 wid for wid in self._workers if wid != primary
             ]
+            if prefer is not None and prefer in self._workers:
+                # warm placement: the adopter co-located with the
+                # standby's replica of the source partition goes ahead
+                # of the ring owner (the prior-durable-adopt pre-scan
+                # below still wins over any preference)
+                candidates = [prefer] + [
+                    wid for wid in candidates if wid != prefer
+                ]
         t0 = time.perf_counter()
         # ownership pre-scan over ALL live workers (the source of a
         # planned move excepted — it owns the session until its
@@ -990,6 +1080,9 @@ class FleetCluster:
             "sessions": len(self._placement),
             "failovers": self.failovers,
             "failover_ms": round(self.failover_ms, 3),
+            "failover_path_bytes": self.failover_path_bytes,
+            "standbys": len(self._standbys),
+            "standby_fetches": self.standby_fetches,
             "migrated_sessions": len(self.migration_log),
             "worker_failovers": reduce_sum(
                 [p["worker_failovers"] for p in per_worker]
@@ -1020,3 +1113,5 @@ class FleetCluster:
                 restored.journal.close()
         for w in self._workers.values():
             w.close()
+        for standby, _prefer in self._standbys.values():
+            standby.close()

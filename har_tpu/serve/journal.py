@@ -160,6 +160,42 @@ def read_segment(path: str) -> tuple[list[tuple[dict, bytes]], bool]:
     return records, pos < n
 
 
+def read_segment_from(
+    path: str, offset: int
+) -> tuple[list[tuple[dict, bytes]], int]:
+    """Incremental segment read for the replication tail
+    (har_tpu.serve.replica): decode every complete record at or after
+    ``offset`` and return (records, next_offset) — the byte cursor just
+    past the last decodable record, the resume point for the next pass.
+    A torn or half-staged tail simply ends the read (the cursor stays
+    before it); the next pass re-reads from there once more bytes land.
+    Same framing walk as ``read_segment`` — the two cannot disagree on
+    what a record is."""
+    records: list[tuple[dict, bytes]] = []
+    try:
+        with open(path, "rb") as f:
+            f.seek(int(offset))
+            data = f.read()
+    except OSError as exc:
+        raise JournalError(f"unreadable journal segment {path}: {exc}")
+    pos, n = 0, len(data)
+    while pos + _HDR.size <= n:
+        meta_len, payload_len, crc = _HDR.unpack_from(data, pos)
+        end = pos + _HDR.size + meta_len + payload_len
+        if end > n:
+            break
+        body = data[pos + _HDR.size : end]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            break
+        try:
+            meta = json.loads(body[:meta_len].decode())
+        except ValueError:
+            break
+        records.append((meta, body[meta_len:]))
+        pos = end
+    return records, int(offset) + pos
+
+
 class FleetJournal:
     """Append-only fleet mutation log + snapshot writer.
 
@@ -437,17 +473,29 @@ def monitor_from_state(state: dict | None):
     return DriftMonitor.from_state(state)
 
 
-def load_journal(root: str) -> tuple[dict, dict, list[tuple[dict, bytes]]]:
+def load_journal(
+    root: str, *, inflight_ship_ok: bool = False
+) -> tuple[dict, dict, list[tuple[dict, bytes]]]:
     """Read a journal directory back: (snapshot_state, snapshot_arrays,
     suffix_records).  The newest COMPLETE snapshot wins (a mid-snapshot
     kill leaves a ``.tmp`` dir, ignored by construction); the suffix is
     every decodable record in segments at or after the snapshot's
-    rotation point, torn tails discarded."""
+    rotation point, torn tails discarded.
+
+    ``inflight_ship_ok`` lifts the partially-shipped-copy refusal for
+    the WARM REPLICA only (har_tpu.serve.replica): a standby's tail
+    destination carries ``ship.log`` without ``ship.done`` for its
+    whole tailing life by design, and its reads are advisory — every
+    FAILOVER restore still runs with the guard on, after
+    ``finalize_tail`` verified whole-file digests and landed the done
+    marker.  Never set this on a recovery path."""
     root = os.path.abspath(os.path.expanduser(root))
     if not os.path.isdir(root):
         raise JournalError(f"no journal directory at {root}")
-    if os.path.exists(os.path.join(root, SHIP_LOG)) and not os.path.exists(
-        os.path.join(root, SHIP_DONE)
+    if (
+        not inflight_ship_ok
+        and os.path.exists(os.path.join(root, SHIP_LOG))
+        and not os.path.exists(os.path.join(root, SHIP_DONE))
     ):
         raise JournalError(
             f"journal directory {root} is a partially shipped copy "

@@ -38,6 +38,11 @@ This package puts a real wire behind that seam:
                 agent per worker host streams journal dirs as chunked,
                 manifest-digested, resumable transfers — the failover
                 hand-off currency across a real process boundary
+  tail.py       the ship protocol pointed at a MOVING target: resumable
+                incremental pulls of a live worker's journal into a
+                standby-local mirror (``har serve-agent --follow``
+                rides this; see ``har_tpu.serve.replica`` for the warm
+                in-memory replica kept on top of the tailed bytes)
   election.py   replicated controller: wall-clock lease file + fenced
                 campaign; a replica completes ``takeover`` when the
                 leader's lease expires
@@ -81,7 +86,15 @@ from har_tpu.serve.net.rpc import (
     RpcRemoteError,
     RpcServer,
 )
-from har_tpu.serve.net.smoke import wire_failover_smoke
+from har_tpu.serve.net.smoke import (
+    replication_smoke,
+    wire_failover_smoke,
+)
+from har_tpu.serve.net.tail import (
+    LocalShipSource,
+    finalize_tail,
+    tail_once,
+)
 from har_tpu.serve.net.wire import (
     MAX_FRAME_BYTES,
     FrameBuffer,
@@ -103,6 +116,7 @@ __all__ = [
     "IngestGateway",
     "LeaderLease",
     "LinkFaults",
+    "LocalShipSource",
     "MAX_FRAME_BYTES",
     "NetCluster",
     "NetWorker",
@@ -121,8 +135,11 @@ __all__ = [
     "encode_events",
     "encode_export",
     "fetch_journal",
+    "finalize_tail",
     "launch_agents",
     "launch_gateway",
     "launch_workers",
+    "replication_smoke",
+    "tail_once",
     "wire_failover_smoke",
 ]

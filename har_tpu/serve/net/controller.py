@@ -65,6 +65,12 @@ from har_tpu.serve.stats import FleetStats
 # CONTROLLER's root (controller replicas share it — the same disk the
 # election lease file already lives on), never on a worker host
 SHIPPED_DIR = "_shipped"
+# controller-private home for warm-standby tails (one subdirectory per
+# followed worker, har_tpu.serve.replica.StandbyAgent): same disk as
+# the staging area, but these fill CONTINUOUSLY while the workers are
+# alive — at failover the finalized tail is the restore source and the
+# ship path above becomes the fallback
+REPLICA_DIR = "_replica"
 
 
 class NetCluster(FleetCluster):
@@ -151,6 +157,15 @@ class NetCluster(FleetCluster):
         dest = self._staged_dir(wid)
         if os.path.exists(os.path.join(dest, RETIRED_MARKER)):
             return None
+        # warm path first: a standby that tailed this worker holds
+        # (verified-on-finalize) local bytes — zero-transfer failover.
+        # Consulted even for a quarantined partition: the quarantine
+        # indicts the SOURCE's ship, not the standby's already-landed
+        # digest-checked copy (a finalize failure falls through to the
+        # quarantine refusal below).
+        warm = self._standby_partition(wid)
+        if warm is not None:
+            return warm
         if wid in self._ship_quarantine:
             # a prior ship failed for a SOURCE reason (digest never
             # verifies, agent refuses the dir) — don't re-pull a
@@ -281,7 +296,13 @@ class NetCluster(FleetCluster):
         root = os.path.abspath(os.path.expanduser(root))
         ledger: list[dict] = []
         seen: set = set()
-        for base in (root, os.path.join(root, SHIPPED_DIR)):
+        for base in (
+            root,
+            os.path.join(root, SHIPPED_DIR),
+            # a failover completed FROM a warm standby tail writes its
+            # marker into the replica home — the third marker home
+            os.path.join(root, REPLICA_DIR),
+        ):
             if not os.path.isdir(base):
                 continue
             for name in sorted(os.listdir(base)):
@@ -413,6 +434,12 @@ class NetCluster(FleetCluster):
             "ship_chunks": s.ship_chunks,
             "ship_resumes": s.ship_resumes,
             "ship_ms": round(self.ship_ms, 3),
+            # warm-standby evidence: bytes moved ON the failover path
+            # (0 for a caught-up tail) and how many fetches the warm
+            # path answered instead of a ship
+            "failover_path_bytes": self.failover_path_bytes,
+            "standby_fetches": self.standby_fetches,
+            "standbys": len(self._standbys),
         }
 
     # ------------------------------------------------------ lifecycle

@@ -500,6 +500,21 @@ def replay_ship_log(dest: str) -> _ShipProgress:
             prog.offsets[meta["f"]] = 0
         elif t == "ship_file":
             prog.done_files.add(meta["f"])
+        elif t == "ship_remanifest":
+            # the SOURCE was alive and changed shape under a tail
+            # (har_tpu.serve.net.tail): a snapshot rotated the segment
+            # set.  Adopt the new manifest and forget progress on the
+            # files it dropped — offsets for surviving files stand,
+            # which is what makes the tail resume without re-pulling a
+            # durable byte.
+            prog.manifest = meta.get("files")
+            keep = {e["f"] for e in prog.manifest or []}
+            prog.offsets = {
+                f: o for f, o in prog.offsets.items() if f in keep
+            }
+            prog.done_files = {
+                f for f in prog.done_files if f in keep
+            }
         elif t == "ship_done":
             prog.done = True
     return prog
@@ -724,11 +739,56 @@ def build_parser() -> argparse.ArgumentParser:
                          "ship stage boundary (mid_ship_send) — a REAL "
                          "sender-host death mid-transfer")
     ap.add_argument("--chaos-at", type=int, default=1)
+    ap.add_argument("--follow", action="append", default=[],
+                    metavar="WID=HOST:PORT",
+                    help="tail-follow a live worker's journal from its "
+                         "ship agent and keep a warm replica (repeat "
+                         "per source); turns this agent into a standby "
+                         "whose staged copies are themselves shippable")
+    ap.add_argument("--cycle-s", type=float, default=0.5,
+                    help="standby tail cadence (with --follow)")
     return ap
+
+
+def _parse_follow(specs):
+    follows = {}
+    for spec in specs:
+        try:
+            wid, addr = spec.split("=", 1)
+            host, port = addr.rsplit(":", 1)
+            follows[wid] = (host, int(port))
+        except ValueError:
+            raise SystemExit(
+                f"--follow wants WID=HOST:PORT, got {spec!r}"
+            )
+    return follows
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.follow:
+        # standby mode replays records through the fleet engine; the
+        # import stays behind the flag so a plain agent remains
+        # engine-free
+        from har_tpu.serve.replica import StandbyHost
+
+        host = StandbyHost(
+            args.root, _parse_follow(args.follow), host=args.host,
+            port=args.port, cycle_s=args.cycle_s,
+        )
+        print(
+            json.dumps(
+                {
+                    "host": host.agent.rpc.host,
+                    "port": host.agent.rpc.port,
+                    "pid": os.getpid(),
+                    "root": host.agent.root,
+                    "follows": sorted(_parse_follow(args.follow)),
+                }
+            ),
+            flush=True,
+        )
+        return host.serve_forever(max_idle_s=args.max_idle_s)
     chaos = None
     if args.chaos_point:
         from har_tpu.serve.net.worker import _HardKillPlan

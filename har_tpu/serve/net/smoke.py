@@ -54,6 +54,7 @@ from har_tpu.serve.net.controller import NetCluster, launch_workers
 def _run_wire_failover(
     sessions: int, workers: int, seed: int, n_samples: int,
     window: int = 100, hop: int = 50, private: bool = False,
+    replicated: bool = False,
 ) -> dict:
     """One measured wire-failover run: drive, kill the victim process
     once windows are flowing, let the protocol finish, verdict.
@@ -64,10 +65,19 @@ def _run_wire_failover(
     from the host's agent (``har_tpu.serve.net.ship``) — the
     ``journal_ship_smoke`` / bench-lane configuration.  ``False``
     keeps the single-box shared-disk restore, which doubles as the
-    bench lane's baseline."""
+    bench lane's baseline.
+
+    ``replicated=True`` (implies private) registers a warm standby
+    that tail-follows every worker's agent from the controller's poll
+    loop (``har_tpu.serve.replica``): the kill must then fail over
+    from the standby's already-verified local bytes — the verdict
+    additionally demands ``failover_path_bytes == 0`` (zero journal
+    bytes moved AFTER the death) and at least one standby-sourced
+    fetch."""
     from har_tpu.serve.chaos import _recordings
     from har_tpu.serve.loadgen import AnalyticDemoModel
 
+    private = private or replicated
     model = AnalyticDemoModel()
     victim = predicted_owner(0, workers)
     root = tempfile.mkdtemp(prefix="har_wire_smoke_")
@@ -102,12 +112,33 @@ def _run_wire_failover(
         )
         for i in range(sessions):
             cluster.add_session(i)
+        if replicated:
+            from har_tpu.serve.net.controller import REPLICA_DIR
+            from har_tpu.serve.replica import StandbyAgent
+
+            # in-controller standby over the agents' ship RPCs; its
+            # transfer counters land on the cluster's net_stats so the
+            # steady-state tail traffic is measured alongside the rest
+            cluster.register_standby(
+                StandbyAgent(
+                    os.path.join(root, REPLICA_DIR),
+                    {wid: h.client() for wid, h in handles.items()},
+                    loader=lambda ver: model,
+                    chunk_bytes=_MATRIX_CHUNK_BYTES,
+                    stats=cluster.net_stats,
+                )
+            )
         recordings = _recordings(sessions, n_samples, 3, seed)
         events: list = []
         balance_log: list = []
         killed = {"t": None}
+        lag = {"last": 0, "at_kill": None}
 
         def on_round(c):
+            if replicated:
+                lag["last"] = sum(
+                    c.net_stats.replication_lag_records.values()
+                )
             if killed["t"] is None:
                 try:
                     scored = c.accounting()["scored"]
@@ -116,6 +147,7 @@ def _run_wire_failover(
                 if scored > 0:
                     procs[victim].kill()  # a real SIGKILL
                     killed["t"] = time.perf_counter()
+                    lag["at_kill"] = lag["last"]
                 return
             _safe_accounting(c, balance_log)
 
@@ -151,6 +183,18 @@ def _run_wire_failover(
                 "failover completed without shipping any journal "
                 "bytes — the shared-nothing path was bypassed"
             )
+        if why is None and replicated:
+            if rpc["standby_fetches"] < 1:
+                why = (
+                    "failover never sourced the partition from the "
+                    "warm standby"
+                )
+            elif rpc["failover_path_bytes"] != 0:
+                why = (
+                    f"warm failover moved {rpc['failover_path_bytes']} "
+                    "journal byte(s) after the death; a caught-up "
+                    "standby must transfer zero"
+                )
         out = {
             "ok": why is None,
             "why": why,
@@ -175,7 +219,15 @@ def _run_wire_failover(
             ),
             "windows_lost": max(expected - len(keys), 0),
             "private_dirs": bool(private),
+            "replicated": bool(replicated),
             "ship_ms": rpc["ship_ms"],
+            "failover_path_bytes": rpc["failover_path_bytes"],
+            "standby_fetches": rpc["standby_fetches"],
+            "standbys": rpc["standbys"],
+            "steady_lag_records": int(lag["last"]),
+            "lag_records_at_kill": (
+                None if lag["at_kill"] is None else int(lag["at_kill"])
+            ),
             "rpc": rpc,
         }
         cluster.shutdown_workers()
@@ -242,6 +294,36 @@ def journal_ship_smoke(
     }
 
 
+def replication_smoke(
+    sessions: int = 18, workers: int = 3, seed: int = 0
+) -> dict:
+    """Gate verdict for CONTINUOUS REPLICATION (the warm-standby
+    tentpole): the journal-ship fleet with one standby tail-following
+    every worker's agent, one worker SIGKILLed mid-dispatch — and the
+    failover must come from the standby's already-local, already-
+    verified bytes: ``failover_path_bytes == 0`` (the ship leaves the
+    failover path entirely), with the same exactly-once + conservation
+    verdict as every other wire smoke.  The stamp carries ``{standbys,
+    lag_records_at_kill, failover_path_bytes, failover_ms,
+    windows_lost}`` (keys pinned by tests/test_release_gate.py)."""
+    out = _run_wire_failover(
+        sessions, workers, seed, n_samples=300, replicated=True
+    )
+    return {
+        "ok": out["ok"],
+        "why": out["why"],
+        "sessions": out["sessions"],
+        "workers": out["workers"],
+        "transport": out["transport"],
+        "standbys": out["standbys"],
+        "standby_fetches": out["standby_fetches"],
+        "lag_records_at_kill": out["lag_records_at_kill"],
+        "failover_path_bytes": out["failover_path_bytes"],
+        "failover_ms": out["failover_ms"],
+        "windows_lost": out["windows_lost"],
+    }
+
+
 def journal_ship_benchmark(
     session_counts,
     n_runs: int = 3,
@@ -258,11 +340,18 @@ def journal_ship_benchmark(
     crossing the process boundary with the recovery currency is a
     measured delta, not an assumption.  ``contract_ok`` pins the
     exactly-once + complete-delivery + conservation verdict on every
-    measured run of BOTH modes."""
+    measured run of ALL modes.
+
+    The REPLICATED arm rides in the same lane: the identical kill
+    with a warm standby tailing every worker, where the failover path
+    moves zero journal bytes (``replicated_failover_path_bytes``) —
+    its ``replicated_failover_ms_median`` against ``failover_ms_median``
+    is the headline number continuous replication buys."""
     rows = []
     for n_sessions in session_counts:
-        ship_ms, failover_ms, base_ms = [], [], []
+        ship_ms, failover_ms, base_ms, repl_ms = [], [], [], []
         shipped_bytes, chunks, ok = 0, 0, True
+        repl_path_bytes, repl_lag = 0, 0
         for r in range(int(n_runs)):
             shipped = _run_wire_failover(
                 int(n_sessions), workers, seed + r, n_samples,
@@ -272,12 +361,19 @@ def journal_ship_benchmark(
                 int(n_sessions), workers, seed + r, n_samples,
                 private=False,
             )
-            ok = ok and shipped["ok"] and base["ok"]
+            repl = _run_wire_failover(
+                int(n_sessions), workers, seed + r, n_samples,
+                replicated=True,
+            )
+            ok = ok and shipped["ok"] and base["ok"] and repl["ok"]
             ship_ms.append(shipped["rpc"]["ship_ms"])
             failover_ms.append(shipped["failover_ms"])
             base_ms.append(base["failover_ms"])
+            repl_ms.append(repl["failover_ms"])
             shipped_bytes = shipped["rpc"]["shipped_bytes"]
             chunks = shipped["rpc"]["ship_chunks"]
+            repl_path_bytes = repl["failover_path_bytes"]
+            repl_lag = repl["steady_lag_records"]
         rows.append(
             {
                 "n_sessions": int(n_sessions),
@@ -291,6 +387,11 @@ def journal_ship_benchmark(
                 "baseline_failover_ms_median": round(
                     float(np.median(base_ms)), 3
                 ),
+                "replicated_failover_ms_median": round(
+                    float(np.median(repl_ms)), 3
+                ),
+                "replicated_failover_path_bytes": int(repl_path_bytes),
+                "replicated_steady_lag_records": int(repl_lag),
                 "shipped_bytes": int(shipped_bytes),
                 "chunks": int(chunks),
                 "contract_ok": ok,

@@ -130,6 +130,188 @@ def _consume_drop(server, sess, ti, reason):
     )
 
 
+def apply_record(server, meta, payload) -> None:
+    """Apply ONE journal record to a replaying server — the record
+    dispatch shared by crash recovery (``restore_server``'s suffix
+    replay) and continuous replication (``har_tpu.serve.replica``'s
+    warm standby, which feeds tailed records through this exact body
+    as they arrive).  The caller owns the ``server._replaying`` guard;
+    this function only interprets records.  Unknown record types are
+    skipped: a newer writer's extra records must not brick an older
+    reader (harlint HL003 pins the writer↔handler bijection)."""
+    channels = server.channels
+    t = meta.get("t")
+    if t == "push":
+        n = int(meta["n"])
+        samples = np.frombuffer(payload, np.float32).reshape(
+            n, channels
+        )
+        server.push(meta["sid"], samples)
+        # the record's samples are post-guard: re-align the raw
+        # transport watermark with the rows the guard rejected
+        rejected = int(meta.get("rn", n)) - n
+        if rejected:
+            server._sessions[meta["sid"]].raw_seen += rejected
+            server.stats.rejected_samples += rejected
+    elif t == "ack":
+        sess = server._sessions.get(meta["sid"])
+        if sess is None:
+            raise RecoveryError(
+                f"ack for unknown session {meta['sid']!r}"
+            )
+        _consume_ack(
+            server, sess, int(meta["ti"]), meta.get("ver", "v0"),
+            bool(meta.get("shed")),
+            np.frombuffer(payload, np.float64),
+        )
+    elif t == "acks":
+        # group-committed acks (one record per retire): the
+        # entries ride in the retire loop's emit order, so
+        # replaying them through the same per-event
+        # _consume_ack sequence re-steps each smoother
+        # bit-identically to a per-record `ack` log.  The
+        # per-record handler above stays — old and mixed logs
+        # replay without migration.  Each entry's t_index is
+        # NOT stored (the push records already determine it:
+        # it's the session's oldest live pending); the record
+        # carries one crc32 over the expected int64 column
+        # ("tic") so a journal that diverged from the engine's
+        # ack order still refuses to recover, at 4 bytes per
+        # RECORD instead of 8 per entry.
+        n = int(meta["n"])
+        ver = meta.get("ver", "v0")
+        a_shed = bool(meta.get("shed"))
+        rows = np.frombuffer(payload, np.float64).reshape(n, -1)
+        pq = server._pending
+        tis = np.empty(n, np.int64)
+        for j, (sid, row) in enumerate(
+            zip(meta["sids"], rows)
+        ):
+            sess = server._sessions.get(sid)
+            if sess is None:
+                raise RecoveryError(
+                    f"ack for unknown session {sid!r}"
+                )
+            p = _oldest_live(server, sess)
+            if p is None:
+                raise RecoveryError(
+                    f"ack for session {sid!r} but no window "
+                    "was recovered pending — a window would "
+                    "be double-scored; refusing to recover "
+                    "from this journal"
+                )
+            tis[j] = int(pq.t_index[p])
+            _consume_ack(
+                server, sess, int(tis[j]), ver, a_shed, row
+            )
+        crc = zlib.crc32(tis.tobytes()) & 0xFFFFFFFF
+        if int(meta.get("tic", crc)) != crc:
+            raise RecoveryError(
+                "acks record t_index checksum mismatch "
+                f"(recorded {meta['tic']}, replayed {crc}) — "
+                "the journal's ack order diverged from the "
+                "recovered pending queue; refusing to recover"
+            )
+    elif t == "drop":
+        sess = server._sessions.get(meta["sid"])
+        if sess is None:
+            raise RecoveryError(
+                f"drop for unknown session {meta['sid']!r}"
+            )
+        _consume_drop(
+            server, sess, int(meta["ti"]), meta.get("reason", "?")
+        )
+    elif t == "add":
+        server.add_session(
+            meta["sid"],
+            monitor=monitor_from_state(meta.get("mon")),
+        )
+    elif t == "remove":
+        server.remove_session(meta["sid"])
+    elif t == "swap":
+        server.model_version = meta["ver"]
+        server.stats.model_swaps += 1
+        server._device_ms.clear()
+    elif t == "resize":
+        # elastic capacity resize (FleetServer.resize): the
+        # schedule knobs replay exactly; the mesh OBJECT is a
+        # runtime resource — recovery shards onto whatever mesh
+        # restore_server was given, same stance as the model
+        server.config = dataclasses.replace(
+            server.config,
+            target_batch=int(meta["tb"]),
+            pipeline_depth=int(meta["depth"]),
+        )
+        server.stats.resizes += 1
+        if int(meta.get("dir", 0)) > 0:
+            server.stats.scale_ups += 1
+        elif int(meta.get("dir", 0)) < 0:
+            server.stats.scale_downs += 1
+    elif t == "disc":
+        # graceful disconnect, flush half: re-derive the final
+        # partial window from the recovered ring — bit-identical
+        # by construction (same _flush_partial, same ring); the
+        # following ack then consumes it like any other window
+        sess = server._sessions.get(meta["sid"])
+        if sess is None:
+            raise RecoveryError(
+                f"disc record for unknown session {meta['sid']!r}"
+            )
+        server._flush_partial(sess)
+    elif t == "shed":
+        on = bool(meta.get("on"))
+        if on and not server._smoothing_shed:
+            server.stats.smoothing_shed_transitions += 1
+        server._smoothing_shed = on
+    elif t == "adopt":
+        # cluster hand-off, receiving half: rebuild the migrated
+        # session from the record's full state payload (ring
+        # float32, then the EMA float64 when meta["ema"]) —
+        # the same adopt_session path the live migration ran.
+        # The stored `handoffs` already counts this adoption;
+        # adopt_session re-bumps, so hand it the predecessor's.
+        window = server.window
+        ring_bytes = window * channels * 4
+        ema = None
+        if meta.get("ema"):
+            ema = np.frombuffer(payload[ring_bytes:], np.float64)
+        server.adopt_session(
+            {
+                "sid": meta["sid"],
+                "ring": np.frombuffer(
+                    payload[:ring_bytes], np.float32
+                ).reshape(window, channels),
+                "n_seen": meta["n_seen"],
+                "raw_seen": meta["raw_seen"],
+                "next_emit": meta["next_emit"],
+                "n_enqueued": meta.get("n_enqueued", 0),
+                "n_scored": meta.get("n_scored", 0),
+                "n_dropped": meta.get("n_dropped", 0),
+                "handoffs": int(meta.get("handoffs", 1)) - 1,
+                "votes": meta.get("votes") or [],
+                "ema": ema,
+                "monitor": meta.get("mon"),
+            }
+        )
+    elif t == "handoff":
+        # cluster hand-off, source half: the session moved to
+        # another worker — evict without dropping (the drain
+        # guarantee re-derives: replay reaches this record with
+        # the session's queue empty, or the journal is corrupt)
+        if meta["sid"] not in server._sessions:
+            raise RecoveryError(
+                f"handoff record for unknown session "
+                f"{meta['sid']!r}"
+            )
+        server._apply_handoff(meta["sid"])
+    elif t == "lost":
+        server.declare_lost(meta["sid"], int(meta["pos"]))
+    elif t == "adapt":
+        server.recovered_adapt_records.append(meta)
+    # unknown record types are skipped: a newer writer's extra
+    # records must not brick an older reader
+
+
 def restore_server(
     journal_dir: str,
     model,
@@ -139,6 +321,7 @@ def restore_server(
     journal_config: JournalConfig | None = None,
     reattach: bool = True,
     mesh=None,
+    inflight_ship_ok: bool = False,
 ):
     """Rebuild a FleetServer from its journal directory.
 
@@ -163,7 +346,9 @@ def restore_server(
     """
     from har_tpu.serve.engine import FleetConfig, FleetServer
 
-    state, arrays, records = load_journal(journal_dir)
+    state, arrays, records = load_journal(
+        journal_dir, inflight_ship_ok=inflight_ship_ok
+    )
     geo = state.get("geometry")
     if not geo:
         raise JournalError("snapshot lacks the geometry block")
@@ -238,178 +423,8 @@ def restore_server(
         server.recovered_adapt_records = []
 
         # ---- replay the journal suffix ---------------------------------
-        channels = geo["channels"]
         for meta, payload in records:
-            t = meta.get("t")
-            if t == "push":
-                n = int(meta["n"])
-                samples = np.frombuffer(payload, np.float32).reshape(
-                    n, channels
-                )
-                server.push(meta["sid"], samples)
-                # the record's samples are post-guard: re-align the raw
-                # transport watermark with the rows the guard rejected
-                rejected = int(meta.get("rn", n)) - n
-                if rejected:
-                    server._sessions[meta["sid"]].raw_seen += rejected
-                    server.stats.rejected_samples += rejected
-            elif t == "ack":
-                sess = server._sessions.get(meta["sid"])
-                if sess is None:
-                    raise RecoveryError(
-                        f"ack for unknown session {meta['sid']!r}"
-                    )
-                _consume_ack(
-                    server, sess, int(meta["ti"]), meta.get("ver", "v0"),
-                    bool(meta.get("shed")),
-                    np.frombuffer(payload, np.float64),
-                )
-            elif t == "acks":
-                # group-committed acks (one record per retire): the
-                # entries ride in the retire loop's emit order, so
-                # replaying them through the same per-event
-                # _consume_ack sequence re-steps each smoother
-                # bit-identically to a per-record `ack` log.  The
-                # per-record handler above stays — old and mixed logs
-                # replay without migration.  Each entry's t_index is
-                # NOT stored (the push records already determine it:
-                # it's the session's oldest live pending); the record
-                # carries one crc32 over the expected int64 column
-                # ("tic") so a journal that diverged from the engine's
-                # ack order still refuses to recover, at 4 bytes per
-                # RECORD instead of 8 per entry.
-                n = int(meta["n"])
-                ver = meta.get("ver", "v0")
-                a_shed = bool(meta.get("shed"))
-                rows = np.frombuffer(payload, np.float64).reshape(n, -1)
-                pq = server._pending
-                tis = np.empty(n, np.int64)
-                for j, (sid, row) in enumerate(
-                    zip(meta["sids"], rows)
-                ):
-                    sess = server._sessions.get(sid)
-                    if sess is None:
-                        raise RecoveryError(
-                            f"ack for unknown session {sid!r}"
-                        )
-                    p = _oldest_live(server, sess)
-                    if p is None:
-                        raise RecoveryError(
-                            f"ack for session {sid!r} but no window "
-                            "was recovered pending — a window would "
-                            "be double-scored; refusing to recover "
-                            "from this journal"
-                        )
-                    tis[j] = int(pq.t_index[p])
-                    _consume_ack(
-                        server, sess, int(tis[j]), ver, a_shed, row
-                    )
-                crc = zlib.crc32(tis.tobytes()) & 0xFFFFFFFF
-                if int(meta.get("tic", crc)) != crc:
-                    raise RecoveryError(
-                        "acks record t_index checksum mismatch "
-                        f"(recorded {meta['tic']}, replayed {crc}) — "
-                        "the journal's ack order diverged from the "
-                        "recovered pending queue; refusing to recover"
-                    )
-            elif t == "drop":
-                sess = server._sessions.get(meta["sid"])
-                if sess is None:
-                    raise RecoveryError(
-                        f"drop for unknown session {meta['sid']!r}"
-                    )
-                _consume_drop(
-                    server, sess, int(meta["ti"]), meta.get("reason", "?")
-                )
-            elif t == "add":
-                server.add_session(
-                    meta["sid"],
-                    monitor=monitor_from_state(meta.get("mon")),
-                )
-            elif t == "remove":
-                server.remove_session(meta["sid"])
-            elif t == "swap":
-                server.model_version = meta["ver"]
-                server.stats.model_swaps += 1
-                server._device_ms.clear()
-            elif t == "resize":
-                # elastic capacity resize (FleetServer.resize): the
-                # schedule knobs replay exactly; the mesh OBJECT is a
-                # runtime resource — recovery shards onto whatever mesh
-                # restore_server was given, same stance as the model
-                server.config = dataclasses.replace(
-                    server.config,
-                    target_batch=int(meta["tb"]),
-                    pipeline_depth=int(meta["depth"]),
-                )
-                server.stats.resizes += 1
-                if int(meta.get("dir", 0)) > 0:
-                    server.stats.scale_ups += 1
-                elif int(meta.get("dir", 0)) < 0:
-                    server.stats.scale_downs += 1
-            elif t == "disc":
-                # graceful disconnect, flush half: re-derive the final
-                # partial window from the recovered ring — bit-identical
-                # by construction (same _flush_partial, same ring); the
-                # following ack then consumes it like any other window
-                sess = server._sessions.get(meta["sid"])
-                if sess is None:
-                    raise RecoveryError(
-                        f"disc record for unknown session {meta['sid']!r}"
-                    )
-                server._flush_partial(sess)
-            elif t == "shed":
-                on = bool(meta.get("on"))
-                if on and not server._smoothing_shed:
-                    server.stats.smoothing_shed_transitions += 1
-                server._smoothing_shed = on
-            elif t == "adopt":
-                # cluster hand-off, receiving half: rebuild the migrated
-                # session from the record's full state payload (ring
-                # float32, then the EMA float64 when meta["ema"]) —
-                # the same adopt_session path the live migration ran.
-                # The stored `handoffs` already counts this adoption;
-                # adopt_session re-bumps, so hand it the predecessor's.
-                window = geo["window"]
-                ring_bytes = window * channels * 4
-                ema = None
-                if meta.get("ema"):
-                    ema = np.frombuffer(payload[ring_bytes:], np.float64)
-                server.adopt_session(
-                    {
-                        "sid": meta["sid"],
-                        "ring": np.frombuffer(
-                            payload[:ring_bytes], np.float32
-                        ).reshape(window, channels),
-                        "n_seen": meta["n_seen"],
-                        "raw_seen": meta["raw_seen"],
-                        "next_emit": meta["next_emit"],
-                        "n_enqueued": meta.get("n_enqueued", 0),
-                        "n_scored": meta.get("n_scored", 0),
-                        "n_dropped": meta.get("n_dropped", 0),
-                        "handoffs": int(meta.get("handoffs", 1)) - 1,
-                        "votes": meta.get("votes") or [],
-                        "ema": ema,
-                        "monitor": meta.get("mon"),
-                    }
-                )
-            elif t == "handoff":
-                # cluster hand-off, source half: the session moved to
-                # another worker — evict without dropping (the drain
-                # guarantee re-derives: replay reaches this record with
-                # the session's queue empty, or the journal is corrupt)
-                if meta["sid"] not in server._sessions:
-                    raise RecoveryError(
-                        f"handoff record for unknown session "
-                        f"{meta['sid']!r}"
-                    )
-                server._apply_handoff(meta["sid"])
-            elif t == "lost":
-                server.declare_lost(meta["sid"], int(meta["pos"]))
-            elif t == "adapt":
-                server.recovered_adapt_records.append(meta)
-            # unknown record types are skipped: a newer writer's extra
-            # records must not brick an older reader
+            apply_record(server, meta, payload)
     finally:
         server._replaying = False
 

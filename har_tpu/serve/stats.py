@@ -281,6 +281,13 @@ class FleetStats:
         self.arena_bytes = 0  # harlint: ephemeral
         self.staging_bytes = 0  # harlint: ephemeral
         self.pending_bytes = 0  # harlint: ephemeral
+        # continuous replication (har_tpu.serve.replica): per-source
+        # tail lag — records the last standby cycle found staged but
+        # not yet applied, and manifest bytes not yet landed locally.
+        # Recomputed by every cycle (and from the tailed files after a
+        # standby restart), never snapshot state
+        self.replication_lag_records: dict = {}  # harlint: ephemeral
+        self.replication_lag_bytes: dict = {}  # harlint: ephemeral
         # wire transport (har_tpu.serve.net): RPC round trips issued,
         # deadline-exceeded re-attempts, and bytes moved each way —
         # the comms/serialization term the Spark-perf study says
@@ -442,6 +449,10 @@ class FleetStats:
             "arena_bytes": self.arena_bytes,
             "staging_bytes": self.staging_bytes,
             "pending_bytes": self.pending_bytes,
+            "replication_lag_records": dict(
+                self.replication_lag_records
+            ),
+            "replication_lag_bytes": dict(self.replication_lag_bytes),
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
             "fused_dispatches": self.fused_dispatches,

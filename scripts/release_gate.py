@@ -277,6 +277,20 @@ def _journal_ship_smoke() -> dict:
     return _run_smoke("har_tpu.serve.net.smoke", "journal_ship_smoke")
 
 
+def _replication_smoke() -> dict:
+    """Continuous-replication smoke verdict (PR 17, har_tpu.serve.
+    replica): the journal-ship fleet with one warm standby
+    tail-following every worker's agent from the controller's poll
+    loop, one worker SIGKILLed mid-dispatch — and the failover must
+    come from the standby's already-local, already-verified bytes:
+    zero journal bytes on the failover path (``failover_path_bytes ==
+    0`` — the ship leaves the failover path entirely), same
+    exactly-once + conservation verdict; the stamp carries
+    ``{standbys, lag_records_at_kill, failover_path_bytes,
+    failover_ms, windows_lost}``."""
+    return _run_smoke("har_tpu.serve.net.smoke", "replication_smoke")
+
+
 def _wire_ingest_smoke() -> dict:
     """Ingest front-door smoke verdict (PR 16, har_tpu.serve.net.
     gateway): an elastic-traffic swing driven through a REAL gateway
@@ -428,6 +442,7 @@ def main(argv=None) -> int:
     wire = None
     ship = None
     ingest = None
+    replication = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -446,6 +461,7 @@ def main(argv=None) -> int:
             wire = prior.get("wire_failover")
             ship = prior.get("journal_ship")
             ingest = prior.get("wire_ingest")
+            replication = prior.get("replication")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -458,6 +474,7 @@ def main(argv=None) -> int:
             wire = None
             ship = None
             ingest = None
+            replication = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -607,6 +624,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # replication gate: the journal-ship fleet plus a warm standby
+        # tailing every worker — the same kill must fail over from the
+        # standby's already-verified local bytes with ZERO journal
+        # bytes on the failover path, stamping {standbys,
+        # lag_records_at_kill, failover_path_bytes, failover_ms,
+        # windows_lost}
+        replication = _replication_smoke()
+        if not replication.get("ok"):
+            print(
+                "\nrelease_gate: RED replication smoke "
+                f"({json.dumps(replication)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -627,6 +658,7 @@ def main(argv=None) -> int:
                 "wire_failover": wire,
                 "journal_ship": ship,
                 "wire_ingest": ingest,
+                "replication": replication,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -667,6 +699,9 @@ def main(argv=None) -> int:
                 ),
                 "wire_ingest_ok": (
                     None if ingest is None else ingest["ok"]
+                ),
+                "replication_ok": (
+                    None if replication is None else replication["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

@@ -245,6 +245,28 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == ship["ship_ms_median"]
         )
         assert extra["journal_ship_contract_ok"] is True
+        # r21 replicated arm of the same lane: a warm standby tails
+        # the workers continuously, so the failover path ships ZERO
+        # bytes and must beat the PR-14 ship-at-failover arm at every
+        # measured session count — the flat keys mirror the lane
+        assert ship["replicated_failover_ms_median"] > 0
+        assert ship["replicated_failover_path_bytes"] == 0
+        assert ship["replicated_steady_lag_records"] >= 0
+        for row in ship["rows"]:
+            assert row["replicated_failover_path_bytes"] == 0
+            assert (
+                row["replicated_failover_ms_median"]
+                < row["failover_ms_median"]
+            )
+        assert (
+            extra["replicated_failover_ms_median"]
+            == ship["replicated_failover_ms_median"]
+        )
+        assert extra["replicated_failover_path_bytes"] == 0
+        assert (
+            extra["replicated_steady_lag_records"]
+            == ship["replicated_steady_lag_records"]
+        )
     # r20 wire-ingest lane: the elastic swing through the gateway
     # front door (batched push_many frames, edge admission, group-
     # commit acks) vs the same trace in-process — contract_ok pins

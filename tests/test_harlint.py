@@ -411,6 +411,7 @@ def test_hl003_acceptance_real_recover_minus_lost_handler():
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -441,6 +442,7 @@ def test_hl003_acceptance_cluster_handoff_handler_and_kill_points():
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -510,6 +512,7 @@ def test_hl003_acceptance_ship_records_and_ship_kill_points():
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -565,6 +568,7 @@ def test_hl003_acceptance_acks_handler_and_retirement_pins():
         "har_tpu/serve/journal.py",
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -633,6 +637,64 @@ def test_hl003_acceptance_acks_handler_and_retirement_pins():
         for f in lint_sources(mutated4, [JournalExhaustivenessRule()])
     )
     assert "retired record type 'ack' has no replay handler" in msgs4
+
+
+def test_hl003_acceptance_tail_records_and_tail_kill_points():
+    """The replication extension of the acceptance mutation: the tail
+    client (net/tail.py) writes into the SAME ship-log record family
+    (including the rotation's ``ship_remanifest``) and declares
+    TAIL_KILL_POINTS — deleting the remanifest replay handler from the
+    REAL ship.py, or dropping ``mid_tail_recv`` from the declared tail
+    matrix, must each fail the gate."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JournalExhaustivenessRule()]) == []
+    # (1) deleting the ship_remanifest replay handler orphans the
+    # record the tail fsyncs at every source rotation — a restarted
+    # standby would resume against the WRONG manifest and pull a
+    # chimera of two journal generations
+    mutated = dict(sources)
+    mutated["har_tpu/serve/net/ship.py"] = sources[
+        "har_tpu/serve/net/ship.py"
+    ].replace(
+        'elif t == "ship_remanifest":', 'elif t == "__deleted__":'
+    )
+    assert (
+        mutated["har_tpu/serve/net/ship.py"]
+        != sources["har_tpu/serve/net/ship.py"]
+    )
+    msgs = " | ".join(
+        f.message
+        for f in lint_sources(mutated, [JournalExhaustivenessRule()])
+    )
+    assert "'ship_remanifest'" in msgs and "no replay handler" in msgs
+    assert "'__deleted__'" in msgs
+    # (2) dropping mid_tail_recv from the declared tail matrix leaves
+    # the standby's between-chunks kill site un-exercised — flagged
+    mutated2 = dict(sources)
+    mutated2["har_tpu/serve/chaos.py"] = sources[
+        "har_tpu/serve/chaos.py"
+    ].replace('    "mid_tail_recv",\n', "")
+    assert (
+        mutated2["har_tpu/serve/chaos.py"]
+        != sources["har_tpu/serve/chaos.py"]
+    )
+    msgs2 = " | ".join(
+        f.message
+        for f in lint_sources(mutated2, [JournalExhaustivenessRule()])
+    )
+    assert "'mid_tail_recv'" in msgs2
+    assert "absent from the chaos matrix" in msgs2
 
 
 # --------------------------------------------------------------- HL004

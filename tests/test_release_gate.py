@@ -277,6 +277,42 @@ def test_gate_log_carries_wire_ingest_verdict():
     assert ingest["ack_coalesce_ratio"] <= 0.5
 
 
+def test_gate_log_carries_replication_verdict():
+    """The warm-standby counterpart of the journal-ship verdict
+    (har_tpu.serve.replica): the gate log must carry a green
+    replication check with the {standbys, lag_records_at_kill,
+    failover_path_bytes, failover_ms, windows_lost} stamp — three
+    subprocess workers continuously tailed by an in-controller
+    standby, one SIGKILLed mid-dispatch, the partition restored from
+    the standby's already-local bytes.  ``failover_path_bytes == 0``
+    IS the tentpole claim: a caught-up tail moves ship_ms off the
+    failover path entirely."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    replication = log.get("replication")
+    assert replication, (
+        "artifacts/test_gate.json lacks the replication verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "standbys",
+        "standby_fetches",
+        "lag_records_at_kill",
+        "failover_path_bytes",
+        "failover_ms",
+        "windows_lost",
+    ):
+        assert key in replication
+    assert replication["ok"] is True
+    assert replication["transport"] == "tcp"
+    assert replication["windows_lost"] == 0
+    assert replication["standbys"] >= 1
+    assert replication["standby_fetches"] >= 1
+    assert replication["failover_path_bytes"] == 0
+    assert replication["failover_ms"] >= 0
+
+
 def test_gate_log_carries_elastic_smoke_verdict():
     """The elastic counterpart of the cluster verdict: the gate log
     must carry a green elastic-traffic check with the {swing, resizes,

@@ -361,13 +361,22 @@ def test_ship_log_records_pinned_against_their_handlers():
     the source level like the wire codec fuzz pins recover.py: every
     ``ship_journal.append({"t": ...})`` type has a ``t == "..."``
     branch in the resume replay, and vice versa (harlint HL003 checks
-    the same sets statically)."""
-    src = (REPO / "har_tpu" / "serve" / "net" / "ship.py").read_text()
+    the same sets statically).  The replication tail (net/tail.py)
+    writes into the SAME log family — its records replay through the
+    same resume loop, so its writers join the pinned set."""
+    net = REPO / "har_tpu" / "serve" / "net"
+    src = (net / "ship.py").read_text()
     written = set(re.findall(r'append\(\s*\{"t": "(ship_\w+)"', src))
+    written |= set(
+        re.findall(
+            r'append\(\s*\{"t": "(ship_\w+)"',
+            (net / "tail.py").read_text(),
+        )
+    )
     handled = set(re.findall(r't == "(ship_\w+)"', src))
     assert written == handled == {
         "ship_begin", "ship_chunk", "ship_void", "ship_file",
-        "ship_done",
+        "ship_done", "ship_remanifest",
     }
 
 
@@ -664,6 +673,7 @@ def test_failover_parks_when_agent_down_and_resumes_on_restart(
     cluster.net_stats = FleetStats()
     cluster._agents = {"w0": dead_client}
     cluster._ship_quarantine = {}
+    cluster._standbys = {}
     cluster._ship_chunk_bytes = 1024
     cluster.ship_ms = 0.0
     cluster.ship_transfers = []
@@ -765,6 +775,7 @@ def test_corrupt_source_quarantines_not_crash_loops(tmp_path):
     cluster.net_stats = FleetStats()
     cluster._agents = {"w0": ShipClient(srv.srv.host, srv.srv.port)}
     cluster._ship_quarantine = {}
+    cluster._standbys = {}
     cluster._ship_chunk_bytes = 1024
     cluster.ship_ms = 0.0
     cluster.ship_transfers = []

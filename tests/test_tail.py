@@ -1,0 +1,630 @@
+"""Continuous journal replication (har_tpu.serve.net.tail +
+har_tpu.serve.replica): warm standbys and zero-transfer failover.
+
+The load-bearing claims, all pinned here:
+
+  - the PR-14 ship protocol works pointed at a MOVING target: a
+    standby tails a LIVE worker's journal (immutable files landed
+    whole, the active segment pulled as a growing suffix) and keeps a
+    warm in-memory replica current by replaying only the new bytes;
+  - a source snapshot/rotation is survived, not special-cased: the
+    tail re-manifests at the new base and the replica rebuilds from
+    the newest tailed snapshot (``ship_remanifest`` is durable in the
+    same ship log, so a standby restart re-founds correctly);
+  - failover against a caught-up standby transfers ZERO bytes — the
+    finalize verifies whole-file digests on already-local bytes — and
+    the restored fleet is bit-identical to an in-place restore;
+  - a PARTIAL tail is still a head start: finalize drains exactly the
+    missing suffix, never re-pulls durable progress;
+  - both directions of PR-14 back-compat: a ship log started by
+    ``fetch_journal`` finalizes under the tail client, and a dir
+    started by the tail completes under ``fetch_journal``;
+  - the tail-axis chaos matrix (standby killed mid-pull / at the
+    re-manifest boundary / mid-finalize-verify) and the worker-axis
+    matrix re-run WITH a warm standby all end with zero double-scored
+    events, bit-identical streams, and a zero-byte failover path;
+  - controller placement is standby-aware: failover hand-offs steer to
+    the worker co-located with the replica, and a BROKEN standby falls
+    back to the cold PR-14 path instead of failing the failover.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from har_tpu.serve.chaos import (
+    TAIL_KILL_POINTS,
+    _DEFAULT_AT,
+    run_cluster_kill_point,
+    run_tail_kill_point,
+)
+from har_tpu.serve.cluster import ClusterConfig, FleetCluster
+from har_tpu.serve.engine import FleetConfig, FleetServer
+from har_tpu.serve.faults import FakeClock
+from har_tpu.serve.journal import (
+    SHIP_DONE,
+    SHIP_LOG,
+    FleetJournal,
+    JournalConfig,
+    JournalError,
+    read_segment_from,
+)
+from har_tpu.serve.loadgen import AnalyticDemoModel, synthetic_sessions
+from har_tpu.serve.net.ship import (
+    ShipAgent,
+    ShipClient,
+    ShipError,
+    ShipFaults,
+    ShipTorn,
+    fetch_journal,
+    journal_manifest,
+)
+from har_tpu.serve.net.tail import (
+    LocalShipSource,
+    finalize_tail,
+    tail_once,
+)
+from har_tpu.serve.replica import StandbyAgent, StandbyHost, WarmReplica
+from har_tpu.serve.stats import FleetStats
+
+MODEL = AnalyticDemoModel()
+
+
+# ------------------------------------------------------------ fixtures
+
+
+def _live_fleet(jdir, *, sessions=4, snapshot_every=0, flush_every=8):
+    """A journaled fleet left ALIVE — the moving target a standby
+    tails.  ``snapshot_every=0`` keeps the attach-time snapshot as the
+    only base (no rotation) so byte-conservation assertions are
+    exact."""
+    server = FleetServer(
+        MODEL, window=100, hop=50, channels=3, smoothing="ema",
+        config=FleetConfig(max_sessions=sessions),
+        journal=FleetJournal(
+            str(jdir),
+            JournalConfig(
+                flush_every=flush_every, snapshot_every=snapshot_every
+            ),
+        ),
+    )
+    for i in range(sessions):
+        server.add_session(i)
+    return server
+
+
+def _push_rounds(server, rng, rounds, *, sessions=4):
+    events = []
+    for _ in range(rounds):
+        for i in range(sessions):
+            server.push(
+                i, rng.normal(size=(50, 3)).astype(np.float32)
+            )
+        events.extend(server.poll(force=True))
+    return events
+
+
+def _standby_over(host_root, sb_root, *, wid="w0", **kw):
+    return StandbyAgent(
+        str(sb_root), {wid: LocalShipSource(str(host_root))},
+        loader=MODEL, chunk_bytes=1024, **kw,
+    )
+
+
+class _AgentThread:
+    """In-process ShipAgent on a background thread (test_ship idiom) —
+    the PR-14 wire endpoint the back-compat tests speak to."""
+
+    def __init__(self, root):
+        self.agent = ShipAgent(str(root))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.agent.rpc.step(0.02)
+
+    def client(self, **kw) -> ShipClient:
+        return ShipClient(
+            self.agent.rpc.host, self.agent.rpc.port, **kw
+        )
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.agent.close()
+
+
+# ------------------------------------------------- matrix declaration
+
+
+def test_tail_kill_points_declared_and_calibrated():
+    """The replication chaos surface is pinned: the tuple the harness
+    (and HL003's bijection check) iterates, and each point's default
+    trip count."""
+    assert TAIL_KILL_POINTS == (
+        "mid_tail_recv", "mid_tail_remanifest", "post_tail_verify"
+    )
+    for point in TAIL_KILL_POINTS:
+        assert point in _DEFAULT_AT, point
+    assert _DEFAULT_AT["mid_tail_recv"] == 2
+    assert _DEFAULT_AT["mid_tail_remanifest"] == 1
+    assert _DEFAULT_AT["post_tail_verify"] == 1
+
+
+# ------------------------------------------------------- live tailing
+
+
+def test_tail_warms_a_live_replica_and_catches_up(tmp_path):
+    """Tailing a RUNNING worker: the replica is queryable (warm) while
+    the source keeps scoring, and once the source goes quiet the lag
+    gauges drain to zero."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir)
+    sb = _standby_over(host_root, tmp_path / "sb")
+    rng = np.random.default_rng(0)
+    try:
+        for _ in range(4):
+            _push_rounds(server, rng, 2)
+            sb.cycle()
+        replica = sb.replicas["w0"]
+        assert replica.server is not None  # warm DURING live traffic
+        assert replica.applied_records > 0
+        assert sb.stats.shipped_bytes > 0
+        # a tailing dir is explicitly NOT restorable until finalized:
+        # the inflight-ship guard refuses (no ship.done)
+        assert os.path.exists(os.path.join(sb.dest("w0"), SHIP_LOG))
+        assert not os.path.exists(
+            os.path.join(sb.dest("w0"), SHIP_DONE)
+        )
+        with pytest.raises(JournalError):
+            FleetServer.restore(sb.dest("w0"), MODEL)
+        # source goes quiet -> the tail drains the remaining suffix
+        server.journal.kill()
+        sb.cycle()
+        sb.cycle()
+        assert sb.stats.replication_lag_bytes["w0"] == 0
+        assert sb.stats.replication_lag_records["w0"] == 0
+        status = sb.status()["replication"]["w0"]
+        assert status["ready"] is True
+        assert status["parked"] is None
+        assert status["applied_records"] == replica.applied_records
+        assert status["base"] == replica.base
+    finally:
+        sb.close()
+
+
+def test_rotation_remanifests_and_rebuilds_the_replica(tmp_path):
+    """A source snapshot rotates the journal's base out from under the
+    tail: the next cycle re-manifests (durable ``ship_remanifest``
+    record), prunes the stale staged files, and the replica re-founds
+    on the newest tailed snapshot."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir, snapshot_every=20)
+    sb = _standby_over(host_root, tmp_path / "sb")
+    rng = np.random.default_rng(1)
+    try:
+        base0 = None
+        for _ in range(6):
+            _push_rounds(server, rng, 2)
+            sb.cycle()
+            if base0 is None and "w0" in sb.replicas:
+                base0 = sb.replicas["w0"].base
+        server.journal.kill()
+        sb.cycle()
+        sb.cycle()
+        replica = sb.replicas["w0"]
+        # the base moved and the replica followed it with >=1 rebuild
+        # beyond the founding one
+        assert replica.base > base0
+        assert replica.rebuilds >= 2
+        records, _ = read_segment_from(
+            os.path.join(sb.dest("w0"), SHIP_LOG), 0
+        )
+        remanifests = [
+            rec for rec, _blob in records
+            if rec["t"] == "ship_remanifest"
+        ]
+        assert remanifests, "rotation never re-manifested"
+        assert sb.stats.replication_lag_bytes["w0"] == 0
+    finally:
+        sb.close()
+
+
+def test_caught_up_failover_ships_zero_bytes(tmp_path):
+    """THE tentpole pin: with the tail caught up when the worker dies,
+    finalize verifies digests on already-local bytes and transfers
+    NOTHING — and the restored fleet is bit-identical to an in-place
+    restore of the dead worker's own directory."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir)
+    sb = _standby_over(host_root, tmp_path / "sb")
+    rng = np.random.default_rng(2)
+    try:
+        for _ in range(3):
+            _push_rounds(server, rng, 2)
+            sb.cycle()
+        server.journal.kill()  # the worker dies
+        sb.cycle()             # the declaring poll's final tail pass
+        fin = sb.finalize("w0")
+        assert fin["bytes"] == 0, fin  # zero-transfer failover
+        assert fin["files"] > 0        # ...but every digest verified
+        assert os.path.exists(os.path.join(sb.dest("w0"), SHIP_DONE))
+        # with no rotation, every byte the standby ever pulled is
+        # exactly the manifest, once — steady-state tail, no re-pulls
+        total = sum(e["size"] for e in journal_manifest(str(jdir)))
+        assert sb.stats.shipped_bytes == total
+        restored = FleetServer.restore(sb.dest("w0"), MODEL)
+        in_place = FleetServer.restore(str(jdir), MODEL)
+        assert set(restored._sessions) == set(in_place._sessions)
+        for sid, live in in_place._sessions.items():
+            twin = restored._sessions[sid]
+            assert twin.n_scored == live.n_scored
+            np.testing.assert_array_equal(
+                twin.asm._ring, live.asm._ring
+            )
+            np.testing.assert_array_equal(
+                twin.smoother._ema, live.smoother._ema
+            )
+        assert (
+            restored.stats.accounting() == in_place.stats.accounting()
+        )
+    finally:
+        sb.close()
+
+
+def test_finalize_drains_a_partial_tail(tmp_path):
+    """A standby that lagged behind still pays only the missing
+    suffix at failover — durable tail progress is never re-pulled."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir)
+    sb = _standby_over(host_root, tmp_path / "sb")
+    rng = np.random.default_rng(3)
+    try:
+        _push_rounds(server, rng, 2)
+        sb.cycle()  # one early pass, then the standby falls behind
+        pulled = sb.stats.shipped_bytes
+        assert pulled > 0
+        _push_rounds(server, rng, 4)
+        server.journal.kill()
+        fin = sb.finalize("w0")
+        total = sum(e["size"] for e in journal_manifest(str(jdir)))
+        assert 0 < fin["bytes"] < total     # only the suffix moved
+        assert pulled + fin["bytes"] == total  # and nothing twice
+        restored = FleetServer.restore(sb.dest("w0"), MODEL)
+        assert restored.stats.accounting()["balanced"]
+    finally:
+        sb.close()
+
+
+# --------------------------------------------------- PR-14 back-compat
+
+
+def test_pr14_ship_log_finalizes_under_the_tail_client(tmp_path):
+    """Forward compat: a transfer STARTED by PR-14's
+    ``fetch_journal`` (torn mid-ship) is completed by
+    ``finalize_tail`` over the same wire agent — resume, not
+    restart."""
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir)
+    _push_rounds(server, np.random.default_rng(4), 4)
+    server.journal.kill()
+    srv = _AgentThread(host_root)
+    client = srv.client()
+    dest = str(tmp_path / "staged")
+    try:
+        with pytest.raises(ShipTorn):
+            fetch_journal(
+                client, "w0", dest, chunk_bytes=512,
+                faults=ShipFaults("torn", at=3),
+            )
+        stats = FleetStats()
+        fin = finalize_tail(
+            client, "w0", dest, chunk_bytes=512, stats=stats
+        )
+        assert fin["resumes"] == 1  # the PR-14 ship log was honoured
+        total = sum(e["size"] for e in journal_manifest(str(jdir)))
+        assert 0 < fin["bytes"] < total  # durable prefix not re-pulled
+        assert os.path.exists(os.path.join(dest, SHIP_DONE))
+        restored = FleetServer.restore(dest, MODEL)
+        assert restored.stats.accounting()["balanced"]
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_tail_started_dir_completes_under_fetch_journal(tmp_path):
+    """Backward compat: a dir a standby began tailing (against the
+    dead worker's final manifest, interrupted mid-pull) is a valid
+    resume point for the PR-14 ship-at-failover fallback — the two
+    clients share one durable ship-log dialect."""
+    from har_tpu.serve.chaos import KillPlan, SimulatedCrash
+
+    host_root = tmp_path / "host"
+    jdir = host_root / "w0"
+    server = _live_fleet(jdir)
+    _push_rounds(server, np.random.default_rng(5), 4)
+    server.journal.kill()
+    source = LocalShipSource(str(host_root))
+    dest = str(tmp_path / "staged")
+    with pytest.raises(SimulatedCrash):
+        tail_once(
+            source, "w0", dest, chunk_bytes=512,
+            chaos=KillPlan("mid_tail_recv", 3),
+        )
+    srv = _AgentThread(host_root)
+    client = srv.client()
+    try:
+        out = fetch_journal(client, "w0", dest, chunk_bytes=512)
+        assert out["resumes"] == 1  # the tail's progress carried over
+        total = sum(e["size"] for e in journal_manifest(str(jdir)))
+        assert 0 < out["bytes"] < total
+        restored = FleetServer.restore(dest, MODEL)
+        assert restored.stats.accounting()["balanced"]
+    finally:
+        client.close()
+        srv.close()
+
+
+# ------------------------------------------------- standby lifecycle
+
+
+def test_standby_parks_on_missing_source_then_recovers(tmp_path):
+    """An unreachable (or not-yet-journaling) source parks — visible
+    in the status RPC — and the next cycle after it appears warms it
+    without operator action."""
+    host_root = tmp_path / "host"
+    os.makedirs(host_root)
+    sb = _standby_over(host_root, tmp_path / "sb")
+    try:
+        sb.cycle()
+        assert "w0" in sb.parked
+        assert sb.status()["replication"]["w0"]["parked"] is not None
+        assert not sb.holds("w0")
+        server = _live_fleet(host_root / "w0")
+        _push_rounds(server, np.random.default_rng(7), 2)
+        server.journal.kill()
+        sb.cycle()
+        sb.cycle()
+        assert "w0" not in sb.parked
+        assert sb.holds("w0")
+        status = sb.status()
+        assert status["sources"] == ["w0"]
+        section = status["replication"]["w0"]
+        assert section["ready"] is True
+        assert section["lag_bytes"] == 0
+        # the section is the status-RPC contract: keys pinned
+        assert set(section) == {
+            "lag_records", "lag_bytes", "base", "applied_records",
+            "rebuilds", "ready", "parked",
+        }
+    finally:
+        sb.close()
+
+
+def test_replication_gauges_ephemeral_and_snapshotted():
+    """The lag gauges are observability, not recovery state: present
+    in every stats snapshot, absent from the journal's durable
+    envelope (a restarted standby recomputes them from its first
+    cycle)."""
+    stats = FleetStats()
+    stats.replication_lag_records["w0"] = 7
+    stats.replication_lag_bytes["w0"] = 4096
+    snap = stats.snapshot()
+    assert snap["replication_lag_records"] == {"w0": 7}
+    assert snap["replication_lag_bytes"] == {"w0": 4096}
+    state = stats.state()
+    assert "replication_lag_records" not in json.dumps(state)
+    fresh = FleetStats()
+    fresh.load_state(state)
+    assert fresh.replication_lag_records == {}
+    assert fresh.replication_lag_bytes == {}
+    assert fresh.unknown_state_keys == 0
+
+
+def test_standby_host_registers_status_rpc(tmp_path):
+    """``har serve-agent --follow`` = a plain ship agent + standby
+    cycles + the ``standby_status`` RPC, on one socket."""
+    host = StandbyHost(
+        str(tmp_path / "sb"), {}, port=0, loader=MODEL
+    )
+    try:
+        assert "standby_status" in host.agent.rpc.handlers
+        body, blob = host.agent.rpc.handlers["standby_status"](
+            {}, b""
+        )
+        assert body["replication"] == {}
+        assert blob == b""
+    finally:
+        host.close()
+
+
+def test_parse_follow_specs():
+    from har_tpu.serve.net.ship import _parse_follow
+
+    assert _parse_follow(["w0=127.0.0.1:7001", "w1=host:80"]) == {
+        "w0": ("127.0.0.1", 7001), "w1": ("host", 80),
+    }
+    with pytest.raises(SystemExit):
+        _parse_follow(["w0=nohost"])
+    with pytest.raises(SystemExit):
+        _parse_follow(["justaname"])
+
+
+# --------------------------------------------- controller integration
+
+
+def test_warm_placement_prefers_the_standby_adjacent_worker(tmp_path):
+    """Failover hand-offs steer to the worker registered next to the
+    standby's replica (ahead of the ring owner), and the partition
+    restore itself comes from the standby at zero transfer."""
+    from har_tpu.serve.chaos import _drive_cluster
+
+    n = 9
+    recordings, _ = synthetic_sessions(
+        n, windows_per_session=2, seed=11
+    )
+    clock = FakeClock()
+    cluster = FleetCluster(
+        MODEL, str(tmp_path / "fleet"), workers=3, window=200,
+        hop=200, smoothing="ema",
+        fleet_config=FleetConfig(max_sessions=n, max_delay_ms=0.0),
+        config=ClusterConfig(
+            lease_s=0.2, probe_retries=2, probe_base_ms=10.0,
+            probe_cap_ms=50.0,
+        ),
+        clock=clock,
+    )
+    for i in range(n):
+        cluster.add_session(i)
+    victim = cluster.worker_of(0)
+    prefer = next(w for w in cluster.workers if w != victim)
+    sb = StandbyAgent(
+        str(tmp_path / "replica"),
+        {victim: LocalShipSource(str(tmp_path / "fleet"))},
+        loader=MODEL,
+    )
+    cluster.register_standby(sb, prefer=prefer)
+    killed = {"done": False}
+
+    def on_round(c):
+        if not killed["done"]:
+            c._workers[victim].kill()
+            killed["done"] = True
+
+    events, cursors = [], [0] * n
+    _drive_cluster(
+        cluster, recordings, cursors, 200, 200, clock, events,
+        on_round,
+    )
+    stats = cluster.cluster_stats()
+    assert stats["failovers"] == 1
+    assert stats["standbys"] == 1
+    assert stats["standby_fetches"] == 1   # warm path taken
+    assert stats["failover_path_bytes"] == 0  # ...at zero transfer
+    moved = cluster.migration_log
+    assert moved  # the victim owned at least one session
+    for entry in moved:
+        assert entry["from"] == victim
+        assert entry["to"] == prefer  # warm placement, not ring owner
+    acct = cluster.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+    cluster.close()
+
+
+def test_broken_standby_falls_back_to_the_cold_path(tmp_path):
+    """A standby that claims the partition but cannot finalize must
+    never make failover WORSE than PR-14: the controller falls back to
+    the dead worker's own journal and completes."""
+
+    class _BrokenStandby:
+        def __init__(self):
+            self.stats = FleetStats()
+            self.finalizes = 0
+
+        def holds(self, wid):
+            return True
+
+        def cycle(self):
+            return {"sources": {}, "lag_records": 0, "lag_bytes": 0}
+
+        def finalize(self, wid):
+            self.finalizes += 1
+            raise ShipError("simulated broken standby")
+
+        def dest(self, wid):
+            return str(tmp_path / "nowhere")
+
+        def close(self):
+            pass
+
+    from har_tpu.serve.chaos import _drive_cluster
+
+    n = 6
+    recordings, _ = synthetic_sessions(
+        n, windows_per_session=2, seed=12
+    )
+    clock = FakeClock()
+    cluster = FleetCluster(
+        MODEL, str(tmp_path / "fleet"), workers=3, window=200,
+        hop=200, smoothing="ema",
+        fleet_config=FleetConfig(max_sessions=n, max_delay_ms=0.0),
+        config=ClusterConfig(
+            lease_s=0.2, probe_retries=2, probe_base_ms=10.0,
+            probe_cap_ms=50.0,
+        ),
+        clock=clock,
+    )
+    for i in range(n):
+        cluster.add_session(i)
+    broken = _BrokenStandby()
+    cluster.register_standby(broken)
+    victim = cluster.worker_of(0)
+    killed = {"done": False}
+
+    def on_round(c):
+        if not killed["done"]:
+            c._workers[victim].kill()
+            killed["done"] = True
+
+    events, cursors = [], [0] * n
+    _drive_cluster(
+        cluster, recordings, cursors, 200, 200, clock, events,
+        on_round,
+    )
+    stats = cluster.cluster_stats()
+    assert stats["failovers"] == 1       # the failover still landed
+    assert broken.finalizes >= 1         # the warm path WAS tried
+    assert stats["standby_fetches"] == 0  # ...and never counted
+    assert stats["failover_path_bytes"] == 0
+    acct = cluster.accounting()
+    assert acct["balanced"] and acct["pending"] == 0
+    cluster.close()
+
+
+# ------------------------------------------------------- chaos matrix
+
+
+@pytest.mark.parametrize("point", TAIL_KILL_POINTS)
+def test_tail_kill_matrix(point):
+    """The replication chaos matrix: the standby killed mid-pull (a
+    fresh standby resumes the SAME staged dir with zero re-pulled
+    bytes), killed at the re-manifest boundary (the durable
+    ``ship_remanifest`` re-founds it), and the worker killed before
+    the finalize verify (the partial tail drains; the finalize retry
+    is idempotent at zero bytes) — every cell ends bit-identical to
+    the unkilled schedule with zero windows lost."""
+    out = run_tail_kill_point(point, sessions=6, seed=0)
+    assert out["ok"], f"{point}: {out['why']}"
+    assert out["windows_lost"] == 0
+    if point == "post_tail_verify":
+        # the worker died mid-chunk: the failover path pays exactly
+        # the missing suffix, once
+        assert out["failover_path_bytes"] > 0
+    else:
+        assert out["failover_path_bytes"] == 0
+
+
+@pytest.mark.parametrize(
+    "point", ("mid_dispatch", "mid_handoff", "mid_migration")
+)
+def test_cluster_kill_matrix_with_warm_standby(point):
+    """The worker-axis matrix re-run with a registered warm standby:
+    same bit-identical / conservation verdicts, but the partition
+    restore sources from the standby at zero failover-path bytes."""
+    out = run_cluster_kill_point(
+        point, sessions=12, workers=3, seed=0, standby=True
+    )
+    assert out["ok"], f"{point}: {out['why']}"
+    assert out["windows_lost"] == 0
+    assert out["standby_fetches"] >= 1
+    assert out["failover_path_bytes"] == 0
