@@ -1382,6 +1382,40 @@ def main() -> None:
         "wire_ingest", 60, _wire_ingest_lane
     )
 
+    # Gateway-HA lane (r19 tentpole, har_tpu.serve.net.gateway +
+    # election): the front door's own failover cost — an elected
+    # gateway pair over one lease directory, the ACTIVE gateway
+    # SIGKILLed mid-delivery while two tenant cohorts push through
+    # reconnecting HA clients.  failover_ms is the wall time from the
+    # client's first failed frame to the first frame the NEW leader
+    # accepts (capped-exponential redial + moved-receipt retarget),
+    # per session count.  contract_ok pins the lossless verdict each
+    # run: bit-identical scored streams, zero windows lost, the
+    # protected tenant unshedded through a one-tenant storm.
+    def _gateway_ha_lane():
+        from har_tpu.serve.net.smoke import gateway_ha_benchmark
+
+        session_counts = [8] if smoke else [8, 24]
+        rows = gateway_ha_benchmark(
+            session_counts, n_runs=1 if smoke else lane_runs
+        )
+        return None, {
+            "model": "analytic_demo",
+            "transport": "tcp",
+            "gateways": 2,
+            "n_runs": 1 if smoke else lane_runs,
+            "rows": rows,
+            "failover_ms_median": rows[-1]["failover_ms_median"],
+            "failover_ms_max": rows[-1]["failover_ms_max"],
+            "resumed_sessions": rows[-1]["resumed_sessions"],
+            "contract_ok": all(r["contract_ok"] for r in rows),
+            "chip_state_probe": chip_probe,
+        }
+
+    _, gateway_ha_stats = deadline_lane(
+        "gateway_ha", 60, _gateway_ha_lane
+    )
+
     # Elastic-traffic lane (r14 tentpole, har_tpu.serve.traffic): the
     # same seeded 10x diurnal swing (overnight-cohort storm, slow
     # clients, mixed rates) served three ways — static floor batch,
@@ -1725,6 +1759,17 @@ def main() -> None:
             "ack_coalesce_ratio"
         ),
         "wire_ingest_contract_ok": ingest_stats.get("contract_ok"),
+        # gateway HA (har_tpu.serve.net.gateway + election): the front
+        # door's failover cost — SIGKILL of the active gateway of an
+        # elected pair to the first frame the new leader accepts, with
+        # the lossless-resume contract pinned per run
+        "gateway_ha_failover_ms_median": gateway_ha_stats.get(
+            "failover_ms_median"
+        ),
+        "gateway_ha_resumed_sessions": gateway_ha_stats.get(
+            "resumed_sessions"
+        ),
+        "gateway_ha_contract_ok": gateway_ha_stats.get("contract_ok"),
         # elastic traffic (har_tpu.serve.traffic): the autoscaled run's
         # numbers across the 10x swing, and whether it beat the best
         # static configuration on p99 or shed rate at equal windows/s
@@ -1826,6 +1871,7 @@ def main() -> None:
         "wire_failover": wire_stats,
         "journal_ship": ship_stats,
         "wire_ingest": ingest_stats,
+        "gateway_ha": gateway_ha_stats,
         "elastic_traffic": elastic_stats,
         "host_plane_scaling": host_plane_stats,
     }

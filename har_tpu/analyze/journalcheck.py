@@ -19,12 +19,18 @@ the shape that drifts:
      must be static);
   2. every replay handler must correspond to a written record type;
   3. the kill-point names the chaos matrix enumerates
-     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` + the cluster, ship and
-     replication-tail tuples in ``serve/chaos.py``) must biject with the
-     ``chaos_point("...")`` / ``_chaos("...")`` call sites across the
-     stack, and every matrix point needs a ``_DEFAULT_AT`` occurrence
-     calibration — a stage boundary without a matrix entry is a crash
-     window no chaos run ever exercises.
+     (``KILL_POINTS`` + ``ENGINE_KILL_POINTS`` + the cluster, ship,
+     replication-tail and gateway tuples in ``serve/chaos.py``) must
+     biject with the ``chaos_point("...")`` / ``_chaos("...")`` call
+     sites across the stack, and every matrix point needs a
+     ``_DEFAULT_AT`` occurrence calibration — a stage boundary without
+     a matrix entry is a crash window no chaos run ever exercises;
+  4. the gateway pair's ``{"moved": leader_addr}`` receipt has a writer
+     side (the standby/drain refusal dict in ``serve/net``) and a
+     consumer side (the HA client's ``"moved" in resp`` redirect) —
+     losing either turns a declared refusal into a silent hangup (no
+     writer) or an unfollowable one (no handler), so the pair is pinned
+     in both directions like the record/handler bijection.
 """
 
 from __future__ import annotations
@@ -156,6 +162,8 @@ class JournalExhaustivenessRule(Rule):
         retired: set[str] = set()
         retired_node = None
         recover_ctx = None
+        moved_writers: list[tuple[FileContext, ast.AST]] = []
+        moved_handlers: list[tuple[FileContext, ast.AST]] = []
 
         for ctx in ctxs:
             base = ctx.rel.rsplit("/", 1)[-1]
@@ -203,8 +211,16 @@ class JournalExhaustivenessRule(Rule):
                 # bijection — a tail boundary outside the matrix is a
                 # standby-death window no chaos run exercises
                 tkp, _ = _string_tuple(ctx.tree, "TAIL_KILL_POINTS")
-                declared = kp | ekp | ckp | skp | tkp
-                matrix_points = kp | ckp | skp | tkp
+                # the ingest gateway pair's stage boundaries
+                # (mid_frame_recv / post_accept_pre_forward /
+                # mid_lease_handoff, fired inside net/gateway.py's
+                # admission and drain paths, run by
+                # run_gateway_kill_point): same bijection — an edge
+                # boundary outside the matrix is a gateway-death
+                # window no chaos run exercises
+                gkp, _ = _string_tuple(ctx.tree, "GATEWAY_KILL_POINTS")
+                declared = kp | ekp | ckp | skp | tkp | gkp
+                matrix_points = kp | ckp | skp | tkp | gkp
                 declared_node = kp_node
                 default_at = _dict_keys(ctx.tree, "_DEFAULT_AT")
             for node in ast.walk(ctx.tree):
@@ -216,6 +232,33 @@ class JournalExhaustivenessRule(Rule):
                     and isinstance(node.args[0].value, str)
                 ):
                     chaos_calls.setdefault(node.args[0].value, (ctx, node))
+            # the moved-receipt bijection lives entirely in the
+            # transport package: writers are `{"moved": ...}` dict
+            # literals, consumers are `"moved" in resp` membership
+            # tests or `.get("moved")` reads
+            if "serve/net/" in ctx.rel:
+                for node in ast.walk(ctx.tree):
+                    if isinstance(node, ast.Dict) and any(
+                        isinstance(k, ast.Constant) and k.value == "moved"
+                        for k in node.keys
+                    ):
+                        moved_writers.append((ctx, node))
+                    elif (
+                        isinstance(node, ast.Compare)
+                        and isinstance(node.left, ast.Constant)
+                        and node.left.value == "moved"
+                        and len(node.ops) == 1
+                        and isinstance(node.ops[0], ast.In)
+                    ):
+                        moved_handlers.append((ctx, node))
+                    elif (
+                        isinstance(node, ast.Call)
+                        and call_name(node) == "get"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value == "moved"
+                    ):
+                        moved_handlers.append((ctx, node))
 
         # record types <-> replay handlers, both directions
         recover_seen = bool(handled) or any(
@@ -311,4 +354,33 @@ class JournalExhaustivenessRule(Rule):
                         "occurrence calibration",
                     )
                 )
+
+        # the moved receipt, both directions: a transport package that
+        # only writes (or only consumes) the receipt has lost half of
+        # the declared-failover contract
+        if moved_writers and not moved_handlers:
+            ctx, node = moved_writers[0]
+            findings.append(
+                ctx.finding(
+                    self.rule_id,
+                    node,
+                    'a {"moved": ...} receipt is written here but no '
+                    'client-side handler ("moved" in resp / '
+                    '.get("moved")) exists in serve/net — the '
+                    "standby's declared refusal would be unfollowable "
+                    "and every failover would strand its clients",
+                )
+            )
+        if moved_handlers and not moved_writers:
+            ctx, node = moved_handlers[0]
+            findings.append(
+                ctx.finding(
+                    self.rule_id,
+                    node,
+                    'a "moved"-receipt handler exists here but nothing '
+                    'in serve/net writes a {"moved": ...} refusal — '
+                    "dead redirect code, or the standby's declared "
+                    "refusal was replaced by a silent hangup",
+                )
+            )
         return findings

@@ -120,6 +120,23 @@ TAIL_KILL_POINTS = (
     "mid_tail_remanifest",
     "post_tail_verify",
 )
+# the ingest gateway pair's stage boundaries (har_tpu.serve.net.gateway,
+# run by run_gateway_kill_point with two elected gateways in front of
+# live workers): the ACTIVE gateway dies while a push frame's header is
+# being judged (mid_frame_recv — the client's frame is unacked and
+# ambiguous; the re-send to the new leader dedups by watermark), it
+# dies after admission said yes but before the chunks reach the workers
+# (post_accept_pre_forward — admitted-but-undelivered, the worst
+# ambiguity window), and it dies inside a graceful drain after marking
+# itself draining but before the early lease release lands
+# (mid_lease_handoff — the peer must still win by waiting out the
+# un-released lease).  Every cell demands windows_lost == 0 and a
+# scored event stream bit-identical to the un-killed run.
+GATEWAY_KILL_POINTS = (
+    "mid_frame_recv",
+    "post_accept_pre_forward",
+    "mid_lease_handoff",
+)
 # the failure modes only a REAL link has (har_tpu.serve.net.chaos —
 # run over subprocess workers on loopback TCP): a slow link and a
 # blackholed probe must NOT be failovers, a duplicated delivery must
@@ -162,6 +179,12 @@ _DEFAULT_AT = {
     "mid_tail_recv": 2,
     "mid_tail_remanifest": 1,
     "post_tail_verify": 1,
+    # gateway-axis occurrences: a mid-run frame receipt (rounds already
+    # delivered, more coming), the second admitted-but-unforwarded
+    # window, and the first drain hand-off
+    "mid_frame_recv": 3,
+    "post_accept_pre_forward": 2,
+    "mid_lease_handoff": 1,
 }
 
 

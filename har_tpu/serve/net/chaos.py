@@ -54,6 +54,7 @@ import time
 
 from har_tpu.serve.chaos import (
     CLUSTER_KILL_POINTS,
+    GATEWAY_KILL_POINTS,
     KILL_POINTS,
     NET_PARTITION_CASES,
     SHIP_KILL_POINTS,
@@ -63,6 +64,7 @@ from har_tpu.serve.chaos import (
     _build_cluster,
     _cluster_schedule,
     _cluster_verdict,
+    _event_fields,
     _recordings,
 )
 from har_tpu.serve.cluster.controller import ClusterConfig
@@ -746,3 +748,224 @@ def _run_split_brain(*, workers, sessions, seed, n_samples, window,
                 proc.kill()
         shutil.rmtree(root, ignore_errors=True)
         shutil.rmtree(priv, ignore_errors=True)
+
+
+# ------------------------------------------------- gateway HA matrix
+
+
+def run_gateway_kill_point(
+    point: str,
+    *,
+    at: int | None = None,
+    workers: int = 2,
+    sessions: int = 6,
+    seed: int = 0,
+    n_samples: int = 600,
+    window: int = 100,
+    hop: int = 50,
+    # the gateway forwards synchronously, so its serve loop cannot
+    # renew while a worker call is in flight: the lease must outlast
+    # the longest forward stall (first-dispatch warmup on a cold
+    # worker is ~0.5s) or the standby steals it mid-round — benign for
+    # data (worker watermarks are the truth) but it would turn the
+    # matrix's "kill" cells into accidental pre-kill flips
+    lease_s: float = 1.0,
+    handoff_round: int | None = None,
+) -> dict:
+    """One cell of the gateway-pair failover matrix: kill the ACTIVE
+    gateway of an elected pair at one of its stage boundaries
+    (``chaos.GATEWAY_KILL_POINTS``) while a reconnecting HA client is
+    mid-delivery, or — with the pseudo-point ``"drain"`` — restart it
+    GRACEFULLY instead (``shutdown {"drain": true}``: in-flight frames
+    finish, refusals carry ``{"moved": ...}``, the lease is released
+    early).  The acceptance bar is identical for both, which is the
+    drain-indistinguishability pin: the standby takes the lease, the
+    client resumes from the workers' watermarks, zero windows lost,
+    and the scored stream BIT-IDENTICAL to an un-killed IN-PROCESS
+    reference run of the same schedule.
+
+    The gateway owns no session state (workers journal, the lease
+    directory elects), so the kill never touches a journal — what this
+    matrix proves is that the FRONT DOOR moving costs nothing: edge
+    dedup-by-watermark absorbs the client's replayed frames and the
+    fenced lease generation rejects any late ack from the deposed
+    leader."""
+    from har_tpu.serve.net.client import HAGatewayClient
+    from har_tpu.serve.net.gateway import launch_gateway_pair
+    from har_tpu.serve.net.ingest import IngestConfig
+    from har_tpu.serve.net.rpc import RpcClient, RpcError
+    from har_tpu.utils.backoff import BackoffPolicy
+
+    drain = point == "drain"
+    if not drain and point not in GATEWAY_KILL_POINTS:
+        raise ValueError(f"unknown gateway kill point {point!r}")
+    at = (_DEFAULT_AT.get(point, 1) if at is None else at)
+    rounds = n_samples // hop
+    if handoff_round is None:
+        handoff_round = rounds // 3
+    # the handoff cells need an explicit drain request to reach the
+    # kill point (or to trigger the graceful restart)
+    handoff = drain or point == "mid_lease_handoff"
+    recordings = _recordings(sessions, n_samples, 3, seed)
+    model = AnalyticDemoModel()
+
+    def loader(ver):
+        return model
+
+    # ---- reference: the un-killed IN-PROCESS cluster run ------------
+    ref_root = tempfile.mkdtemp(prefix="har_gwref_")
+    try:
+        ref_clock = FakeClock()
+        ref = _build_cluster(
+            ref_root, ref_clock, sessions=sessions, workers=workers,
+            window=window, hop=hop, model=model,
+            flush_every=512, snapshot_every=40, loader=loader,
+        )
+        for w in ref._workers.values():
+            w.server._fault_hook = None
+        for i in range(sessions):
+            ref.add_session(i)
+        ref_events: list = []
+        for r in range(rounds):
+            for i in range(sessions):
+                ref.push(i, recordings[i][r * hop:(r + 1) * hop])
+            ref_events.extend(ref.poll(force=True))
+            ref_clock.advance(0.01)
+        ref_events.extend(ref.flush())
+        ref.close()
+    finally:
+        shutil.rmtree(ref_root, ignore_errors=True)
+
+    # ---- the wire run: worker fleet + elected gateway pair ----------
+    root = tempfile.mkdtemp(prefix="har_gwchaos_")
+    procs: list = []
+    client = None
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop, target_batch=32,
+            max_delay_ms=0.0, retries=1, flush_every=512,
+            snapshot_every=40,
+        )
+        procs.extend(w.process for w in net_workers)
+        pair = launch_gateway_pair(
+            root, net_workers, deadline_s=2.0, config=IngestConfig(),
+            lease_s=lease_s,
+            chaos_point=None if drain else point,
+            chaos_at=at,
+        )
+        procs.extend(p for p, _, _ in pair)
+        (proc_a, host_a, port_a), (_, host_b, port_b) = pair
+        client = HAGatewayClient(
+            [f"{host_a}:{port_a}", f"{host_b}:{port_b}"],
+            deadline_s=2.0, retries=1, seed=seed,
+            reconnect=BackoffPolicy(
+                base_ms=20.0, cap_ms=250.0, factor=2.0, jitter=0.25
+            ),
+        )
+        for i in range(sessions):
+            client.add_session(i)
+        events: list = []
+        for r in range(rounds):
+            if handoff and r == handoff_round:
+                # address gateway A DIRECTLY, not through the HA
+                # client: a deadline-retried drain that followed the
+                # lease would drain the NEW leader too and leave the
+                # pair dry
+                probe = RpcClient(
+                    host_a, port_a, deadline_s=1.0, retries=0
+                )
+                try:
+                    probe.call("shutdown", {"drain": True})
+                except RpcError:
+                    pass  # mid_lease_handoff kills A inside the call
+                finally:
+                    probe.close()
+            for i in range(sessions):
+                client.push(i, recordings[i][r * hop:(r + 1) * hop])
+            events.extend(client.poll(force=True))
+        events.extend(client.flush())
+        acct = client.accounting()
+        gw_stats = client.gateway_stats()
+
+        # ---- fired check -------------------------------------------
+        deadline = time.monotonic() + 5.0
+        while proc_a.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        rc_a = proc_a.poll()
+        why = None
+        if drain:
+            if rc_a != 0:
+                why = (
+                    f"drain: gateway A exited {rc_a!r}, wanted a clean "
+                    "0 after the grace window"
+                )
+        elif rc_a is None:
+            why = f"{point}: the chaos plan never fired (A still alive)"
+
+        # ---- verdict: the same bar as every other matrix ------------
+        def _per_sid(evts):
+            out: dict = {}
+            for fe in evts:
+                out.setdefault(fe.session_id, []).append(
+                    _event_fields(fe)
+                )
+            return out
+
+        ref_by = _per_sid(ref_events)
+        got_by = _per_sid(events)
+        keys = [(fe.session_id, fe.event.t_index) for fe in events]
+        if why is None and len(keys) != len(set(keys)):
+            why = (
+                "duplicate (session, t_index) events — the replayed "
+                "frame was double-ingested across the lease flip"
+            )
+        windows_lost = len(ref_events) - len(events)
+        if why is None and windows_lost != 0:
+            why = f"{windows_lost} windows lost across the lease flip"
+        if why is None and got_by != ref_by:
+            why = (
+                "scored stream not bit-identical to the un-killed "
+                "in-process reference"
+            )
+        if why is None and not acct.get("balanced", False):
+            why = f"conservation violated after failover: {acct!r}"
+        if why is None and int(acct.get("lost_in_crash", 0)) != 0:
+            why = (
+                f"{acct['lost_in_crash']} windows declared lost — the "
+                "gateway kill must not cost journal suffix"
+            )
+        if why is None and client.gen < 2:
+            why = (
+                "client never saw a fenced generation bump "
+                f"(gen={client.gen}) — did the lease actually move?"
+            )
+        if why is None and client.failover_episodes < 1:
+            why = "client recorded no failover episode"
+        out = {
+            "ok": why is None,
+            "point": point,
+            "why": why,
+            "drain": drain,
+            "windows_lost": windows_lost,
+            "delivered": len(events),
+            "failover_ms": float(client.last_failover_ms or 0.0),
+            "reconnects": client.reconnects,
+            "moved_receipts": client.moved_receipts,
+            "stale_acks_rejected": client.stale_acks_rejected,
+            "resumed_sessions": len(client.resumed),
+            "deduped_samples": client.deduped_samples,
+            "gateways": 2,
+            "gateway_exit": rc_a,
+            "lease_gen": client.gen,
+            "standby_lease_wins": int(gw_stats.get("lease_wins", 0)),
+            "accounting": acct,
+        }
+        client.shutdown()
+        return out
+    finally:
+        if client is not None:
+            client.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)

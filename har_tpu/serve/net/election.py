@@ -109,6 +109,35 @@ class LeaderLease:
         )
         return True
 
+    def release(self, leader_id: str, generation: int) -> bool:
+        """Give the lease up EARLY — a draining holder expires its own
+        lease (``expires = now``) instead of letting standbys wait out
+        the full term, so a planned hand-off flips as fast as a crash
+        detection, minus the detection.  Refused (False) under the same
+        fencing as ``renew``: only the current (leader, generation) may
+        release, a deposed holder's late release must not clip the
+        successor's lease."""
+        lease = self.read()
+        if lease is not None and (
+            lease.get("gen", 0) > generation
+            or (
+                lease.get("gen", 0) == generation
+                and lease.get("leader") != leader_id
+            )
+        ):
+            return False
+        atomic_write(
+            self._path,
+            json.dumps(
+                {
+                    "leader": leader_id,
+                    "gen": int(generation),
+                    "expires": self._wall(),
+                }
+            ),
+        )
+        return True
+
     def campaign(self, leader_id: str) -> int | None:
         """Try to take an expired lease: lock, re-read, write gen+1.
         Returns the won generation, or None (lease alive, or another

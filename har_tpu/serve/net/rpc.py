@@ -438,9 +438,15 @@ class RpcServer:
                     reason = self.admission(hmeta, plen)
                     if reason is not None:
                         buf.skip_frame()
-                        frame = encode_frame(
-                            {"id": hmeta.get("id"), "shed": reason}
-                        )
+                        if isinstance(reason, dict):
+                            # a structured refusal (the gateway
+                            # standby's {"moved": leader} receipt):
+                            # sent verbatim, addressed to the request
+                            resp = dict(reason)
+                            resp["id"] = hmeta.get("id")
+                        else:
+                            resp = {"id": hmeta.get("id"), "shed": reason}
+                        frame = encode_frame(resp)
                         self.requests_served += 1
                         if key[0] is not None and key[1] is not None:
                             self._dedup[key] = frame

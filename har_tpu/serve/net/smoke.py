@@ -781,6 +781,289 @@ def wire_ingest_benchmark(
     return rows
 
 
+def _run_gateway_ha(
+    sessions: int,
+    workers: int,
+    seed: int,
+    *,
+    rounds: int = 12,
+    window: int = 100,
+    hop: int = 50,
+    lease_s: float = 1.0,
+    kill_round: int | None = None,
+) -> dict:
+    """One measured HA front-door run: an elected gateway PAIR fronting
+    subprocess workers, two tenant cohorts (``care`` weight 3.0 — the
+    protected monitored-patient streams — and ``bulk`` weight 1.0)
+    pushing through reconnecting HA clients, and the ACTIVE gateway
+    SIGKILLed mid-run.  The verdict pins the lease flip losslessly:
+    every client reconnects and resumes from the workers' watermarks,
+    ``windows_lost == 0``, the combined scored stream bit-identical to
+    an in-process un-killed run of the same schedule — then a one-
+    tenant storm (an oversized ``bulk`` burst) is refused with a
+    declared receipt while the ``care`` cohort sees ZERO edge sheds,
+    and the edge ledger's per-tenant slices sum to its globals."""
+    from har_tpu.serve.chaos import _recordings
+    from har_tpu.serve.cluster.controller import FleetCluster
+    from har_tpu.serve.engine import FleetConfig
+    from har_tpu.serve.journal import JournalConfig
+    from har_tpu.serve.loadgen import AnalyticDemoModel
+    from har_tpu.serve.net.client import HAGatewayClient
+    from har_tpu.serve.net.gateway import launch_gateway_pair
+    from har_tpu.serve.net.ingest import IngestConfig
+    from har_tpu.utils.backoff import BackoffPolicy
+
+    sessions = max(int(sessions), 2)
+    if kill_round is None:
+        kill_round = max(rounds // 3, 1)
+    n_samples = rounds * hop
+    recordings = _recordings(sessions, n_samples, 3, seed)
+    care_sids = list(range(sessions // 2))
+    bulk_sids = list(range(sessions // 2, sessions))
+    config = IngestConfig(
+        # a soft byte ceiling the storm burst overflows while every
+        # honest frame stays far below it
+        max_frame_bytes=1 << 18,
+        tenants=(("bulk", 1.0), ("care", 3.0)),
+    )
+
+    # ---- reference: the same schedule, in-process, un-killed --------
+    ref_root = tempfile.mkdtemp(prefix="har_gwha_ref_")
+    ref_events: list = []
+    try:
+        ref = FleetCluster(
+            AnalyticDemoModel(),
+            ref_root,
+            workers=workers,
+            window=window,
+            hop=hop,
+            fleet_config=FleetConfig(
+                target_batch=32, max_delay_ms=0.0, retries=1
+            ),
+            journal_config=JournalConfig(
+                flush_every=512, snapshot_every=40
+            ),
+        )
+        for i in range(sessions):
+            ref.add_session(i)
+        for r in range(rounds):
+            for i in range(sessions):
+                ref.push(i, recordings[i][r * hop:(r + 1) * hop])
+            ref_events.extend(ref.poll(force=True))
+        ref_events.extend(ref.flush())
+        for w in ref._workers.values():
+            w.close()
+    finally:
+        shutil.rmtree(ref_root, ignore_errors=True)
+
+    # ---- the wire run: worker fleet + elected pair + two tenants ----
+    root = tempfile.mkdtemp(prefix="har_gwha_wire_")
+    procs: list = []
+    clients: list = []
+    try:
+        net_workers = launch_workers(
+            root, workers, window=window, hop=hop, target_batch=32,
+            max_delay_ms=0.0, flush_every=512, snapshot_every=40,
+        )
+        procs = [w.process for w in net_workers]
+        pair = launch_gateway_pair(
+            root, net_workers, config=config, lease_s=lease_s
+        )
+        procs.extend(p for p, _, _ in pair)
+        addrs = [f"{h}:{p}" for _, h, p in pair]
+        policy = BackoffPolicy(
+            base_ms=20.0, cap_ms=250.0, factor=2.0, jitter=0.25
+        )
+        care = HAGatewayClient(
+            addrs, tenant="care", deadline_s=2.0, retries=1,
+            reconnect=policy, seed=seed,
+        )
+        bulk = HAGatewayClient(
+            addrs, tenant="bulk", deadline_s=2.0, retries=1,
+            reconnect=policy, seed=seed + 1,
+        )
+        clients = [care, bulk]
+        for i in care_sids:
+            care.add_session(i)
+        for i in bulk_sids:
+            bulk.add_session(i)
+        events: list = []
+        t_kill = None
+        for r in range(rounds):
+            if r == kill_round:
+                # a real SIGKILL of the ACTIVE gateway, client frames
+                # in flight on both tenants
+                pair[0][0].kill()
+                t_kill = time.monotonic()
+            for i in care_sids:
+                care.push(i, recordings[i][r * hop:(r + 1) * hop])
+            for i in bulk_sids:
+                bulk.push(i, recordings[i][r * hop:(r + 1) * hop])
+            events.extend(care.poll(force=True))
+            events.extend(bulk.poll(force=True))
+        events.extend(care.flush())
+        events.extend(bulk.flush())
+
+        # ---- the one-tenant storm: an oversized bulk burst ----------
+        storm_sid = sessions
+        bulk.add_session(storm_sid)
+        bulk.push(
+            storm_sid, np.zeros((24576, 3), np.float32)
+        )  # 288 KiB > the 256 KiB soft ceiling: shed, with a receipt
+        events.extend(bulk.poll(force=True))
+
+        acct = care.accounting()
+        gw = care.gateway_stats()
+        failover_s = time.monotonic() - (t_kill or time.monotonic())
+
+        # ---- verdict ------------------------------------------------
+        ref_by = _by_session(ref_events)
+        got_by = _by_session(events)
+        keys = {(fe.session_id, fe.event.t_index) for fe in events}
+        windows_lost = len(ref_events) - len(events)
+        slices = gw.get("tenants", {})
+        why = None
+        if len(keys) != len(events):
+            why = "an event was delivered twice across the lease flip"
+        elif windows_lost != 0:
+            why = f"{windows_lost} window(s) lost across the lease flip"
+        elif got_by != ref_by:
+            why = (
+                "scored stream not bit-identical to the un-killed "
+                "in-process run"
+            )
+        elif care.edge_sheds != 0:
+            why = (
+                f"the protected tenant took {care.edge_sheds} edge "
+                "shed(s) during the bulk storm"
+            )
+        elif bulk.shed_by_reason.get("frame_bytes", 0) < 1:
+            why = "the bulk storm was not refused at the edge"
+        elif slices.get("care", {}).get("shed_frames", 0) != 0:
+            why = "the edge ledger charged sheds to the care slice"
+        elif slices.get("bulk", {}).get("shed_frames", 0) < 1:
+            why = "the edge ledger missed the bulk storm shed"
+        elif any(
+            sum(s.get(k, 0) for s in slices.values()) != gw.get(k)
+            for k in (
+                "admitted_frames", "admitted_sessions",
+                "admitted_bytes", "shed_frames", "shed_sessions",
+                "shed_bytes",
+            )
+        ):
+            why = (
+                "per-tenant slices do not sum to the edge ledger "
+                "globals"
+            )
+        elif not acct["balanced"] or acct["pending"] != 0:
+            why = f"conservation violated across the flip: {acct}"
+        elif min(care.gen, bulk.gen) < 2:
+            why = (
+                "a client never saw the fenced generation move "
+                f"(care={care.gen}, bulk={bulk.gen})"
+            )
+        elif min(care.failover_episodes, bulk.failover_episodes) < 1:
+            why = "a client recorded no failover episode"
+        failover_ms = max(
+            care.last_failover_ms or 0.0, bulk.last_failover_ms or 0.0
+        )
+        out = {
+            "ok": why is None,
+            "why": why,
+            "sessions": int(sessions),
+            "workers": int(workers),
+            "gateways": 2,
+            "transport": "tcp",
+            "rounds": int(rounds),
+            "windows_lost": windows_lost,
+            "delivered": len(events),
+            "failover_ms": float(failover_ms),
+            "run_failover_s": float(failover_s),
+            "reconnects": care.reconnects + bulk.reconnects,
+            "moved_receipts": care.moved_receipts + bulk.moved_receipts,
+            "resumed_sessions": len(care.resumed | bulk.resumed),
+            "tenant_sheds": {
+                t: int(s.get("shed_frames", 0))
+                for t, s in slices.items()
+            },
+            "lease_gen": int(max(care.gen, bulk.gen)),
+            "accounting": acct,
+        }
+        care.shutdown()
+        return out
+    finally:
+        for c in clients:
+            c.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def gateway_ha_smoke(
+    sessions: int = 8, workers: int = 2, seed: int = 0
+) -> dict:
+    """Gate verdict: one gateway-pair failover run reshaped into the
+    gate-log stamp (keys pinned by tests/test_release_gate.py)."""
+    out = _run_gateway_ha(sessions, workers, seed)
+    return {
+        "ok": out["ok"],
+        "why": out["why"],
+        "sessions": out["sessions"],
+        "workers": out["workers"],
+        "gateways": out["gateways"],
+        "transport": out["transport"],
+        "failover_ms": out["failover_ms"],
+        "resumed_sessions": out["resumed_sessions"],
+        "tenant_sheds": out["tenant_sheds"],
+        "windows_lost": out["windows_lost"],
+    }
+
+
+def gateway_ha_benchmark(
+    session_counts,
+    n_runs: int = 3,
+    *,
+    workers: int = 2,
+    seed: int = 0,
+    rounds: int = 12,
+) -> list[dict]:
+    """bench.py's ``gateway_ha`` lane rows: per session count, the
+    failover cost of the ACTIVE gateway dying — wall time from the
+    SIGKILL to the first frame the new leader ACCEPTS
+    (``failover_ms``, median of ``n_runs``) — plus the reconnect storm
+    size.  ``contract_ok`` pins the lossless verdict (bit-identity,
+    zero windows lost, tenant fairness) on every measured run."""
+    rows = []
+    for n_sessions in session_counts:
+        fo_ms, reconnects, moved = [], 0, 0
+        resumed, ok = 0, True
+        for r in range(int(n_runs)):
+            out = _run_gateway_ha(
+                int(n_sessions), workers, seed + r, rounds=rounds
+            )
+            ok = ok and out["ok"]
+            fo_ms.append(out["failover_ms"])
+            reconnects = out["reconnects"]
+            moved = out["moved_receipts"]
+            resumed = out["resumed_sessions"]
+        rows.append(
+            {
+                "n_sessions": int(n_sessions),
+                "workers": int(workers),
+                "gateways": 2,
+                "transport": "tcp",
+                "failover_ms_median": round(float(np.median(fo_ms)), 1),
+                "failover_ms_max": round(float(np.max(fo_ms)), 1),
+                "reconnects": int(reconnects),
+                "moved_receipts": int(moved),
+                "resumed_sessions": int(resumed),
+                "contract_ok": ok,
+            }
+        )
+    return rows
+
+
 if __name__ == "__main__":
     import json
 

@@ -181,21 +181,29 @@ def decode_samples(meta: dict, payload: bytes) -> np.ndarray:
     )
 
 
-def encode_chunk_batch(items) -> tuple[dict, bytes]:
+def encode_chunk_batch(items, offsets=None) -> tuple[dict, bytes]:
     """Multi-session push codec — one frame per delivery round instead
     of one RPC per session chunk: per-chunk ``{sid, n, c}`` dicts in
     the meta list (the ``push`` record's fields), the float32 sample
     rows concatenated in the payload in delivery order.  The meta's
     ``s`` (session count) and the frame's payload length are exactly
     what the gateway's edge admission reads from the header — a shed
-    frame is refused before this payload is ever decoded."""
+    frame is refused before this payload is ever decoded.
+
+    ``offsets`` (optional, parallel to ``items``) stamps each chunk
+    with ``o``: the session-stream sample offset of the chunk's FIRST
+    row.  The gateway compares ``o`` against the workers'
+    ``watermark(sid)`` to drop already-delivered rows idempotently —
+    the dedup that makes a client's post-reconnect re-send lossless
+    instead of double-counted."""
     metas: list = []
     chunks: list = []
-    for sid, samples in items:
+    for i, (sid, samples) in enumerate(items):
         arr = np.ascontiguousarray(samples, np.float32)
-        metas.append(
-            {"sid": sid, "n": int(arr.shape[0]), "c": int(arr.shape[1])}
-        )
+        em = {"sid": sid, "n": int(arr.shape[0]), "c": int(arr.shape[1])}
+        if offsets is not None:
+            em["o"] = int(offsets[i])
+        metas.append(em)
         chunks.append(arr.tobytes())
     return {"chunks": metas, "s": len(metas)}, b"".join(chunks)
 

@@ -234,6 +234,13 @@ class FleetStats:
         self.shadow_windows = 0
         self.shadow_errors = 0
         self.scored_by_version: dict[str, int] = {}
+        # edge identity (har_tpu.serve.net.ingest): per-tenant frame
+        # accept/shed counts from the gateway's admission ladder — the
+        # fairness policy's observable (a storming tenant's sheds grow,
+        # a protected tenant's stay zero), persisted like every other
+        # dict counter
+        self.tenant_accepts: dict[str, int] = {}
+        self.tenant_sheds: dict[str, int] = {}
         # pipelined dispatch (har_tpu.serve.dispatch): host-assembly
         # time that ran UNDER an in-flight device batch, total ticket
         # in-flight time (launch end → retire fetch done), the in-flight
@@ -351,6 +358,17 @@ class FleetStats:
             self.scored_by_version.get(version, 0) + n
         )
 
+    def note_tenant_accept(self, tenant: str) -> None:
+        """One push frame from ``tenant`` admitted at the edge."""
+        self.tenant_accepts[tenant] = (
+            self.tenant_accepts.get(tenant, 0) + 1
+        )
+
+    def note_tenant_shed(self, tenant: str) -> None:
+        """One push frame from ``tenant`` refused (with a receipt) at
+        the edge — the fairness ladder's declared refusal."""
+        self.tenant_sheds[tenant] = self.tenant_sheds.get(tenant, 0) + 1
+
     def note_shadow(self, n_windows: int, ms: float) -> None:
         self.shadow_batches += 1
         self.shadow_windows += n_windows
@@ -455,6 +473,8 @@ class FleetStats:
             "replication_lag_bytes": dict(self.replication_lag_bytes),
             "unknown_state_keys": self.unknown_state_keys,
             "scored_by_version": dict(self.scored_by_version),
+            "tenant_accepts": dict(self.tenant_accepts),
+            "tenant_sheds": dict(self.tenant_sheds),
             "fused_dispatches": self.fused_dispatches,
             "fetch_bytes": self.fetch_bytes,
             "fetch_bytes_saved": self.fetch_bytes_saved,
@@ -501,6 +521,7 @@ class FleetStats:
     # _COUNTERS/_STAGES within it) as an unknown key and warns.
     _STATE_KEYS = (
         "counters", "dropped", "batch_sizes", "scored_by_version",
+        "tenant_accepts", "tenant_sheds",
         "overlap_host_ms", "inflight_ms", "inflight_depth",
         "device_windows", "migration_ms", "stages",
     )
@@ -515,6 +536,8 @@ class FleetStats:
             "dropped": dict(self.dropped),
             "batch_sizes": {str(k): v for k, v in self.batch_sizes.items()},
             "scored_by_version": dict(self.scored_by_version),
+            "tenant_accepts": dict(self.tenant_accepts),
+            "tenant_sheds": dict(self.tenant_sheds),
             "overlap_host_ms": self.overlap_host_ms,
             "inflight_ms": self.inflight_ms,
             "migration_ms": self.migration_ms,
@@ -580,6 +603,16 @@ class FleetStats:
         self.scored_by_version = {
             str(k): int(v)
             for k, v in (state.get("scored_by_version") or {}).items()
+        }
+        # pre-tenant state dicts lack the edge identity counters: the
+        # zero default IS the back-compat contract (test-pinned)
+        self.tenant_accepts = {
+            str(k): int(v)
+            for k, v in (state.get("tenant_accepts") or {}).items()
+        }
+        self.tenant_sheds = {
+            str(k): int(v)
+            for k, v in (state.get("tenant_sheds") or {}).items()
         }
         for name, st in (state.get("stages") or {}).items():
             if name in self._STAGES:

@@ -304,6 +304,21 @@ def _wire_ingest_smoke() -> dict:
     return _run_smoke("har_tpu.serve.net.smoke", "wire_ingest_smoke")
 
 
+def _gateway_ha_smoke() -> dict:
+    """Gateway HA smoke verdict (PR 19, har_tpu.serve.net.gateway +
+    election): an elected gateway PAIR over one lease directory, two
+    tenant cohorts pushing through reconnecting HA clients, the ACTIVE
+    gateway SIGKILLed mid-run — the standby must take the lease and
+    every client must resume from the workers' watermarks with the
+    scored stream bit-identical to the un-killed in-process run
+    (``windows_lost == 0``); then a one-tenant storm must be refused
+    with a declared receipt while the protected tenant sees zero edge
+    sheds and the edge ledger's per-tenant slices sum to its globals;
+    the stamp carries ``{gateways, failover_ms, resumed_sessions,
+    tenant_sheds, windows_lost}``."""
+    return _run_smoke("har_tpu.serve.net.smoke", "gateway_ha_smoke")
+
+
 def _host_plane_smoke() -> dict:
     """Host-plane smoke verdict (PR 12, the SoA session estate):
     batched-vs-sequential ingest bit-identity at N=64 with mid-chunk
@@ -443,6 +458,7 @@ def main(argv=None) -> int:
     ship = None
     ingest = None
     replication = None
+    gateway_ha = None
     if args.counts_only:
         # carry the previous run's fleet + pipeline + adapt + recovery
         # + cluster + harlint verdicts forward: a counts-only refresh
@@ -462,6 +478,7 @@ def main(argv=None) -> int:
             ship = prior.get("journal_ship")
             ingest = prior.get("wire_ingest")
             replication = prior.get("replication")
+            gateway_ha = prior.get("gateway_ha")
         except (OSError, ValueError):
             fleet = None
             pipeline = None
@@ -475,6 +492,7 @@ def main(argv=None) -> int:
             ship = None
             ingest = None
             replication = None
+            gateway_ha = None
     if not args.counts_only:
         # static-analysis gate first: harlint is sub-second (pure ast,
         # no jax backend) and a broken fleet invariant must refuse the
@@ -638,6 +656,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # gateway HA gate: the front door's own failover — an elected
+        # gateway pair, the ACTIVE one SIGKILLed mid-delivery, clients
+        # reconnecting and resuming from worker watermarks, plus the
+        # tenant-fair refusal of a one-tenant storm, stamping
+        # {gateways, failover_ms, resumed_sessions, tenant_sheds,
+        # windows_lost}
+        gateway_ha = _gateway_ha_smoke()
+        if not gateway_ha.get("ok"):
+            print(
+                "\nrelease_gate: RED gateway HA smoke "
+                f"({json.dumps(gateway_ha)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
 
     sync_counts(smoke, total, check_only=False)
     GATE_LOG.parent.mkdir(exist_ok=True)
@@ -659,6 +691,7 @@ def main(argv=None) -> int:
                 "journal_ship": ship,
                 "wire_ingest": ingest,
                 "replication": replication,
+                "gateway_ha": gateway_ha,
                 "git_head": _git_head(),
                 "captured_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -702,6 +735,9 @@ def main(argv=None) -> int:
                 ),
                 "replication_ok": (
                     None if replication is None else replication["ok"]
+                ),
+                "gateway_ha_ok": (
+                    None if gateway_ha is None else gateway_ha["ok"]
                 ),
                 "log": str(GATE_LOG.relative_to(REPO)),
             }

@@ -85,7 +85,7 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
         "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
         "fleet_recovery", "cluster_failover", "wire_failover",
-        "journal_ship", "wire_ingest", "elastic_traffic",
+        "journal_ship", "wire_ingest", "gateway_ha", "elastic_traffic",
         "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
@@ -296,6 +296,34 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == ingest["ack_coalesce_ratio"]
         )
         assert extra["wire_ingest_contract_ok"] is True
+    # r19 gateway-HA lane: kill the active gateway of an elected pair
+    # mid-delivery at each session count — failover-to-first-accepted-
+    # frame latency, with contract_ok pinning zero windows lost and a
+    # scored stream bit-identical to the un-killed in-process run; or
+    # a deadline-skip marker; never silently absent
+    ha = extra["lanes"]["gateway_ha"]
+    if "skipped" not in ha:
+        assert ha["transport"] == "tcp"
+        assert ha["gateways"] == 2
+        assert ha["contract_ok"] is True
+        assert ha["failover_ms_median"] > 0
+        assert ha["resumed_sessions"] >= 1
+        for row in ha["rows"]:
+            assert row["gateways"] == 2
+            assert row["failover_ms_median"] > 0
+            assert row["reconnects"] + row["moved_receipts"] >= 1
+            assert row["resumed_sessions"] >= 1
+            assert row["contract_ok"] is True
+        assert "chip_state_probe" in ha
+        assert (
+            extra["gateway_ha_failover_ms_median"]
+            == ha["failover_ms_median"]
+        )
+        assert (
+            extra["gateway_ha_resumed_sessions"]
+            == ha["resumed_sessions"]
+        )
+        assert extra["gateway_ha_contract_ok"] is True
     # r14 elastic-traffic lane: the autoscaled diurnal swing vs the
     # static floor/ceiling configurations under the deterministic
     # dispatch-cost model — the adaptive run must beat the best static

@@ -1,0 +1,206 @@
+"""Gateway high availability (PR 19): the elected gateway pair, the
+lossless reconnecting client, and the failover kill matrix.
+
+Three layers, tested bottom-up:
+
+  - ``LeaderLease.release`` — the graceful-drain primitive: an early
+    lease release is fenced exactly like ``renew`` (a deposed leader
+    cannot release its successor's lease), and it expires the lease
+    IMMEDIATELY so the standby's next campaign wins without waiting
+    out the TTL.
+  - ``HAGatewayClient`` — redial pacing rides ``utils/backoff``
+    verbatim (capped exponential, seeded jitter, ``reset()`` on the
+    first successful frame), a ``{"moved": addr}`` receipt retargets
+    WITHOUT a backoff sleep (the receipt is a redirect, not a
+    failure), and a deposed leader's late ack (stale ``gen``) is
+    rejected and re-delivered.
+  - ``run_gateway_kill_point`` — the matrix: the ACTIVE gateway of a
+    real subprocess pair killed at each ``GATEWAY_KILL_POINTS`` stage
+    boundary (plus the graceful ``drain`` cell), and the scored
+    stream must come out bit-identical to an un-killed in-process
+    run with zero windows lost — the front door moving costs nothing.
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+import pytest
+
+from har_tpu.serve.chaos import GATEWAY_KILL_POINTS
+from har_tpu.serve.net.chaos import run_gateway_kill_point
+from har_tpu.serve.net.client import HAGatewayClient
+from har_tpu.serve.net.election import LeaderLease
+from har_tpu.serve.net.rpc import RpcConnectionRefused
+from har_tpu.utils.backoff import Backoff, BackoffPolicy
+
+
+# ----------------------------------------------------- lease release
+
+
+def test_lease_release_is_fenced_and_immediate():
+    clock = {"t": 1000.0}
+    wall = lambda: clock["t"]  # noqa: E731
+    with tempfile.TemporaryDirectory() as root:
+        lease = LeaderLease(root, lease_s=10.0, wall=wall)
+        gen_a = lease.campaign("A")
+        assert gen_a == 1 and lease.holder() == "A"
+        # fencing: a non-holder cannot release, nor can a stale
+        # generation — the exact refusal rules renew has
+        assert not lease.release("B", gen_a)
+        assert lease.holder() == "A"
+        # the real release expires the lease NOW: no TTL wait — the
+        # standby's very next campaign wins
+        assert lease.release("A", gen_a)
+        assert lease.holder() is None
+        gen_b = lease.campaign("B")
+        assert gen_b == 2 and lease.holder() == "B"
+        # the deposed leader's LATE release (a drain racing its own
+        # replacement) must not touch the successor's lease
+        assert not lease.release("A", gen_a)
+        assert lease.holder() == "B"
+        assert lease.renew("B", gen_b)
+
+
+# ------------------------------------------- HA client, scripted wire
+
+
+class _ScriptedRpc:
+    """Stands in for RpcClient: answers from a script of responses and
+    exceptions, recording every dial the client makes."""
+
+    def __init__(self):
+        self.script: list = []
+        self.dials: list = []
+        self.calls: list = []
+
+    def call(self, method, meta=None, payload=b""):
+        self.calls.append(method)
+        if self.script:
+            item = self.script.pop(0)
+            if isinstance(item, Exception):
+                raise item
+            return dict(item), b""
+        return {"id": 0, "r": 0, "hop": 50}, b""
+
+    def close(self):
+        pass
+
+
+class _FakeHAClient(HAGatewayClient):
+    """HAGatewayClient over the scripted transport: ``_dial`` installs
+    the shared fake instead of opening a socket, and sleeps are
+    swallowed so the pinned evidence is ``redial_delays_ms`` itself."""
+
+    def __init__(self, fake, **kw):
+        self._fake = fake
+        kw.setdefault("sleep", lambda s: None)
+        super().__init__(["a:1", "b:2"], **kw)
+
+    def _dial(self, host, port):
+        self._fake.dials.append((host, int(port)))
+        self._client = self._fake
+
+
+def test_redial_backoff_paces_capped_exponential_and_resets():
+    fake = _ScriptedRpc()
+    c = _FakeHAClient(
+        fake,
+        reconnect=BackoffPolicy(
+            base_ms=10.0, cap_ms=40.0, factor=2.0, jitter=0.0
+        ),
+    )
+    # five refusals, then the frame lands: the delays must walk the
+    # capped exponential exactly — 10, 20, 40, 40, 40
+    fake.script = [RpcConnectionRefused("down")] * 5
+    c._call("push_many", {"s": 1})
+    assert c.redial_delays_ms == [10.0, 20.0, 40.0, 40.0, 40.0]
+    assert c.reconnects == 5 and c.failover_episodes == 1
+    # the success RESET the schedule: the next episode restarts at the
+    # base delay, not where the last one left off
+    fake.script = [RpcConnectionRefused("down")] * 2
+    c._call("push_many", {"s": 1})
+    assert c.redial_delays_ms[5:] == [10.0, 20.0]
+    assert c.failover_episodes == 2
+    # each failed attempt rotated to the OTHER configured address —
+    # the client never hammers one dead gateway
+    hosts = [h for h, _ in fake.dials[1:]]  # [0] is the initial dial
+    assert set(hosts) == {"a", "b"}
+
+
+def test_redial_jitter_rides_utils_backoff_verbatim():
+    policy = BackoffPolicy(
+        base_ms=10.0, cap_ms=500.0, factor=2.0, jitter=0.25
+    )
+    fake = _ScriptedRpc()
+    c = _FakeHAClient(fake, reconnect=policy, seed=7)
+    fake.script = [RpcConnectionRefused("down")] * 4
+    c._call("push_many", {"s": 1})
+    expect = Backoff(policy, seed=7)
+    assert c.redial_delays_ms == [expect.next_ms() for _ in range(4)]
+
+
+def test_moved_receipt_retargets_without_a_backoff_sleep():
+    fake = _ScriptedRpc()
+    c = _FakeHAClient(fake)
+    # the standby's declared refusal carries the leader's address: the
+    # client follows it IMMEDIATELY — a redirect is not a failure, so
+    # no delay is drawn and no thundering herd builds at a lease flip
+    fake.script = [{"moved": "b:2"}]
+    c._call("push_many", {"s": 1})
+    assert c.moved_receipts == 1
+    assert c.redial_delays_ms == []
+    assert fake.dials[-1] == ("b", 2)
+    assert c.failover_episodes == 1
+    # a receipt WITHOUT an address (election still in flight) degrades
+    # to the rotate-under-backoff path
+    fake.script = [{"moved": None}]
+    c._call("push_many", {"s": 1})
+    assert c.moved_receipts == 2
+    assert len(c.redial_delays_ms) == 1
+
+
+def test_stale_generation_ack_is_rejected_and_redelivered():
+    fake = _ScriptedRpc()
+    c = _FakeHAClient(fake)
+    fake.script = [{"id": 0, "r": 1, "gen": 2}]
+    c._call("push_many", {"s": 1})
+    assert c.gen == 2
+    # a deposed leader's late ack rides a smaller generation: the
+    # fence rejects it and the frame is re-delivered — the ack a
+    # client trusts always comes from the real leader
+    fake.script = [
+        {"id": 0, "r": 1, "gen": 1},
+        {"id": 0, "r": 1, "gen": 2},
+    ]
+    resp, _ = c._call("push_many", {"s": 1})
+    assert resp["gen"] == 2
+    assert c.stale_acks_rejected == 1
+    assert c.gen == 2
+
+
+# ------------------------------------------------- the failover matrix
+
+
+@pytest.mark.parametrize("point", GATEWAY_KILL_POINTS + ("drain",))
+def test_gateway_kill_matrix(point):
+    """THE acceptance pin: the active gateway of a REAL subprocess
+    pair dies at each of its stage boundaries mid-delivery (the
+    ``drain`` cell restarts it gracefully instead), the standby takes
+    the lease, the HA client reconnects and resumes from the workers'
+    watermarks — zero windows lost, the scored stream bit-identical
+    to the un-killed in-process run, conservation balanced.  The
+    drain cell's verdict is the SAME bar: a planned restart is
+    indistinguishable from a crash, minus the detection wait and plus
+    a clean exit code."""
+    out = run_gateway_kill_point(point)
+    assert out["ok"], (point, out["why"])
+    assert out["windows_lost"] == 0
+    assert out["gateways"] == 2
+    assert out["lease_gen"] >= 2
+    assert out["resumed_sessions"] >= 1
+    assert out["reconnects"] + out["moved_receipts"] >= 1
+    if point == "drain":
+        assert out["gateway_exit"] == 0  # graceful: the grace window
+    else:
+        assert out["gateway_exit"] == 137  # the chaos plan's hard exit

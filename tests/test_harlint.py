@@ -412,6 +412,8 @@ def test_hl003_acceptance_real_recover_minus_lost_handler():
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
         "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -443,6 +445,8 @@ def test_hl003_acceptance_cluster_handoff_handler_and_kill_points():
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
         "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -513,6 +517,8 @@ def test_hl003_acceptance_ship_records_and_ship_kill_points():
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
         "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -569,6 +575,8 @@ def test_hl003_acceptance_acks_handler_and_retirement_pins():
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
         "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -655,6 +663,8 @@ def test_hl003_acceptance_tail_records_and_tail_kill_points():
         "har_tpu/serve/cluster/controller.py",
         "har_tpu/serve/net/ship.py",
         "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
         "har_tpu/adapt/swap.py",
     ):
         sources[rel] = (REPO / rel).read_text()
@@ -695,6 +705,80 @@ def test_hl003_acceptance_tail_records_and_tail_kill_points():
     )
     assert "'mid_tail_recv'" in msgs2
     assert "absent from the chaos matrix" in msgs2
+
+
+def test_hl003_acceptance_gateway_moved_receipt_and_kill_points():
+    """The edge-HA extension of the acceptance mutation: the gateway
+    pair declares GATEWAY_KILL_POINTS and answers ``{"moved": ...}``
+    receipts the HA client must handle.  Dropping a stage boundary
+    from the declared matrix, deleting the client's moved-receipt
+    handler, or deleting the standby's receipt writer must each fail
+    the gate — both directions of the moved bijection are load-bearing
+    (a silent standby strands every client of a flipped lease)."""
+    sources = {}
+    for rel in (
+        "har_tpu/serve/engine.py",
+        "har_tpu/serve/recover.py",
+        "har_tpu/serve/chaos.py",
+        "har_tpu/serve/journal.py",
+        "har_tpu/serve/cluster/controller.py",
+        "har_tpu/serve/net/ship.py",
+        "har_tpu/serve/net/tail.py",
+        "har_tpu/serve/net/gateway.py",
+        "har_tpu/serve/net/client.py",
+        "har_tpu/adapt/swap.py",
+    ):
+        sources[rel] = (REPO / rel).read_text()
+    assert lint_sources(sources, [JournalExhaustivenessRule()]) == []
+    # (1) dropping mid_frame_recv from the declared gateway matrix
+    # leaves the admission hook's kill site un-exercised — flagged
+    mutated = dict(sources)
+    mutated["har_tpu/serve/chaos.py"] = sources[
+        "har_tpu/serve/chaos.py"
+    ].replace('    "mid_frame_recv",\n', "")
+    assert (
+        mutated["har_tpu/serve/chaos.py"]
+        != sources["har_tpu/serve/chaos.py"]
+    )
+    msgs = " | ".join(
+        f.message
+        for f in lint_sources(mutated, [JournalExhaustivenessRule()])
+    )
+    assert "'mid_frame_recv'" in msgs
+    assert "absent from the chaos matrix" in msgs
+    # (2) deleting the HA client's moved-receipt handler orphans the
+    # standby's declared refusal: the receipt is written but nothing
+    # follows it — clients would spin on the deposed address forever
+    mutated2 = dict(sources)
+    mutated2["har_tpu/serve/net/client.py"] = (
+        sources["har_tpu/serve/net/client.py"]
+        .replace('"moved" in resp', '"m0ved" in resp')
+        .replace('resp.get("moved")', 'resp.get("m0ved")')
+    )
+    assert (
+        mutated2["har_tpu/serve/net/client.py"]
+        != sources["har_tpu/serve/net/client.py"]
+    )
+    msgs2 = " | ".join(
+        f.message
+        for f in lint_sources(mutated2, [JournalExhaustivenessRule()])
+    )
+    assert "no client-side handler" in msgs2
+    # (3) the writer side is load-bearing the same way: a standby that
+    # stops answering moved receipts is a silent hangup in disguise
+    mutated3 = dict(sources)
+    mutated3["har_tpu/serve/net/gateway.py"] = sources[
+        "har_tpu/serve/net/gateway.py"
+    ].replace('{"moved": self._leader_addr()}', '{"m0ved": None}')
+    assert (
+        mutated3["har_tpu/serve/net/gateway.py"]
+        != sources["har_tpu/serve/net/gateway.py"]
+    )
+    msgs3 = " | ".join(
+        f.message
+        for f in lint_sources(mutated3, [JournalExhaustivenessRule()])
+    )
+    assert '"moved"-receipt handler exists here but nothing' in msgs3
 
 
 # --------------------------------------------------------------- HL004

@@ -35,7 +35,11 @@ from har_tpu.serve.cluster import ClusterConfig, FleetCluster
 from har_tpu.serve.journal import _HDR
 from har_tpu.serve.loadgen import AnalyticDemoModel
 from har_tpu.serve.net.gateway import GatewayClient, IngestGateway
-from har_tpu.serve.net.ingest import EdgeAdmission, IngestConfig
+from har_tpu.serve.net.ingest import (
+    EdgeAdmission,
+    IngestConfig,
+    TenantViolation,
+)
 from har_tpu.serve.net.rpc import RpcClient, RpcServer
 from har_tpu.serve.net.wire import (
     MAX_FRAME_BYTES,
@@ -584,3 +588,219 @@ def test_gateway_batched_frames_score_bit_identical_to_inprocess(
     assert stats["shed_frames"] == 0
     assert acct_gw["enqueued"] == acct_bat["enqueued"]
     assert acct_gw["balanced"] and acct_gw["pending"] == 0
+
+
+# --------------------------------- tenant identity + weighted ladders
+
+
+def test_tenant_ladders_ride_weighted_shares():
+    """Each tenant walks the ladder against its OWN weighted share of
+    the backlog budget: the storming tenant crosses its hard share and
+    is refused while the protected (high-weight) tenant stays at level
+    0 and keeps landing frames — weighted fairness, not head-of-line
+    collapse."""
+    adm = EdgeAdmission(
+        IngestConfig(
+            soft_backlog=40, hard_backlog=80,
+            tenants=(("care", 3.0), ("bulk", 1.0)),
+        )
+    )
+    # shares: bulk 1/4 (soft 10 / hard 20), care 3/4 (soft 30 / hard 60)
+    adm.note_enqueued(20, "bulk")
+    assert adm.tenant_level("bulk") == 2
+    assert adm.tenant_level("care") == 0
+    assert adm.level == 0  # globally quiet: the storm is bulk's alone
+    assert adm.admit({"s": 1, "wm": 0, "tn": "bulk"}, 10) == "hard_backlog"
+    assert adm.admit({"s": 1, "wm": 5, "tn": "care"}, 10) is None
+    # draining below the hard share recovers to level 1: wm-aligned
+    # frames land, lagging catch-up traffic is the first to go
+    adm.note_retired(5, "bulk")
+    assert adm.tenant_level("bulk") == 1
+    assert adm.admit({"s": 1, "wm": 10, "tn": "bulk"}, 10) is None
+    assert adm.admit({"s": 1, "wm": 5, "tn": "bulk"}, 10) == "soft_backlog"
+    # below the soft share the tenant ladder is fully open again
+    adm.note_retired(10, "bulk")
+    assert adm.tenant_level("bulk") == 0
+    assert adm.admit({"s": 1, "wm": 5, "tn": "bulk"}, 10) is None
+    # the quiet tenant never saw a shed
+    snap = adm.snapshot()
+    assert snap["tenants"]["care"]["shed_frames"] == 0
+    assert snap["tenants"]["bulk"]["shed_frames"] == 2
+
+
+def test_snapshot_slices_sum_to_globals():
+    """The edge conservation law, tenant edition: after EVERY admission
+    decision the per-tenant slices' counters sum to the globals — per
+    reason too — so the ledger can never lose a frame between the
+    identity axis and the total."""
+    adm = EdgeAdmission(
+        IngestConfig(
+            soft_backlog=8, hard_backlog=16, max_frame_sessions=4,
+            max_frame_bytes=100, tenants=(("care", 3.0), ("bulk", 1.0)),
+        )
+    )
+
+    def check():
+        snap = adm.snapshot()
+        for k in (
+            "admitted_frames", "admitted_sessions", "admitted_bytes",
+            "shed_frames", "shed_sessions", "shed_bytes",
+        ):
+            assert sum(
+                s[k] for s in snap["tenants"].values()
+            ) == snap[k], k
+        merged: dict = {}
+        for s in snap["tenants"].values():
+            for r, c in s["shed_by_reason"].items():
+                merged[r] = merged.get(r, 0) + c
+        assert merged == snap["shed_by_reason"]
+
+    adm.note_enqueued(4, "bulk")  # bulk hard share (16/4) reached
+    frames = [
+        ({"s": 2, "wm": 0, "tn": "care"}, 50, None),
+        ({"s": 9, "wm": 0, "tn": "care"}, 10, "frame_sessions"),
+        ({"s": 2, "wm": 0, "tn": "bulk"}, 500, "frame_bytes"),
+        ({"s": 2, "wm": 0, "tn": "bulk"}, 50, "hard_backlog"),
+        ({"s": 1, "wm": 10, "tn": "care"}, 30, None),
+    ]
+    for meta, plen, want in frames:
+        assert adm.admit(meta, plen) == want
+        check()
+
+
+def test_unidentified_frames_die_with_no_receipt(tmp_path):
+    """With a tenant table configured, a push frame whose tenant id is
+    missing or unknown is a PROTOCOL VIOLATION, not a shed: the unit
+    surface raises ``TenantViolation``, and over the wire the
+    connection hangs up with no receipt and no ledger trace — the same
+    fate as a garbled header, so an unauthenticated sender learns
+    nothing about the gateway's policy."""
+    adm = EdgeAdmission(IngestConfig(tenants=(("care", 1.0),)))
+    with pytest.raises(TenantViolation):
+        adm.resolve_tenant({"s": 1, "wm": 0})
+    with pytest.raises(TenantViolation):
+        adm.admit({"s": 1, "wm": 0, "tn": "mallory"}, 10)
+    # without a table identity is not enforced: the default slice
+    assert EdgeAdmission().resolve_tenant({}) == "default"
+
+    cluster, gw = _gateway_fixture(
+        tmp_path, IngestConfig(tenants=(("care", 1.0),)), n_sessions=1
+    )
+    try:
+        meta, payload = _chunk_frame(n_sessions=1, tn="mallory", wm=40)
+        frame = encode_frame(
+            {**meta, "m": "push_many", "id": 1, "cid": "liar.tn"},
+            payload,
+        )
+        liar = socket.create_connection((gw.rpc.host, gw.rpc.port))
+        try:
+            gw.rpc.step(0.02)  # accept
+            liar.sendall(frame)
+            for _ in range(5):
+                gw.rpc.step(0.02)
+            liar.settimeout(2.0)
+            assert liar.recv(1 << 16) == b""  # hangup, not a receipt
+        finally:
+            liar.close()
+        # no trace anywhere: not a shed, not an admit, nothing staged
+        snap = gw.admission.snapshot()
+        assert snap["shed_frames"] == 0
+        assert snap["admitted_frames"] == 0
+        assert snap["tenants"] == {}
+        assert gw.rounds == 0
+        assert cluster.accounting()["enqueued"] == 0
+    finally:
+        gw.close()
+        cluster.close()
+
+
+# ------------------------------------ reconnect replay dedup at edge
+
+
+def test_replayed_rows_below_watermark_trim_idempotently(tmp_path):
+    """The lossless-reconnect half of edge HA, in-process edition: a
+    reconnecting client re-sends its buffered chunks with their stream
+    offsets; rows below the workers' delivery watermark are trimmed at
+    the edge with a ``dd`` receipt, rows above land once — the scored
+    stream is bit-identical to an unbroken run."""
+    rng = np.random.default_rng(11)
+    rows = rng.normal(size=(150, 3)).astype(np.float32)
+
+    # the unbroken reference
+    ref_cluster, ref_gw = _gateway_fixture(tmp_path / "ref")
+    ref_pump = _Pump(ref_gw.rpc)
+    ref = GatewayClient(ref_gw.rpc.host, ref_gw.rpc.port)
+    try:
+        ref.add_session(0)
+        ref_events = []
+        for start in range(0, 150, 50):
+            ref.push(0, rows[start : start + 50])
+            ref_events.extend(ref.poll(force=True))
+        ref_events.extend(ref.flush())
+    finally:
+        ref.close()
+        ref_pump.close()
+        ref_cluster.close()
+
+    # the replayed run: 100 rows land normally, then a reconnect-style
+    # replay re-sends the WHOLE stream from offset 0
+    cluster, gw = _gateway_fixture(tmp_path / "re")
+    pump = _Pump(gw.rpc)
+    client = GatewayClient(gw.rpc.host, gw.rpc.port)
+    try:
+        client.add_session(0)
+        events = []
+        for start in range(0, 100, 50):
+            client.push(0, rows[start : start + 50])
+            events.extend(client.poll(force=True))
+        assert client.watermark(0) == 100
+        meta, payload = encode_chunk_batch([(0, rows)], offsets=[0])
+        meta["wm"] = 150
+        resp, _ = client._client.call("push_many", meta, payload)
+        # 100 already-delivered rows trimmed, 50 new rows staged once
+        assert "shed" not in resp
+        assert resp["dd"] == 100 and resp["r"] == 1
+        events.extend(client.poll(force=True))
+        events.extend(client.flush())
+        acct = client.accounting()
+    finally:
+        client.close()
+        pump.close()
+        cluster.close()
+
+    assert _by_session(ref_events) == _by_session(events)
+    assert len(ref_events) == 2  # windows at samples 100 and 150
+    assert acct["enqueued"] == 2  # the replay double-staged NOTHING
+    assert acct["balanced"] and acct["pending"] == 0
+
+
+def test_client_offsets_roll_back_on_shed(tmp_path):
+    """Offsets count DELIVERED samples only: a shed frame's rows never
+    occupied delivery positions, so the client rolls its cursors back
+    and the stream's next samples take them — client offsets and
+    worker watermarks stay in one coordinate system across refusals."""
+    cluster, gw = _gateway_fixture(
+        tmp_path, IngestConfig(max_frame_bytes=2048)
+    )
+    pump = _Pump(gw.rpc)
+    client = GatewayClient(gw.rpc.host, gw.rpc.port)
+    try:
+        client.add_session(0)
+        client.push(0, np.zeros((300, 3), np.float32))  # 3600 B > 2048
+        client.poll(force=True)
+        assert client.shed_by_reason == {"frame_bytes": 1}
+        assert client.shed_samples == 300
+        assert client._off[0] == 0  # rolled back: nothing delivered
+        client.push(0, np.ones((100, 3), np.float32))
+        client.poll(force=True)
+        client.flush()
+        assert client._off[0] == 100
+        assert client.windows_enqueued == 1
+        assert client.deduped_samples == 0  # rollback, not dedup
+        assert client.watermark(0) == 100
+        acct = client.accounting()
+        assert acct["balanced"] and acct["enqueued"] == 1
+    finally:
+        client.close()
+        pump.close()
+        cluster.close()

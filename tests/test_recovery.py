@@ -709,6 +709,39 @@ def test_stats_load_state_warns_and_counts_unknown_keys():
         FleetStats().load_state(json.loads(json.dumps(s.state())))
 
 
+def test_stats_tenant_counters_roundtrip_and_pre_tenant_defaults():
+    """The edge identity axis is durable observability: per-tenant
+    accept/shed counters survive the state()/load_state round-trip via
+    JSON, and a PRE-TENANT state dict (written before the edge carried
+    identity) loads with empty-dict defaults — no warning, no phantom
+    tenants."""
+    s = FleetStats()
+    s.note_tenant_accept("care")
+    s.note_tenant_accept("care")
+    s.note_tenant_accept("bulk")
+    s.note_tenant_shed("bulk")
+    state = json.loads(json.dumps(s.state()))
+    s2 = FleetStats()
+    s2.load_state(state)
+    assert s2.tenant_accepts == {"care": 2, "bulk": 1}
+    assert s2.tenant_sheds == {"bulk": 1}
+    # the round-trip is idempotent through the snapshot surface too
+    assert s2.state()["tenant_accepts"] == state["tenant_accepts"]
+    # pre-tenant dict: the keys absent entirely — zero defaults, and a
+    # silent load (an old journal is not a forward-compat event)
+    old = json.loads(json.dumps(state))
+    old.pop("tenant_accepts")
+    old.pop("tenant_sheds")
+    s3 = FleetStats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        s3.load_state(old)
+    assert s3.tenant_accepts == {} and s3.tenant_sheds == {}
+    assert s3.accounting()["balanced"]
+
+
 def test_cli_serve_journal_kill_and_resume(tmp_path, capsys):
     """Acceptance: `har serve --journal DIR --resume` survives a
     mid-run kill end to end — the resumed run recovers, re-delivers

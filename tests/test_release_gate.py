@@ -313,6 +313,44 @@ def test_gate_log_carries_replication_verdict():
     assert replication["failover_ms"] >= 0
 
 
+def test_gate_log_carries_gateway_ha_verdict():
+    """The edge-HA counterpart of the replication verdict (PR 19,
+    har_tpu.serve.net.gateway pair + election): the gate log must
+    carry a green gateway-HA check with the {gateways, failover_ms,
+    resumed_sessions, tenant_sheds, windows_lost} stamp — the active
+    gateway of an elected pair SIGKILLed mid-delivery, the standby
+    takes the lease, every client reconnects and resumes from the
+    workers' watermarks bit-identically, and a one-tenant storm at the
+    byte ceiling is refused while the protected tenant takes zero edge
+    sheds."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    ha = log.get("gateway_ha")
+    assert ha, (
+        "artifacts/test_gate.json lacks the gateway_ha verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "gateways",
+        "failover_ms",
+        "resumed_sessions",
+        "tenant_sheds",
+        "windows_lost",
+    ):
+        assert key in ha
+    assert ha["ok"] is True
+    assert ha["transport"] == "tcp"
+    assert ha["gateways"] == 2
+    assert ha["windows_lost"] == 0
+    assert ha["failover_ms"] >= 0
+    assert ha["resumed_sessions"] >= 1
+    # weighted fairness at the edge: the storming tenant was refused,
+    # the protected tenant never saw a shed
+    assert ha["tenant_sheds"]["bulk"] >= 1
+    assert ha["tenant_sheds"]["care"] == 0
+
+
 def test_gate_log_carries_elastic_smoke_verdict():
     """The elastic counterpart of the cluster verdict: the gate log
     must carry a green elastic-traffic check with the {swing, resizes,
