@@ -1107,6 +1107,100 @@ def main() -> None:
         "fleet_pipeline_grid", 35, _pipeline_grid_lane
     )
 
+    # Model-parallel grid (PR 20, har_tpu.parallel.rules +
+    # ModelParallelScorer): the 2D (batch × model) mesh cells.  Two
+    # claims — the ~85 MB wide-transformer checkpoint (past the 64 MiB
+    # emulated per-device budget, impossible batch-only) serves
+    # label-identically to the single device with its per-device
+    # footprint split 4-way, and the small-model 2x4 cell holds >=0.8x
+    # the equal-device 8x1 batch-sharded windows/s.  Every cell runs in
+    # a subprocess with the dry-run device count forced (the shared
+    # run_model_parallel_cell_subprocess — same reason as the pipeline
+    # grid's mesh cell); a dead cell is a recorded marker, never a lost
+    # bench run.  scripts/model_parallel_grid_bench.py is the
+    # committed-artifact path over the SAME cell runner.
+    def _model_parallel_grid_lane():
+        from har_tpu.serve.loadgen import (
+            run_model_parallel_cell_subprocess,
+        )
+
+        n_sessions = 128 if smoke else 1000
+        tb_base = 32 if smoke else 256
+        wide_sessions = 4 if smoke else 8
+        budget_bytes = 64 * 2**20
+        common = dict(
+            n_sessions=n_sessions, tunnel_rtt_ms=30.0,
+            n_runs=lane_runs, seed=3,
+        )
+        grid = {}
+        cells = (
+            ("1x1", 1, 1, dict(common, target_batch=tb_base)),
+            ("8x1", 8, 1, dict(common, target_batch=tb_base * 8)),
+            ("2x4", 2, 4, dict(common, target_batch=tb_base * 8)),
+            (
+                "2x4_wide_transformer", 2, 4,
+                dict(
+                    n_sessions=wide_sessions, windows_per_session=1,
+                    target_batch=16, tunnel_rtt_ms=0.0,
+                    n_runs=lane_runs, seed=3, model="wide_transformer",
+                    check_single_device=True,
+                ),
+            ),
+        )
+        for label, dp, tp, kwargs in cells:
+            try:
+                grid[label] = run_model_parallel_cell_subprocess(
+                    dp, tp, kwargs, timeout_s=300,
+                )
+            except Exception as exc:
+                grid[label] = {
+                    "error": f"cell failed: {str(exc)[-300:]}"
+                }
+                print(
+                    f"warning: model_parallel_grid {label} cell "
+                    f"failed: {str(exc)[-300:]}",
+                    file=sys.stderr,
+                )
+        ok_cells = {
+            k: v for k, v in grid.items() if "error" not in v
+        }
+        base = (ok_cells.get("8x1") or {}).get("windows_per_sec_median")
+        mp = (ok_cells.get("2x4") or {}).get("windows_per_sec_median")
+        wide = ok_cells.get("2x4_wide_transformer") or {}
+        return None, {
+            "small_model": "jit_demo_mlp_h256",
+            "wide_model": "wide_transformer_e768_l3",
+            "n_sessions": n_sessions,
+            "n_runs": lane_runs,
+            "grid": grid,
+            "baseline_cell": "8x1",
+            "model_parallel_speedup": (
+                round(mp / base, 2) if base and mp else None
+            ),
+            "emulated_device_budget_bytes": budget_bytes,
+            "fits_one_device": (
+                bool(wide["params_bytes_total"] <= budget_bytes)
+                if wide
+                else None
+            ),
+            "wide_params_bytes_per_device": wide.get(
+                "params_bytes_per_device"
+            ),
+            "wide_served_within_budget": (
+                bool(wide["params_bytes_per_device"] < budget_bytes)
+                if wide
+                else None
+            ),
+            "wide_single_device_equivalent": wide.get(
+                "single_device_equivalent"
+            ),
+            "chip_state_probe": chip_probe,
+        }
+
+    _, model_parallel_stats = deadline_lane(
+        "model_parallel_grid", 40, _model_parallel_grid_lane
+    )
+
     # Adaptive-serving lane (r8 tentpole, har_tpu.adapt): the fleet
     # workload with a FORCED mid-run hot-swap — every session streams
     # half its recording, the serving model is swapped at a dispatch
@@ -1686,6 +1780,15 @@ def main() -> None:
             .get(pipeline_stats.get("mesh_cell") or "", {})
             .get("devices")
         ),
+        # model-parallel grid (har_tpu.parallel.rules): the 2x4
+        # (batch × model) mesh vs the equal-device batch-sharded 8x1,
+        # plus the wide-transformer capability verdict — fits_one_device
+        # False IS the claim (the checkpoint exceeds the emulated
+        # per-device budget and only the model axis serves it)
+        "model_parallel_speedup": model_parallel_stats.get(
+            "model_parallel_speedup"
+        ),
+        "fits_one_device": model_parallel_stats.get("fits_one_device"),
         # adaptive serving (har_tpu.adapt): the fleet numbers across a
         # forced mid-run hot-swap — zero drops is the contract
         "adaptive_windows_per_sec_median": adaptive_stats.get(
@@ -1865,6 +1968,7 @@ def main() -> None:
         "saturation_transformer": sat_stats,
         "fleet_serving": fleet_stats,
         "fleet_pipeline_grid": pipeline_stats,
+        "model_parallel_grid": model_parallel_stats,
         "adaptive_serving": adaptive_stats,
         "fleet_recovery": recovery_stats,
         "cluster_failover": cluster_stats,

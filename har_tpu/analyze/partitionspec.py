@@ -3,9 +3,9 @@ parallel package declares where its arguments live, every axis name a
 ``PartitionSpec`` mentions is a declared mesh axis, and spec builders
 actually shard the >1-D kernels they exist to shard.
 
-This is the static half of the ROADMAP's ``match_partition_rules``
-refactor (the shared train/serve sharding layer): before the rule
-tables exist, the invariants they will encode are already checkable —
+This is the static half of the ``match_partition_rules`` sharding
+layer (the shared train/serve rule tables in ``parallel/rules.py``):
+the invariants the tables encode are checked without importing jax —
 
   1. **specs for all args.**  A ``shard_map(...)`` must declare BOTH
      ``in_specs`` and ``out_specs``; when ``in_specs`` is a literal
@@ -36,6 +36,23 @@ tables exist, the invariants they will encode are already checkable —
      branch — every 2-D kernel falls through to ``P()`` and the model
      silently serves fully replicated.  Likewise a ``shard_map`` whose
      literal ``in_specs`` are ALL empty ``P()`` maps nothing.
+
+  4. **rule tables audit against their reference trees.**  The
+     ``match_partition_rules`` layer (``parallel/rules.py``) declares
+     literal rule TABLES (``RULE_TABLES``) and a canonical reference
+     param tree per family (``REFERENCE_TREES``: ``(path, ndim,
+     "shard"|"rep")`` rows).  The audit resolves every reference leaf
+     through the table first-match-wins, exactly like the runtime
+     matcher, and demands: the table ends in a replicating ``(r".*",
+     P())`` catch-all (so an unmatched leaf replicates by policy
+     instead of raising in production); every reference leaf is
+     claimed by some rule; a "shard" leaf's claiming rule carries a
+     declared axis (a DELETED kernel rule drops the leaf to the
+     catch-all — the silent-full-replication regression); a "rep"
+     leaf's claiming rule is axis-free; and every non-catch-all rule
+     is the first-match winner of at least one reference leaf (a
+     catch-all hoisted to the front starves every later rule — all
+     dead, one finding each).
 
 Scope: ``har_tpu/parallel/*.py`` + ``har_tpu/serve/dispatch.py`` (the
 serving-side placement).  Pure stdlib, like every harlint rule.
@@ -327,6 +344,9 @@ class PartitionSpecRule(Rule):
                     if not isinstance(dec, ast.Call) and _is_jit_ref(dec):
                         jit_contract(dec, {}, "@jit", symbol=qual)
 
+        # ---- rule-table audit (the match_partition_rules layer)
+        self._table_audit(ctx, declared, flag, resolve_axis)
+
         # ---- spec-builder replication check (`*specs*` functions)
         for qual, fnode in functions:
             if not isinstance(fnode, (ast.FunctionDef,
@@ -371,6 +391,176 @@ class PartitionSpecRule(Rule):
                     symbol=qual,
                 )
         return findings
+
+    # ------------------------------------------------------------ tables
+
+    def _table_audit(self, ctx, declared, flag, resolve_axis):
+        """Check 4: resolve every REFERENCE_TREES leaf through its
+        RULE_TABLES table first-match-wins (mirroring the runtime
+        matcher regex-for-regex) and flag unmatched leaves, mis-placed
+        claims, dead rules, and a missing/misplaced catch-all."""
+        import re
+
+        lits: dict[str, ast.AST] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lits[t.id] = node.value
+        tables = lits.get("RULE_TABLES")
+        refs = lits.get("REFERENCE_TREES")
+        if not (isinstance(tables, ast.Dict) and isinstance(refs, ast.Dict)):
+            return
+
+        def seq(val):
+            """A (possibly Name-indirected) literal tuple/list node."""
+            if isinstance(val, ast.Name):
+                val = lits.get(val.id)
+            return val if isinstance(val, (ast.Tuple, ast.List)) else None
+
+        ref_map = {}
+        for k, v in zip(refs.keys, refs.values):
+            if isinstance(k, ast.Constant):
+                ref_map[k.value] = seq(v)
+
+        for k, v in zip(tables.keys, tables.values):
+            family = k.value if isinstance(k, ast.Constant) else None
+            table = seq(v)
+            if family is None or table is None:
+                continue
+            ref = ref_map.get(family)
+            if ref is None:
+                flag(
+                    k,
+                    f"rule table `{family}` has no REFERENCE_TREES "
+                    "entry — the table audit cannot resolve its "
+                    "coverage; add the family's canonical param tree",
+                )
+                continue
+
+            # parse (pattern, P(...)) rows; opaque rows are skipped
+            # (generated tables are exercised at runtime, not here)
+            rules = []
+            for entry in table.elts:
+                if not (
+                    isinstance(entry, ast.Tuple) and len(entry.elts) == 2
+                ):
+                    continue
+                pat_node, spec_node = entry.elts
+                if not (
+                    isinstance(pat_node, ast.Constant)
+                    and isinstance(pat_node.value, str)
+                ):
+                    continue
+                axes: list[str] = []
+                n_entries = 0
+                if (
+                    isinstance(spec_node, ast.Call)
+                    and isinstance(spec_node.func, ast.Name)
+                    and spec_node.func.id in _SPEC_NAMES
+                ):
+                    n_entries = len(spec_node.args)
+                    for a in spec_node.args:
+                        if isinstance(a, ast.Starred):
+                            continue
+                        axes.extend(
+                            ax
+                            for ax in resolve_axis(a, spec_node.lineno)
+                            if ax in declared
+                        )
+                try:
+                    compiled = re.compile(pat_node.value)
+                except re.error as exc:
+                    flag(
+                        pat_node,
+                        f"rule pattern {pat_node.value!r} in `{family}` "
+                        f"does not compile: {exc}",
+                    )
+                    continue
+                rules.append(
+                    (pat_node.value, compiled, axes, n_entries, entry)
+                )
+            if not rules:
+                continue
+
+            last_pat, _, last_axes, _, last_node = rules[-1]
+            if last_pat != r".*" or last_axes:
+                flag(
+                    last_node,
+                    f"rule table `{family}` does not end in the "
+                    'replicating `(r".*", P())` catch-all — an '
+                    "unmatched leaf raises at placement time instead "
+                    "of replicating by policy",
+                )
+
+            winners: set[int] = set()
+            for leaf in ref.elts:
+                if not (
+                    isinstance(leaf, ast.Tuple) and len(leaf.elts) == 3
+                ):
+                    continue
+                path_n, ndim_n, kind_n = leaf.elts
+                if not all(
+                    isinstance(n, ast.Constant)
+                    for n in (path_n, ndim_n, kind_n)
+                ):
+                    continue
+                path, ndim, kind = (
+                    path_n.value, ndim_n.value, kind_n.value
+                )
+                idx = next(
+                    (
+                        i
+                        for i, r in enumerate(rules)
+                        if r[1].search(path)
+                    ),
+                    None,
+                )
+                if idx is None:
+                    flag(
+                        leaf,
+                        f"reference leaf `{path}` matches no rule in "
+                        f"`{family}` — match_partition_rules would "
+                        "raise on this family's own canonical tree",
+                    )
+                    continue
+                winners.add(idx)
+                pat, _, axes, n_entries, entry = rules[idx]
+                if kind == "shard" and not axes:
+                    flag(
+                        entry,
+                        f"sharded reference leaf `{path}` of `{family}` "
+                        f"is claimed by replicating rule `{pat}` — the "
+                        "kernel it stands for serves FULLY REPLICATED "
+                        "(a deleted or shadowed sharding rule)",
+                    )
+                elif kind == "rep" and axes:
+                    flag(
+                        entry,
+                        f"replicated reference leaf `{path}` of "
+                        f"`{family}` is claimed by sharding rule "
+                        f"`{pat}` — a leaf meant to replicate would "
+                        "be partitioned",
+                    )
+                if axes and n_entries > ndim:
+                    flag(
+                        entry,
+                        f"rule `{pat}` of `{family}` declares "
+                        f"{n_entries} spec entries but claims "
+                        f"{ndim}-dim leaf `{path}` — the spec is "
+                        "longer than the array rank",
+                    )
+            for i, (pat, _, axes, _, entry) in enumerate(rules):
+                if pat == r".*" or i in winners:
+                    continue
+                flag(
+                    entry,
+                    f"rule `{pat}` in `{family}` is the first-match "
+                    "winner of no reference-tree leaf — a dead rule "
+                    "(shadowed by an earlier pattern, or a stale "
+                    "path); every live rule must claim at least one "
+                    "canonical leaf",
+                )
 
     # ------------------------------------------------------------ arity
 

@@ -248,6 +248,15 @@ def _parser() -> argparse.ArgumentParser:
                          "host_platform_device_count=N for a dry-run "
                          "mesh.  Needs a jitted model; the analytic "
                          "demo model falls back to host scoring")
+    sv.add_argument("--mesh-shape", type=str, default=None,
+                    help="2D BxM (batch x model) serving mesh, e.g. "
+                         "2x4: batch rows shard over B devices while "
+                         "the checkpoint's params place model-parallel "
+                         "over M via the partition-rule tables "
+                         "(har_tpu.parallel.rules) — serves models "
+                         "bigger than one device.  Mutually exclusive "
+                         "with --mesh; needs B*M visible devices (same "
+                         "dry-run hint as --mesh) and a jitted model")
     sv.add_argument("--workers", type=int, default=0,
                     help="run a multi-worker fleet cluster "
                          "(har_tpu.serve.cluster): sessions partition "
@@ -907,6 +916,37 @@ def main(argv=None) -> int:
             mesh = create_mesh(
                 dp=args.mesh, tp=1, devices=jax.devices()[: args.mesh]
             )
+        if args.mesh_shape:
+            if args.mesh:
+                raise SystemExit(
+                    "--mesh-shape and --mesh both name a serving mesh; "
+                    "pass one (--mesh-shape BxM covers the 1D case as "
+                    "Bx1)"
+                )
+            import re as _re
+
+            m = _re.fullmatch(r"(\d+)x(\d+)", args.mesh_shape.strip())
+            if not m or int(m.group(1)) < 1 or int(m.group(2)) < 1:
+                raise SystemExit(
+                    f"--mesh-shape {args.mesh_shape!r} is not BxM "
+                    "(two positive integers, e.g. 2x4)"
+                )
+            b, mdl = int(m.group(1)), int(m.group(2))
+            import jax
+
+            from har_tpu.parallel.mesh import create_mesh
+
+            n_dev = len(jax.devices())
+            if b * mdl > n_dev:
+                raise SystemExit(
+                    f"--mesh-shape {b}x{mdl} needs {b * mdl} devices "
+                    f"but only {n_dev} are visible; on a CPU host run "
+                    "under XLA_FLAGS=--xla_force_host_platform_device_"
+                    f"count={b * mdl} for a dry-run mesh"
+                )
+            mesh = create_mesh(
+                dp=b, tp=mdl, devices=jax.devices()[: b * mdl]
+            )
         journal_cfg = None
         if args.journal:
             from har_tpu.serve import JournalConfig
@@ -1140,12 +1180,16 @@ def main(argv=None) -> int:
             # sessions partition across N journaled FleetServers behind
             # the consistent-hash router; --kill-worker demos a live
             # failover (journal hand-off migration, global conservation)
-            if args.resume or args.adapt or args.mesh or args.checkpoint:
+            if (
+                args.resume or args.adapt or args.mesh
+                or args.mesh_shape or args.checkpoint
+            ):
                 raise SystemExit(
                     "--workers drives the analytic demo fleet; it does "
                     "not combine with --resume/--adapt/--mesh/"
-                    "--checkpoint (each worker is an unmodified "
-                    "FleetServer — run those modes single-process)"
+                    "--mesh-shape/--checkpoint (each worker is an "
+                    "unmodified FleetServer — run those modes "
+                    "single-process)"
                 )
             if args.net:
                 # REAL transport (har_tpu.serve.net): OS subprocess

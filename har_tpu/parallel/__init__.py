@@ -19,6 +19,20 @@ from har_tpu.parallel.sharding import (
     shard_batch,
 )
 from har_tpu.parallel.data_parallel import jit_replicated, make_dp_train_step
+from har_tpu.parallel.rules import (
+    DENSE_MLP_RULES,
+    INT8_RULES,
+    MOE_RULES,
+    PIPELINE_RULES,
+    RULE_TABLES,
+    TRANSFORMER_RULES,
+    alternating_rules,
+    make_shard_and_gather_fns,
+    make_shard_fns,
+    match_partition_rules,
+    match_rule,
+    rules_for_params,
+)
 from har_tpu.parallel.tensor_parallel import (
     dense_alternating_specs,
     make_gspmd_scan_fit,
@@ -38,6 +52,18 @@ from har_tpu.parallel.expert_parallel import (
 )
 
 __all__ = [
+    "DENSE_MLP_RULES",
+    "INT8_RULES",
+    "MOE_RULES",
+    "PIPELINE_RULES",
+    "RULE_TABLES",
+    "TRANSFORMER_RULES",
+    "alternating_rules",
+    "make_shard_and_gather_fns",
+    "make_shard_fns",
+    "match_partition_rules",
+    "match_rule",
+    "rules_for_params",
     "DP_DCN_AXIS",
     "create_multihost_mesh",
     "EP_AXIS",

@@ -132,8 +132,18 @@ def make_moe_fn(
         return y, aux
 
     # router replicated, expert stacks split on their leading E axis,
-    # tokens split on the batch axis; aux scalars replicated
-    param_specs = {"router": P(), "experts": P(axis)}
+    # tokens split on the batch axis; aux scalars replicated — the
+    # layout is the MOE_RULES table's, looked up by argument name
+    from har_tpu.parallel.rules import MOE_RULES, match_rule, respec_axis
+
+    param_specs = {
+        "router": respec_axis(
+            match_rule(MOE_RULES, "router"), EP_AXIS, axis
+        ),
+        "experts": respec_axis(
+            match_rule(MOE_RULES, "experts"), EP_AXIS, axis
+        ),
+    }
     return jax.shard_map(
         moe,
         mesh=mesh,

@@ -106,6 +106,15 @@ def data_shard_count(mesh: Mesh) -> int:
     ) if data_axes(mesh) else 1
 
 
+def model_shard_count(mesh: Mesh) -> int:
+    """How many ways the model (hidden) dimension splits on this mesh —
+    the ``tp`` extent of a 2D ``(dp, tp)`` serving mesh, 1 when the
+    mesh has no model axis."""
+    # host-side mesh-shape arithmetic, like data_shard_count
+    # harlint: host-ok
+    return int(mesh.shape.get(TP_AXIS, 1))
+
+
 def linear_data_shard_index(mesh: Mesh):
     """Traced linear shard id across every data axis (inside shard_map).
 

@@ -100,11 +100,25 @@ def make_pipeline_fn(
             jnp.where(idx == s - 1, 1.0, 0.0) * outbuf, axis
         )
 
+    # stage stacks split their leading S axis, activations replicate —
+    # the PIPELINE_RULES table's layout, looked up by argument name
+    from har_tpu.parallel.rules import (
+        PIPELINE_RULES,
+        match_rule,
+        respec_axis,
+    )
+
     return jax.shard_map(
         pipelined,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(
+            respec_axis(
+                match_rule(PIPELINE_RULES, "stacked_params"),
+                PP_AXIS, axis,
+            ),
+            match_rule(PIPELINE_RULES, "x"),
+        ),
+        out_specs=match_rule(PIPELINE_RULES, "y"),
         check_vma=False,
     )
 

@@ -1,0 +1,405 @@
+"""THE partition-rule sharding layer: regex rule tables → spec trees.
+
+One declarative table per model family maps parameter-tree paths to
+`PartitionSpec`s; `match_partition_rules` resolves a table against any
+concrete param tree (first match wins, scalars always replicate, a
+terminal catch-all is required) and `make_shard_and_gather_fns` turns
+the resolved spec tree into per-leaf placement/fetch closures.  This is
+the ROADMAP's "match_partition_rules refactor": the hand-built spec
+trees that used to live in `tensor_parallel` / `zero1` /
+`expert_parallel` / `pipeline_parallel` collapse into table lookups
+here, and the serving side (`har_tpu.serve.dispatch
+.ModelParallelScorer`) places checkpoints through the SAME tables — one
+sharding vocabulary for train and serve (the DrJAX framing: placement
+is data, not code).
+
+Tables are module-level LITERALS on purpose: harlint's HL007 audit
+reads them statically (every leaf of a family's reference tree must be
+claimed by exactly one live rule; the catch-all must be terminal), so a
+deleted kernel rule or a catch-all hoisted above the kernel rules fails
+`har lint` before it can silently serve a replicated model.
+
+Rule semantics:
+  - a rule is ``(regex, PartitionSpec)``; the regex is `re.search`-ed
+    against the '/'-joined tree path of each leaf (dict keys, attr
+    names, or sequence indices — so int8's flat leaf LIST addresses as
+    "0", "1", …).
+  - first match wins; later rules never see a claimed leaf.
+  - scalar leaves (ndim 0, or single-element) replicate regardless of
+    the table — there is nothing to shard.
+  - a leaf no rule matches is a ``ValueError``: every table must end
+    with a catch-all ``(".*", P())``.
+
+Axis convention: tables shard over the mesh's ``tp`` axis (the model
+axis of a 2D ``(dp, tp)`` serving mesh — `mesh.create_mesh`); the batch
+rides ``dp`` via `sharding.batch_sharding` exactly as before.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from har_tpu.parallel.mesh import TP_AXIS
+
+
+def tree_path_str(path) -> str:
+    """'/'-joined printable form of a tree_flatten_with_path key path
+    (dict key, attribute, or sequence index — int8 leaf lists address
+    by position)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:  # raw tuple-path entries (tests, hand-built paths)
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_rule(rules, name: str):
+    """First-match-wins lookup of ONE '/'-joined path in a rule table.
+
+    The scalar-blind primitive under `match_partition_rules`, exposed
+    for call sites that place named arguments rather than param leaves
+    (shard_map prefix trees built before any params exist — the moe and
+    pipeline wrappers)."""
+    for pattern, spec in rules:
+        if re.search(pattern, name) is not None:
+            return spec
+    raise ValueError(
+        f"no partition rule matched {name!r} — every rule table must "
+        "end with a terminal catch-all ('.*', P())"
+    )
+
+
+def match_partition_rules(rules, params):
+    """Resolve a rule table against a param tree → PartitionSpec tree.
+
+    ``rules`` is a sequence of ``(regex, PartitionSpec)``; the first
+    rule whose regex `re.search`-matches a leaf's '/'-joined path wins.
+    Scalar leaves replicate unconditionally.  Raises ``ValueError`` for
+    a leaf no rule matches — a table without a terminal catch-all is a
+    bug, not a default."""
+    def assign(path, leaf):
+        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+            return P()  # scalars: nothing to shard, whatever the table says
+        return match_rule(rules, tree_path_str(path))
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def make_shard_fns(mesh: Mesh, partition_specs):
+    """Per-leaf placement tree for a resolved spec tree: each fn puts a
+    host (or replicated-device) leaf onto the mesh in its table layout
+    — ONE placement, reused for the life of the program.  Apply with
+    ``jax.tree.map(lambda f, x: f(x), fns, tree)``.  This is the half a
+    scorer needs at construction; the gather half lives only in
+    `make_shard_and_gather_fns` so the launch path never closes over a
+    host sync."""
+    def shard_fn(spec):
+        sharding = NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sharding)
+
+    return jax.tree.map(
+        shard_fn, partition_specs, is_leaf=lambda s: isinstance(s, P)
+    )
+
+
+def make_shard_and_gather_fns(mesh: Mesh, partition_specs):
+    """``(shard_fns, gather_fns)`` trees for a resolved spec tree:
+    ``shard_fns`` as in `make_shard_fns`, ``gather_fns`` fetching the
+    placed leaves back to host fully assembled (the checkpoint-export
+    path — an explicit, rare host sync by design)."""
+    def gather_fn(spec):
+        del spec  # a device_get assembles any layout
+        return lambda x: jax.device_get(x)
+
+    return (
+        make_shard_fns(mesh, partition_specs),
+        jax.tree.map(
+            gather_fn, partition_specs, is_leaf=lambda s: isinstance(s, P)
+        ),
+    )
+
+
+def respec_axis(spec, old: str, new: str):
+    """A table spec with one mesh-axis name substituted — for wrappers
+    that accept a caller-chosen axis name over a default-axis table
+    (`make_moe_fn(axis=...)`, `make_pipeline_fn(axis=...)`)."""
+    if old == new:
+        return spec
+    return P(*[new if entry == old else entry for entry in tuple(spec)])
+
+
+def spec_shard_count(mesh: Mesh, spec) -> int:
+    """How many ways a single leaf splits under ``spec`` on ``mesh`` —
+    host-side mesh arithmetic for params-bytes accounting."""
+    n = 1
+    for entry in tuple(spec):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            if ax is not None:
+                n *= int(mesh.shape[ax])
+    return n
+
+
+# --------------------------------------------------------------------
+# family tables
+#
+# LITERAL tables (no comprehensions, no helpers) — harlint HL007's
+# table audit parses these with `ast` and replays the first-match-wins
+# resolution against REFERENCE_TREES below.  Edit a table and the audit
+# re-judges it; delete a kernel rule or hoist the catch-all and
+# `har lint` fails.
+
+# Stacks of Flax `nn.Dense` layers (the MLP family, and any model whose
+# 2-D kernels are auto-named Dense_0, Dense_1, …): Megatron
+# alternation by LAYER PARITY — even layers column-parallel (output dim
+# sharded, bias follows), odd layers row-parallel (input dim sharded —
+# the previous layer left the activations sharded on hidden).  The
+# regexes key on the LAST digit of the layer index, so Dense_10 pairs
+# with Dense_0's parity exactly as the natural-order walk in
+# `dense_alternating_specs` always produced.
+DENSE_MLP_RULES = (
+    (r"Dense_\d*[02468]/kernel$", P(None, TP_AXIS)),
+    (r"Dense_\d*[13579]/kernel$", P(TP_AXIS, None)),
+    (r"Dense_\d*[02468]/bias$", P(TP_AXIS)),
+    (r".*", P()),
+)
+
+# Transformer1D encoder (har_tpu.models.transformer, unscanned layout —
+# the checkpoint form a served model carries): attention qkv
+# column-parallel (heads split over tp), proj row-parallel closing the
+# pair with one all-reduce; the FFN Dense_0/Dense_1 pair likewise.
+# Embedding, norms, and the small head stay replicated (the catch-all).
+TRANSFORMER_RULES = (
+    (r"qkv/kernel$", P(None, TP_AXIS)),
+    (r"qkv/bias$", P(TP_AXIS)),
+    (r"proj/kernel$", P(TP_AXIS, None)),
+    (r"Dense_0/kernel$", P(None, TP_AXIS)),
+    (r"Dense_0/bias$", P(TP_AXIS)),
+    (r"Dense_1/kernel$", P(TP_AXIS, None)),
+    (r".*", P()),
+)
+
+# int8-quantized serving leaves (har_tpu.quantize._Int8Inner.params): a
+# flat LIST of program-input leaves — int8 kernels interleaved with the
+# f32 remainder, addressed by position — in the same natural traversal
+# order the float tree flattens to.  int8 leaves are ordinary program
+# inputs and shard like any other ≥2-dim leaf: alternate
+# column-/row-parallel by kernel ordinal.  The canonical quantized demo
+# pair flattens alphabetically to ``[b1, w1, w2]`` — position 0 is the
+# bias (replicated via the catch-all), 1 the int8 up-projection
+# (column-parallel), 2 the int8 down-projection (row-parallel).
+INT8_RULES = (
+    (r"^1$", P(None, TP_AXIS)),
+    (r"^2$", P(TP_AXIS, None)),
+    (r".*", P()),
+)
+
+# ZeRO-1 optimizer state (zero1.make_zero1_fit): every array leaf of
+# the flattened-vector optimizer state shards its leading axis over the
+# mesh's data axes; scalar leaves (Adam's step count) replicate through
+# the matcher's scalar guard.  Built per-mesh because the data axes are
+# the mesh's own (``(dp,)``, or ``(dp_dcn, dp)`` on multi-slice pods).
+def zero1_rules(axes):
+    return ((r".*", P(axes)),)
+
+
+# Switch-routed MoE (expert_parallel.init_moe_params): the replicated
+# router vs the expert stacks' leading E axis, one expert per device on
+# the linear ``ep`` mesh.  Resolved by NAME (`match_rule`) into the
+# moe shard_map's in_specs prefix tree.
+MOE_RULES = (
+    (r"^router$", P()),
+    (r"^experts(/|$)", P("ep")),
+    (r".*", P()),
+)
+
+# GPipe pipeline (pipeline_parallel.make_pipeline_fn): stage-stacked
+# params split their leading S axis over the linear ``pp`` mesh;
+# the microbatched activations (and the collected output) replicate.
+PIPELINE_RULES = (
+    (r"^stacked_params$", P("pp")),
+    (r".*", P()),
+)
+
+RULE_TABLES = {
+    "dense_mlp": DENSE_MLP_RULES,
+    "transformer": TRANSFORMER_RULES,
+    "int8": INT8_RULES,
+    "moe": MOE_RULES,
+}
+
+# Reference trees the HL007 audit resolves each table against: one
+# ``(path, ndim, placement)`` row per leaf of the family's canonical
+# param tree, ``placement`` declaring the INTENT — "shard" leaves must
+# be claimed by a live non-terminal rule carrying a real axis,
+# "rep" leaves must resolve replicated.  A deleted kernel rule turns a
+# "shard" row into a catch-all hit (unmatched-leaf finding); a
+# catch-all hoisted first starves every later rule (dead-rule finding).
+REFERENCE_TREES = {
+    "dense_mlp": (
+        ("Dense_0/kernel", 2, "shard"),
+        ("Dense_0/bias", 1, "shard"),
+        ("Dense_1/kernel", 2, "shard"),
+        ("Dense_1/bias", 1, "rep"),
+        ("Dense_10/kernel", 2, "shard"),
+        ("Dense_10/bias", 1, "shard"),
+    ),
+    "transformer": (
+        ("EncoderBlock_0/qkv/kernel", 2, "shard"),
+        ("EncoderBlock_0/qkv/bias", 1, "shard"),
+        ("EncoderBlock_0/proj/kernel", 2, "shard"),
+        ("EncoderBlock_0/proj/bias", 1, "rep"),
+        ("EncoderBlock_0/Dense_0/kernel", 2, "shard"),
+        ("EncoderBlock_0/Dense_0/bias", 1, "shard"),
+        ("EncoderBlock_0/Dense_1/kernel", 2, "shard"),
+        ("EncoderBlock_0/Dense_1/bias", 1, "rep"),
+        ("EncoderBlock_0/LayerNorm_0/scale", 1, "rep"),
+        ("EncoderBlock_0/LayerNorm_0/bias", 1, "rep"),
+        ("LayerNorm_0/scale", 1, "rep"),
+        ("embed/kernel", 2, "rep"),
+        ("embed/bias", 1, "rep"),
+        ("head/kernel", 2, "rep"),
+        ("head/bias", 1, "rep"),
+    ),
+    "int8": (
+        ("0", 1, "rep"),
+        ("1", 2, "shard"),
+        ("2", 2, "shard"),
+    ),
+    "moe": (
+        ("router", 2, "rep"),
+        ("experts/w1", 3, "shard"),
+        ("experts/b1", 2, "shard"),
+        ("experts/w2", 3, "shard"),
+        ("experts/b2", 2, "shard"),
+    ),
+}
+
+
+def alternating_rules(
+    params, tp_axis: str = TP_AXIS, *, kernels_only: bool = False
+):
+    """GENERATED table: Megatron alternation over any param tree.
+
+    Walks the tree in the same natural order `dense_alternating_specs`
+    always used ((prefix, numeric-suffix) component sort, so Dense_10
+    follows Dense_9) and emits one exact-path rule per 2-D kernel-like
+    leaf — even ordinals column-parallel, odd row-parallel, a bias (or
+    1-D follower) after a column-parallel kernel sharded with it — plus
+    the terminal catch-all.  This is how arbitrary trees (JitDemoModel's
+    ``w1/b1/w2``, int8 leaf lists, CNN heads) get a family table without
+    hand-writing one; for pure Dense stacks it resolves identically to
+    ``DENSE_MLP_RULES``.
+
+    ``kernels_only=True`` restricts the alternation to leaves NAMED
+    ``kernel`` (the historical `dense_alternating_specs` contract:
+    LSTM cell matrices and other 2-D non-kernel leaves replicate)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def natural_key(path):
+        def component(k):
+            k = getattr(k, "key", getattr(k, "idx", k))
+            head, _, tail = str(k).rpartition("_")
+            return (head, int(tail)) if tail.isdigit() else (str(k), -1)
+
+        return tuple(component(k) for k in path)
+
+    ordered = sorted(flat, key=lambda pl: natural_key(pl[0]))
+    rules = []
+    kernel_index = 0
+    column_prefixes = set()
+    prev_was_column = False
+    for path, leaf in ordered:
+        name = tree_path_str(path)
+        tail = str(
+            getattr(path[-1], "key", getattr(path[-1], "idx", path[-1]))
+        )
+        is_kernel = (
+            tail == "kernel" if kernels_only else tail != "bias"
+        )
+        if np.ndim(leaf) == 2 and is_kernel:
+            column = kernel_index % 2 == 0
+            if column:
+                column_prefixes.add(name.rpartition("/")[0])
+            kernel_index += 1
+            rules.append((
+                rf"^{re.escape(name)}$",
+                P(None, tp_axis) if column else P(tp_axis, None),
+            ))
+            prev_was_column = column
+        elif np.ndim(leaf) == 1 and tail.isdigit() and prev_was_column:
+            # positional (list) form: the 1-D follower of a
+            # column-parallel kernel is its bias — shard with it
+            rules.append((rf"^{re.escape(name)}$", P(tp_axis)))
+            prev_was_column = False
+        else:
+            prev_was_column = False
+    for path, leaf in ordered:
+        name = tree_path_str(path)
+        tail = str(
+            getattr(path[-1], "key", getattr(path[-1], "idx", path[-1]))
+        )
+        if tail == "bias" and name.rpartition("/")[0] in column_prefixes:
+            rules.append((rf"^{re.escape(name)}$", P(tp_axis)))
+    rules.append((r".*", P()))
+    return tuple(rules)
+
+
+def rules_for_params(params, tp_axis: str = TP_AXIS):
+    """Family auto-detection: the table a param tree serves under.
+
+    Paths carrying the transformer vocabulary (``qkv/kernel``) get
+    ``TRANSFORMER_RULES``; trees whose every ≥2-dim leaf is an
+    auto-named ``Dense_k/kernel`` get ``DENSE_MLP_RULES``; everything
+    else (demo models, int8 leaf lists, conv stacks) gets a generated
+    `alternating_rules` table over its own exact paths."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = [tree_path_str(p) for p, _ in flat]
+    if any(n.endswith("qkv/kernel") for n in names):
+        return TRANSFORMER_RULES
+    multi = [
+        n for (p, leaf), n in zip(flat, names) if np.ndim(leaf) >= 2
+    ]
+    if multi and all(
+        re.search(r"Dense_\d+/kernel$", n) for n in multi
+    ):
+        return DENSE_MLP_RULES
+    return alternating_rules(params, tp_axis)
+
+
+def shard_divisibility_check(params, specs, mesh: Mesh) -> None:
+    """Refuse layouts whose sharded dims do not divide their mesh-axis
+    extent — a silently padded placement would change the served
+    math."""
+    def check(path, x, s):
+        for dim, entry in zip(np.shape(x), tuple(s)):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is None:
+                    continue
+                # host-side mesh-shape arithmetic at scorer
+                # construction — no device value is touched
+                # harlint: host-ok
+                n = int(mesh.shape[ax])
+                if dim % n:
+                    raise ValueError(
+                        f"param {tree_path_str(path)!r} dim {dim} not "
+                        f"divisible by mesh axis {ax!r}={n} (spec {s})"
+                    )
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    spec_flat = jax.tree.leaves(
+        specs, is_leaf=lambda t: isinstance(t, P)
+    )
+    for (path, leaf), s in zip(flat, spec_flat):
+        check(path, leaf, s)

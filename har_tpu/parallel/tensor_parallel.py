@@ -36,49 +36,28 @@ from har_tpu.parallel.mesh import DP_AXIS, TP_AXIS
 def dense_alternating_specs(params, tp_axis: str = TP_AXIS):
     """PartitionSpec pytree: alternate column-/row-parallel 2-D kernels.
 
-    Walks the tree in sorted-key order (Flax names are Dense_0, Dense_1, …
-    so traversal order is layer order).  The i-th 2-D kernel gets
-    ``P(None, tp)`` for even i (column-parallel: output dim sharded) and
-    ``P(tp, None)`` for odd i (row-parallel: input dim sharded — its
-    input activations are already sharded by the previous layer).  A bias
-    directly following a column-parallel kernel is ``P(tp)``; everything
-    else (LayerNorm scales, small heads, LSTM cells) is replicated.
+    The i-th 2-D ``kernel`` (natural layer order: Dense_0, Dense_1, …,
+    Dense_10 after Dense_9) gets ``P(None, tp)`` for even i
+    (column-parallel: output dim sharded) and ``P(tp, None)`` for odd i
+    (row-parallel: input dim sharded — its input activations are already
+    sharded by the previous layer).  A bias directly following a
+    column-parallel kernel is ``P(tp)``; everything else (LayerNorm
+    scales, small heads, LSTM cells) is replicated.
+
+    Collapsed onto the rule-table layer (`har_tpu.parallel.rules`): the
+    hand-built spec walk is now ``alternating_rules`` (the table this
+    tree generates, exact-path regex per kernel) resolved by
+    ``match_partition_rules`` — the same first-match-wins machinery the
+    serving-side `ModelParallelScorer` and the static family tables
+    (`DENSE_MLP_RULES`) use.
     """
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    from har_tpu.parallel.rules import (
+        alternating_rules,
+        match_partition_rules,
+    )
 
-    # natural-order traversal: lexicographic dict order puts Dense_10
-    # before Dense_2, which would flip the parity of every later layer —
-    # sort each path component on its (prefix, numeric-suffix) pair
-    def natural_key(path):
-        def component(k):
-            k = getattr(k, "key", str(k))
-            head, _, tail = str(k).rpartition("_")
-            return (head, int(tail)) if tail.isdigit() else (str(k), -1)
-
-        return tuple(component(k) for k in path)
-
-    ordered = sorted(flat, key=lambda pl: natural_key(pl[0]))
-    specs = {}
-    kernel_index = 0
-    last_kernel_spec: dict[tuple, P] = {}
-    for path, leaf in ordered:
-        if leaf.ndim == 2 and path[-1].key == "kernel":
-            spec = (
-                P(None, tp_axis) if kernel_index % 2 == 0 else P(tp_axis, None)
-            )
-            kernel_index += 1
-            last_kernel_spec[path[:-1]] = spec
-            specs[path] = spec
-        else:
-            specs[path] = P()
-    # biases follow their kernel's output sharding
-    for path in list(specs):
-        if path[-1].key == "bias":
-            ks = last_kernel_spec.get(path[:-1])
-            if ks is not None and ks == P(None, tp_axis):
-                specs[path] = P(tp_axis)
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(params), [specs[p] for p, _ in flat]
+    return match_partition_rules(
+        alternating_rules(params, tp_axis, kernels_only=True), params
     )
 
 

@@ -86,12 +86,13 @@ def make_zero1_fit(
 
     # one placement rule, used for both the in/out specs and the initial
     # device_put: array leaves shard their leading axis over the data
-    # axes, scalar leaves (e.g. Adam's step count) replicate
+    # axes, scalar leaves (e.g. Adam's step count) replicate via the
+    # matcher's scalar guard — a one-row rule table over the optimizer
+    # state (the zero1 entry of the shared sharding layer)
+    from har_tpu.parallel.rules import match_partition_rules, zero1_rules
+
     opt_template = optimizer.init(jnp.zeros((dpad,), flat0.dtype))
-    opt_specs = jax.tree.map(
-        lambda leaf: P(axes) if jnp.ndim(leaf) >= 1 else P(),
-        opt_template,
-    )
+    opt_specs = match_partition_rules(zero1_rules(axes), opt_template)
 
     def init_opt_state():
         return jax.tree.map(

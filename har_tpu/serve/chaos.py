@@ -300,9 +300,17 @@ def run_kill_point(
     fsync: bool = True,
     journal_dir: str | None = None,
     pipeline_depth: int = 1,
+    mesh=None,
 ) -> dict:
     """Kill a journaled fleet at one stage boundary, recover, resume,
     and return the verdict dict (``ok`` + evidence).
+
+    ``mesh`` runs the whole matrix behind a mesh-backed dispatch plane
+    (a 2D ``(dp, tp)`` mesh serves through ``ModelParallelScorer``,
+    params placed via the family rule table): the A/B models become
+    jitted demo models (the analytic pair has no device program), and
+    recovery re-places the params through the SAME table — placement is
+    a runtime resource like the mesh, never journaled.
 
     Runs under the PR-2 FakeClock + DispatchFaults harness (periodic
     injected stalls on the fake clock: the fault plumbing is live, the
@@ -317,13 +325,25 @@ def run_kill_point(
     if point in ENGINE_KILL_POINTS:
         return run_engine_kill_point(
             point, sessions=sessions, seed=seed, journal_dir=journal_dir,
-            pipeline_depth=pipeline_depth,
+            pipeline_depth=pipeline_depth, mesh=mesh,
         )
     if point not in KILL_POINTS:
         raise ValueError(f"unknown kill point {point!r}")
     at = _DEFAULT_AT[point] if at is None else at
     recordings = _recordings(sessions, n_samples, 3, seed)
-    models = {"A": AnalyticDemoModel(), "B": AnalyticDemoModel(tau=5.0)}
+    if mesh is None:
+        models = {
+            "A": AnalyticDemoModel(), "B": AnalyticDemoModel(tau=5.0),
+        }
+    else:
+        # mesh-backed dispatch plane: the analytic pair is host-only,
+        # so the A/B swap serves two jitted demo checkpoints instead
+        from har_tpu.serve.loadgen import JitDemoModel
+
+        models = {
+            "A": JitDemoModel(window=window, channels=3, seed=1729),
+            "B": JitDemoModel(window=window, channels=3, seed=5),
+        }
     swap_sample = (n_samples // hop // 2) * hop  # mid-recording
     config = FleetConfig(
         max_sessions=sessions, target_batch=32, max_delay_ms=0.0,
@@ -338,6 +358,7 @@ def run_kill_point(
                 stall_every=3, stall_ms=1.0, fake_clock=clock
             ),
             clock=clock, model_version="A", journal=journal,
+            mesh=mesh,
         )
         for i in range(sessions):
             server.add_session(i)
@@ -399,6 +420,7 @@ def run_kill_point(
             fault_hook=DispatchFaults(
                 stall_every=3, stall_ms=1.0, fake_clock=clock2
             ),
+            mesh=mesh,
         )
         recovery_ms = (time.perf_counter() - t0) * 1e3
 
@@ -472,13 +494,15 @@ def _verdict(point, ref_events, pre_events, post_events, restored,
     }
 
 
-def run_random_kill(seed: int) -> dict:
+def run_random_kill(seed: int, mesh=None) -> dict:
     """Seed-randomized kill-point draw for the property test: point,
     occurrence, flush batching, snapshot cadence AND pipeline depth all
     vary — the recovery contract must hold for every combination.  The
     depth draw spans the full ticket ring {1, 2, 3, 4}: at depth >= 3
     several tickets are genuinely in flight at the kill instant, and
-    every one of them must recover as ordinary un-acked pending."""
+    every one of them must recover as ordinary un-acked pending.
+    ``mesh`` runs the draw behind a mesh-backed dispatch plane (see
+    `run_kill_point`)."""
     rng = np.random.default_rng((seed, 0xDEAD))
     point = KILL_POINTS[int(rng.integers(len(KILL_POINTS)))]
     at = _DEFAULT_AT[point] + int(rng.integers(0, 3))
@@ -490,13 +514,14 @@ def run_random_kill(seed: int) -> dict:
         flush_every=int(rng.choice([1, 4, 16, 64])),
         snapshot_every=int(rng.choice([0, 10, 30])),
         pipeline_depth=int(rng.choice([1, 2, 3, 4])),
+        mesh=mesh,
     )
     out["seed"] = seed
     if not out["ok"] and "never fired" in (out["why"] or ""):
         # a tiny random fleet may finish before a late occurrence; that
         # is a harness-calibration miss, not a durability failure —
         # retry at the first occurrence so every seed tests recovery
-        out = run_kill_point(point, at=1, sessions=4, seed=seed)
+        out = run_kill_point(point, at=1, sessions=4, seed=seed, mesh=mesh)
         out["seed"] = seed
     return out
 
@@ -504,6 +529,7 @@ def run_random_kill(seed: int) -> dict:
 def run_engine_kill_point(
     point: str, *, sessions: int = 8, seed: int = 0,
     journal_dir: str | None = None, pipeline_depth: int = 1,
+    mesh=None,
 ) -> dict:
     """Kill inside the adaptation controller's registry transitions —
     after ``registry.promote`` but before the fleet swap applies
@@ -511,7 +537,8 @@ def run_engine_kill_point(
     swap-back (``mid_rollback``) — then recover and prove the
     half-finished transition completes cleanly: the recovered fleet
     serves exactly the registry's CURRENT version, with accounting
-    intact."""
+    intact.  ``mesh`` runs the transition behind a mesh-backed
+    dispatch plane, as in `run_kill_point`."""
     import shutil
 
     from har_tpu.adapt.registry import ModelRegistry
@@ -531,8 +558,14 @@ def run_engine_kill_point(
         journal = FleetJournal(
             journal_dir, JournalConfig(flush_every=8, snapshot_every=0)
         )
-        incumbent = AnalyticDemoModel()
-        candidate = AnalyticDemoModel(tau=5.0)
+        if mesh is None:
+            incumbent = AnalyticDemoModel()
+            candidate = AnalyticDemoModel(tau=5.0)
+        else:
+            from har_tpu.serve.loadgen import JitDemoModel
+
+            incumbent = JitDemoModel(window=100, channels=3, seed=1729)
+            candidate = JitDemoModel(window=100, channels=3, seed=5)
         models: dict = {}
 
         # post-swap dispatch failures force the probation regression
@@ -545,6 +578,7 @@ def run_engine_kill_point(
                 pipeline_depth=pipeline_depth,
             ),
             clock=clock, fault_hook=faults, journal=journal,
+            mesh=mesh,
         )
         rng = np.random.default_rng((seed, 77))
         recs = [
@@ -568,7 +602,13 @@ def run_engine_kill_point(
                 min_sessions=2, window_s=1e9, cooldown_s=1e9,
                 recovery_patience=1,
             ),
-            shadow_config=ShadowConfig(sample_every=1, min_windows=4),
+            # mesh-backed pairs are independently-seeded jit models, so
+            # the argmax-agreement gate is off: the matrix tests the
+            # journaled transition, not candidate quality
+            shadow_config=ShadowConfig(
+                sample_every=1, min_windows=4,
+                min_agreement=0.98 if mesh is None else 0.0,
+            ),
             clock=clock,
         )
         models[server.model_version] = incumbent
@@ -613,7 +653,9 @@ def run_engine_kill_point(
         # ---- recovery ----------------------------------------------------
         t0 = time.perf_counter()
         clock2 = FakeClock(clock.t)
-        restored = FleetServer.restore(journal_dir, loader, clock=clock2)
+        restored = FleetServer.restore(
+            journal_dir, loader, clock=clock2, mesh=mesh
+        )
         registry2 = ModelRegistry(reg_root, clock=clock2)
         engine2 = AdaptationEngine(
             restored, registry2, lambda job: candidate,
@@ -624,7 +666,10 @@ def run_engine_kill_point(
                 min_sessions=2, window_s=1e9, cooldown_s=1e9,
                 recovery_patience=1,
             ),
-            shadow_config=ShadowConfig(sample_every=1, min_windows=4),
+            shadow_config=ShadowConfig(
+                sample_every=1, min_windows=4,
+                min_agreement=0.98 if mesh is None else 0.0,
+            ),
             clock=clock2,
             resume=True,
             loader=loader,

@@ -444,6 +444,7 @@ class HostScorer:
 
     kind = "host"
     devices = 1
+    model_axis_shards = 1
     device_labels = ("host",)
     supports_fused = False  # no device program to fuse into
 
@@ -528,6 +529,12 @@ class DeviceScorer:
         self._jax = jax
         self.model = model
         self._pre, self._inner = _split_predict(model)
+        # the param tree every launch/measure dispatches against —
+        # subclasses that PLACE params (ModelParallelScorer's rule-table
+        # layout) override this once at construction, and every
+        # downstream path (bare predict, fused hot loop, calibration)
+        # serves the placed tree without knowing it
+        self._params = self._inner.params
         self.devices = 1
         self.device_labels = (str(jax.devices()[0].id),)
         self.compiled_shapes: set[int] = set()
@@ -567,7 +574,7 @@ class DeviceScorer:
         # device_put; no device buffer is touched
         # harlint: host-ok
         x = self._place(np.asarray(x, np.float32))
-        handle = self._inner._predict(self._inner.params, x)
+        handle = self._inner._predict(self._params, x)
         if self.tunnel_rtt_ms:
             return (handle, time.perf_counter())
         return handle
@@ -649,7 +656,7 @@ class DeviceScorer:
         the one fused program, un-fetched.  No host-side scaler, no
         dtype cast, no per-dispatch allocation on this path."""
         self.compiled_shapes.add(len(windows))
-        handle = self._fused_fn()(self._inner.params, self._place(windows))
+        handle = self._fused_fn()(self._params, self._place(windows))
         if self.tunnel_rtt_ms:
             return (handle, time.perf_counter())
         return handle
@@ -693,6 +700,24 @@ class DeviceScorer:
                 pass
         return total if found else None
 
+    def params_bytes(self) -> dict:
+        """Host-side params-residency accounting: total checkpoint
+        bytes and the largest single-device share.  A single-device (or
+        batch-only-sharded) program holds the FULL param tree on every
+        device; the model-parallel subclass divides each leaf by its
+        spec's shard count.  Pure host arithmetic over leaf shapes —
+        no device buffer is touched."""
+        total = sum(
+            # nbytes is shape×itemsize metadata on host and device
+            # arrays alike — no transfer
+            int(
+                np.prod(np.shape(leaf), dtype=np.int64)
+                * np.dtype(leaf.dtype).itemsize
+            )
+            for leaf in self._jax.tree.leaves(self._inner.params)
+        )
+        return {"total": total, "per_device": total}
+
     def measure(self, batch: int, iters: int = 16, *,
                 fused: bool = False) -> dict:
         """Device p50 for one padded program AT THE SHAPE AND PLACEMENT
@@ -719,7 +744,7 @@ class DeviceScorer:
 
         if fused:
             fn = self._fused_fn()
-            params = self._inner.params
+            params = self._params
             fn(params, place())[0].block_until_ready()  # warm
             times = []
             for _ in range(iters):
@@ -730,7 +755,7 @@ class DeviceScorer:
         else:
             x = place()
             fn = self._inner._predict
-            params = self._inner.params
+            params = self._params
             fn(params, x).block_until_ready()  # warm
             times = []
             for _ in range(iters):
@@ -748,6 +773,8 @@ class DeviceScorer:
     # geometry for measure(); the engine stamps these after construction
     model_window = 200
     model_channels = 3
+    # model-axis shard count: 1 everywhere except ModelParallelScorer
+    model_axis_shards = 1
 
 
 class ShardedScorer(DeviceScorer):
@@ -790,13 +817,83 @@ class ShardedScorer(DeviceScorer):
         return self._jax.device_put(x, self._sharding)
 
 
+class ModelParallelScorer(ShardedScorer):
+    """ShardedScorer with the PARAMS placed over the mesh's model axis.
+
+    The 2D ``(dp, tp)`` layout: the batch rides the data axes exactly
+    as in ShardedScorer (rows split ``dp``-ways, ``pad_shard`` pads per
+    BATCH-shard count), while the checkpoint's ≥2-dim leaves split over
+    ``tp`` in the layout the family's partition-rule table declares
+    (`har_tpu.parallel.rules` — the same tables the tp trainers read).
+    Placement happens ONCE, here at construction, through the
+    rule-table shard-fn tree; every launch (bare, fused, calibration)
+    then dispatches against the placed tree and XLA inserts the tp
+    collectives the layout implies.  This is what serves a checkpoint
+    too big for one device: per-device residency is the sharded leaves'
+    1/tp share, reported by ``params_bytes``.
+
+    The placement is a RUNTIME resource like the mesh itself: a journal
+    recovery or an engine ``resize`` onto a model-axis mesh rebuilds
+    the scorer, which re-places the params via the same rule table —
+    nothing about the layout is (or needs to be) durable.
+    """
+
+    kind = "model_parallel"
+
+    def __init__(self, model, mesh, rules=None):
+        super().__init__(model, mesh)
+        from har_tpu.parallel.mesh import model_shard_count
+        from har_tpu.parallel.rules import (
+            make_shard_fns,
+            match_partition_rules,
+            rules_for_params,
+            shard_divisibility_check,
+        )
+
+        params = self._inner.params
+        self.rules = rules_for_params(params) if rules is None else rules
+        self.param_specs = match_partition_rules(self.rules, params)
+        # indivisible hidden dims refuse here (ValueError), and
+        # make_scorer falls back to the batch-only sharded path
+        shard_divisibility_check(params, self.param_specs, mesh)
+        shard_fns = make_shard_fns(mesh, self.param_specs)
+        self._params = self._jax.tree.map(
+            lambda place, leaf: place(leaf), shard_fns, params
+        )
+        self.model_axis_shards = model_shard_count(mesh)
+
+    def params_bytes(self) -> dict:
+        from jax.sharding import PartitionSpec
+
+        from har_tpu.parallel.rules import spec_shard_count
+
+        jax = self._jax
+        is_spec = lambda s: isinstance(s, PartitionSpec)
+        total = per_device = 0
+        for leaf, spec in zip(
+            jax.tree.leaves(self._inner.params),
+            jax.tree.leaves(self.param_specs, is_leaf=is_spec),
+        ):
+            nbytes = int(
+                np.prod(np.shape(leaf), dtype=np.int64)
+                * np.dtype(leaf.dtype).itemsize
+            )
+            total += nbytes
+            per_device += nbytes // spec_shard_count(self.mesh, spec)
+        return {"total": total, "per_device": per_device}
+
+
 def make_scorer(model, mesh=None, *, tier: str = "f32",
-                window: int = 200, channels: int = 3):
-    """The one scorer-selection policy: a >1-device mesh gets the
-    sharded path, a jittable model gets the async single-device path,
-    everything else falls back to the synchronous HostScorer (which is
-    operation-identical to the PR-2 engine).  Model swaps rebuild the
-    scorer — the engine calls this again with the new model.
+                window: int = 200, channels: int = 3, rules=None):
+    """The one scorer-selection policy: a mesh with a model axis
+    (``tp > 1``) gets the 2D model-parallel path (params placed once
+    via the family's partition-rule table — ``rules`` overrides the
+    auto-detected table), any other >1-device mesh gets the
+    batch-sharded path, a jittable model gets the async single-device
+    path, and everything else falls back to the synchronous HostScorer
+    (which is operation-identical to the PR-2 engine).  Model swaps
+    rebuild the scorer — the engine calls this again with the new
+    model.
 
     ``tier="int8"`` serves the weight-only int8 quantization of the
     model (har_tpu.quantize.quantize_serving) behind the SAME ticket /
@@ -818,9 +915,16 @@ def make_scorer(model, mesh=None, *, tier: str = "f32",
         raise ValueError(f"unknown serving tier {tier!r}")
     scorer = None
     if mesh is not None:
-        from har_tpu.parallel.mesh import data_shard_count
+        from har_tpu.parallel.mesh import data_shard_count, model_shard_count
 
-        if data_shard_count(mesh) > 1:
+        if model_shard_count(mesh) > 1:
+            try:
+                scorer = ModelParallelScorer(model, mesh, rules=rules)
+            except ValueError:
+                # host model (no device program) or indivisible hidden
+                # dims — fall through to the batch-only ladder
+                scorer = None
+        if scorer is None and data_shard_count(mesh) > 1:
             try:
                 scorer = ShardedScorer(model, mesh)
             except ValueError:

@@ -2810,6 +2810,11 @@ class FleetServer:
         snap["devices"] = (
             None if self._scorer is None else self._scorer.devices
         )
+        snap["model_axis_shards"] = (
+            None
+            if self._scorer is None
+            else getattr(self._scorer, "model_axis_shards", 1)
+        )
         if self._device_ms:
             snap["device_ms"] = {
                 str(b): d["p50_ms"]

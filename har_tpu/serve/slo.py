@@ -251,6 +251,131 @@ def fleet_pipeline_smoke(
     }
 
 
+def model_parallel_smoke(
+    sessions: int = 48,
+    *,
+    windows_per_session: int = 2,
+    target_batch: int = 16,
+    pipeline_depth: int = 2,
+    dp: int = 2,
+    tp: int = 4,
+    seed: int = 11,
+) -> dict:
+    """The release gate's model-parallel check: the SAME load run once
+    on a single device and once on the 2D ``dp × tp`` (batch × model)
+    dry-run mesh through a ``ModelParallelScorer`` — params placed ONCE
+    via the partition-rule table, then served behind the ordinary
+    ticket ring.
+
+    Verdict contract:
+      - every session's (t_index, label, raw_label, drift) sequence is
+        identical across the two runs and the probability vectors match
+        to 1e-6 (the GSPMD re-tiling tolerance — this is the unfused
+        tier, so the FULL vector is compared, not the label surrogate);
+      - the mesh run really is model-parallel: scorer kind
+        ``model_parallel``, ``model_axis_shards == tp``, and
+        ``params_bytes()["per_device"]`` STRICTLY below the
+        single-device scorer's total — the one property that makes a
+        bigger-than-one-chip model servable at all;
+      - zero dropped windows and balanced accounting in both runs.
+
+    Stamped as ``{sessions, mesh, model_axis_shards,
+    params_bytes_per_device, p99_ms, ...}`` in the gate log; the
+    release gate forces ``--xla_force_host_platform_device_count=8`` so
+    the 2×4 placement is proven on every host.
+    """
+    import jax
+
+    from har_tpu.parallel.mesh import create_mesh
+    from har_tpu.serve.dispatch import ModelParallelScorer
+    from har_tpu.serve.loadgen import JitDemoModel
+
+    need = dp * tp
+    if len(jax.devices()) < need:
+        return {
+            "ok": False,
+            "error": (
+                f"{len(jax.devices())} devices visible, {need} needed "
+                "— run under --xla_force_host_platform_device_count"
+            ),
+        }
+    mesh = create_mesh(dp=dp, tp=tp, devices=jax.devices()[:need])
+    model = JitDemoModel()
+    recordings, _ = synthetic_sessions(
+        sessions, windows_per_session=windows_per_session, seed=seed
+    )
+
+    def one_run(run_mesh):
+        server = FleetServer(
+            model, window=200, hop=200, smoothing="ema",
+            config=FleetConfig(
+                max_sessions=sessions,
+                target_batch=target_batch,
+                pipeline_depth=pipeline_depth if run_mesh else 1,
+            ),
+            mesh=run_mesh,
+        )
+        for i in range(sessions):
+            server.add_session(i)
+        events, report = drive_fleet(server, recordings, seed=seed)
+        by_sid: dict[int, list] = {i: [] for i in range(sessions)}
+        for ev in events:
+            by_sid[ev.session_id].append(ev.event)
+        return server, report, by_sid
+
+    s1, r1, ref = one_run(None)
+    s2, r2, got = one_run(mesh)
+
+    equivalent = True
+    for i in range(sessions):
+        a, b = ref[i], got[i]
+        if len(a) != len(b) or not all(
+            x.t_index == y.t_index
+            and x.label == y.label
+            and x.raw_label == y.raw_label
+            and x.drift == y.drift
+            and np.allclose(x.probability, y.probability, atol=1e-6)
+            for x, y in zip(a, b)
+        ):
+            equivalent = False
+            break
+
+    snap1, snap2 = s1.stats_snapshot(), s2.stats_snapshot()
+    clean = all(
+        s["accounting"]["dropped"] == 0
+        and s["accounting"]["pending"] == 0
+        and s["accounting"]["balanced"]
+        for s in (snap1, snap2)
+    )
+    placed = isinstance(s2.scorer, ModelParallelScorer)
+    shards = s2.scorer.model_axis_shards
+    single_bytes = s1.scorer.params_bytes()
+    placed_bytes = s2.scorer.params_bytes()
+    fits = placed_bytes["per_device"] < single_bytes["total"]
+    scored = snap2["accounting"]["scored"]
+    return {
+        "sessions": sessions,
+        "mesh": f"{dp}x{tp}",
+        "model_axis_shards": shards,
+        "batch_shards": s2.scorer.devices,
+        "params_bytes_single": single_bytes["total"],
+        "params_bytes_per_device": placed_bytes["per_device"],
+        "p99_ms": snap2["stages"]["event_ms"].get("p99_ms"),
+        "dropped": snap2["accounting"]["dropped"],
+        "windows_per_sec": (
+            round(scored / r2.duration_s, 1) if r2.duration_s else None
+        ),
+        "equivalent": equivalent,
+        "ok": bool(
+            equivalent
+            and clean
+            and placed
+            and shards == tp
+            and fits
+        ),
+    }
+
+
 def host_plane_smoke(
     sessions: int = 256, *, check_sessions: int = 64, seed: int = 5
 ) -> dict:

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Standalone model-parallel grid → artifacts/model_parallel_grid.json.
+
+The bench's ``model_parallel_grid`` lane (bench.py) runs the same
+measurement inside the budgeted round-end draw; this script is the
+standalone path that produces a committed artifact on any host.  Two
+claims, one artifact:
+
+  1. capability — a wide Transformer1D-shaped checkpoint whose f32
+     params (~85 MB) EXCEED the grid's emulated per-device budget
+     (64 MiB) serves correctly on the 2×4 (batch × model) mesh:
+     ``params_bytes_per_device`` strictly below the budget, decisions
+     label-identical with probability vectors to 1e-6 vs the
+     single-device reference.  Batch-only sharding replicates the full
+     checkpoint per device, so under the stated budget this model is
+     impossible to serve without the model axis — ``fits_one_device``
+     is the flat verdict key;
+  2. overhead — on the SMALL h256 MLP (which fits everywhere), the 2×4
+     model-parallel cell must hold >= 0.8x the windows/s of the
+     equal-device 8×1 batch-sharded mesh at 1,000 sessions (n_runs>=3,
+     median+std) — the flat ``model_parallel_speedup`` key.  The
+     4-device batch-sharded cell rides along for the smaller-footprint
+     comparison.
+
+    python scripts/model_parallel_grid_bench.py          # writes artifact
+    python scripts/model_parallel_grid_bench.py --smoke  # tiny, no write
+
+Every multi-device cell runs in a subprocess with a forced dry-run
+device count (the flag only affects the CPU backend; a host exposing
+enough real devices shards those).  Every cell must come back with zero
+dropped windows and a balanced conservation law, the wide cell must be
+single-device-equivalent, and the speedup must clear 0.8, or the
+artifact is refused.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # runnable from any cwd, no install
+    sys.path.insert(0, str(REPO))
+ARTIFACT = REPO / "artifacts" / "model_parallel_grid.json"
+
+# the emulated per-device parameter budget the fits_one_device verdict
+# is judged against: dry-run CPU devices have no HBM ceiling of their
+# own, so the artifact STATES one — sized between the wide checkpoint's
+# per-device shard (~21 MB on 2x4) and its full replica (~85 MB), i.e.
+# a device class the sharded placement fits and the replicated one
+# cannot
+EMULATED_DEVICE_BUDGET_BYTES = 64 * 2**20
+
+
+def measure(n_sessions: int, n_runs: int, tb_base: int,
+            wide_sessions: int) -> dict:
+    # THE shared measurement + subprocess wrapper
+    # (loadgen.run_model_parallel_cell / _subprocess) — also behind
+    # bench.py's model_parallel_grid lane, so the lane and this
+    # committed artifact cannot silently diverge
+    from har_tpu.serve.loadgen import run_model_parallel_cell_subprocess
+
+    rtt_ms = 30.0
+    common = dict(
+        n_sessions=n_sessions, tunnel_rtt_ms=rtt_ms, n_runs=n_runs,
+        seed=3,
+    )
+    # equal TOTAL batch for the two 8-device cells: the model axis does
+    # not multiply batch capacity, so weak scaling is per BATCH shard —
+    # 2x4 and 8x1 then issue the same dispatch count over the same load
+    # and the speedup isolates the model axis' own overhead (the
+    # all-reduces), not a batching-policy difference
+    grid = {
+        "1x1": run_model_parallel_cell_subprocess(
+            1, 1, dict(common, target_batch=tb_base)
+        ),
+        "4x1": run_model_parallel_cell_subprocess(
+            4, 1, dict(common, target_batch=tb_base * 4)
+        ),
+        "8x1": run_model_parallel_cell_subprocess(
+            8, 1, dict(common, target_batch=tb_base * 8)
+        ),
+        "2x4": run_model_parallel_cell_subprocess(
+            2, 4, dict(common, target_batch=tb_base * 8)
+        ),
+    }
+    # the headline capability cell: the ~85 MB wide transformer, tiny
+    # session count (it proves placement + equivalence, not throughput),
+    # no emulated RTT (its device program is the cost being placed)
+    grid["2x4_wide_transformer"] = run_model_parallel_cell_subprocess(
+        2, 4,
+        dict(
+            n_sessions=wide_sessions, windows_per_session=1,
+            target_batch=16, tunnel_rtt_ms=0.0, n_runs=n_runs, seed=3,
+            model="wide_transformer", check_single_device=True,
+        ),
+        timeout_s=900.0,
+    )
+    for label, cell in grid.items():
+        print(
+            f"{label}: {cell['windows_per_sec_median']} w/s median "
+            f"(std {cell['windows_per_sec_std']}), scorer "
+            f"{cell['scorer']}, per-device "
+            f"{cell['params_bytes_per_device']} B",
+            file=sys.stderr,
+        )
+    wide = grid["2x4_wide_transformer"]
+    batch_sharded = grid["8x1"]["windows_per_sec_median"]
+    speedup = (
+        round(grid["2x4"]["windows_per_sec_median"] / batch_sharded, 2)
+        if batch_sharded
+        else None
+    )
+    return {
+        "lane": "model_parallel_grid",
+        "small_model": "jit_demo_mlp_h256",
+        "wide_model": "wide_transformer_e768_l3",
+        "emulated_tunnel_rtt_ms": rtt_ms,
+        "n_sessions": n_sessions,
+        "windows_per_session": 2,
+        "n_runs": n_runs,
+        "grid": grid,
+        "baseline_cell": "8x1",
+        "model_parallel_speedup": speedup,
+        "speedup_vs_4dev_batch_sharded": (
+            round(
+                grid["2x4"]["windows_per_sec_median"]
+                / grid["4x1"]["windows_per_sec_median"],
+                2,
+            )
+            if grid["4x1"]["windows_per_sec_median"]
+            else None
+        ),
+        "emulated_device_budget_bytes": EMULATED_DEVICE_BUDGET_BYTES,
+        # the wide checkpoint does NOT fit one emulated device — the
+        # whole reason the model axis exists
+        "fits_one_device": bool(
+            wide["params_bytes_total"] <= EMULATED_DEVICE_BUDGET_BYTES
+        ),
+        "wide_params_bytes_total": wide["params_bytes_total"],
+        "wide_params_bytes_per_device": wide["params_bytes_per_device"],
+        "wide_served_within_budget": bool(
+            wide["params_bytes_per_device"] < EMULATED_DEVICE_BUDGET_BYTES
+        ),
+        "wide_single_device_equivalent": wide["single_device_equivalent"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny sizes, print only (no artifact write)")
+    ap.add_argument("--n-runs", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    n_sessions = 64 if args.smoke else 1000
+    tb_base = 16 if args.smoke else 256
+    wide_sessions = 4 if args.smoke else 8
+    result = measure(n_sessions, args.n_runs, tb_base, wide_sessions)
+    clean = all(
+        c["dropped_windows"] == 0 and c["accounting_balanced"]
+        for c in result["grid"].values()
+    )
+    if not clean:
+        print("grid cell dropped windows or broke accounting — "
+              "artifact refused", file=sys.stderr)
+        return 1
+    if not result["wide_single_device_equivalent"]:
+        print("wide-transformer cell diverged from the single-device "
+              "reference — artifact refused", file=sys.stderr)
+        return 1
+    if result["fits_one_device"] or not result["wide_served_within_budget"]:
+        print("budget story broken: the wide checkpoint must exceed one "
+              "emulated device and fit per-device when sharded — "
+              "artifact refused", file=sys.stderr)
+        return 1
+    if not args.smoke and (
+        result["model_parallel_speedup"] is None
+        or result["model_parallel_speedup"] < 0.8
+    ):
+        print(
+            f"model_parallel_speedup {result['model_parallel_speedup']} "
+            "< 0.8 of the equal-device batch-sharded cell — artifact "
+            "refused", file=sys.stderr,
+        )
+        return 1
+    result["source"] = "scripts/model_parallel_grid_bench.py"
+    result["emulation_note"] = (
+        "tunnel_rtt_ms emulates the documented remote-tunnel dispatch "
+        "on the small-model cells so dispatch-count differences are "
+        "visible on a local-CPU host; the per-device budget is EMULATED "
+        "(stated above) — dry-run CPU devices have no HBM ceiling, so "
+        "the fits_one_device verdict is bookkeeping against that stated "
+        "budget, with the single-device reference run used for the "
+        "numerical equivalence check only"
+    )
+    try:
+        import jax
+
+        result["backend"] = jax.default_backend()
+    except Exception:
+        result["backend"] = None
+    try:
+        result["git_head"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=REPO, capture_output=True, text=True,
+        ).stdout.strip()
+    except OSError:
+        result["git_head"] = "unknown"
+    result["captured_at"] = int(time.time())
+    if args.smoke:
+        print(json.dumps(result))
+        return 0
+    ARTIFACT.parent.mkdir(exist_ok=True)
+    ARTIFACT.write_text(json.dumps(result, indent=1))
+    print(json.dumps({
+        "artifact": str(ARTIFACT.relative_to(REPO)),
+        "model_parallel_speedup": result["model_parallel_speedup"],
+        "fits_one_device": result["fits_one_device"],
+        "wide_params_bytes_per_device": (
+            result["wide_params_bytes_per_device"]
+        ),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

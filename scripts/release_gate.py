@@ -206,6 +206,29 @@ def _pipeline_smoke() -> dict:
     )
 
 
+def _model_parallel_smoke() -> dict:
+    """Model-parallel serving smoke verdict (PR 20, har_tpu.parallel.
+    rules + ModelParallelScorer): the same fleet load on one device and
+    on the 2×4 (batch × model) dry-run mesh — rule-table placement done
+    once at construction — must be label-identical with probability
+    vectors to 1e-6, with ``params_bytes_per_device`` STRICTLY below
+    the single-device total (the property that lets a model bigger than
+    one chip serve at all); the stamp carries ``{mesh,
+    model_axis_shards, params_bytes_per_device, p99_ms}``.  The 8
+    dry-run devices are forced like the pipeline smoke's — the 2D
+    placement must be proven on every host."""
+    return _run_smoke(
+        "har_tpu.serve.slo",
+        "model_parallel_smoke",
+        extra_env={
+            "XLA_FLAGS": (
+                __import__("os").environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            )
+        },
+    )
+
+
 def _adapt_smoke() -> dict:
     """Drift→retrain→shadow→swap loop smoke verdict."""
     return _run_smoke("har_tpu.adapt.smoke", "adapt_smoke")
@@ -448,6 +471,7 @@ def main(argv=None) -> int:
     suite = None
     fleet = None
     pipeline = None
+    model_parallel = None
     adapt = None
     recovery = None
     cluster = None
@@ -468,6 +492,7 @@ def main(argv=None) -> int:
             prior = json.loads(GATE_LOG.read_text())
             fleet = prior.get("fleet_slo")
             pipeline = prior.get("fleet_pipeline")
+            model_parallel = prior.get("model_parallel")
             adapt = prior.get("adapt_smoke")
             recovery = prior.get("recovery_smoke")
             cluster = prior.get("cluster_failover")
@@ -482,6 +507,7 @@ def main(argv=None) -> int:
         except (OSError, ValueError):
             fleet = None
             pipeline = None
+            model_parallel = None
             adapt = None
             recovery = None
             cluster = None
@@ -543,6 +569,19 @@ def main(argv=None) -> int:
             print(
                 "\nrelease_gate: RED fleet pipeline smoke "
                 f"({json.dumps(pipeline)[:300]}) — snapshot refused",
+                file=sys.stderr,
+            )
+            return 1
+        # model-parallel gate: the 2×4 (batch × model) dry-run mesh run
+        # must be label-identical (probs to 1e-6) to the single-device
+        # run with the per-device parameter footprint strictly below
+        # the single-device total — stamped {mesh, model_axis_shards,
+        # params_bytes_per_device, p99_ms} below
+        model_parallel = _model_parallel_smoke()
+        if not model_parallel.get("ok"):
+            print(
+                "\nrelease_gate: RED model-parallel smoke "
+                f"({json.dumps(model_parallel)[:300]}) — snapshot refused",
                 file=sys.stderr,
             )
             return 1
@@ -682,6 +721,7 @@ def main(argv=None) -> int:
                 "harlint": harlint,
                 "fleet_slo": fleet,
                 "fleet_pipeline": pipeline,
+                "model_parallel": model_parallel,
                 "adapt_smoke": adapt,
                 "recovery_smoke": recovery,
                 "cluster_failover": cluster,
@@ -710,6 +750,10 @@ def main(argv=None) -> int:
                 "fleet_slo_ok": None if fleet is None else fleet["ok"],
                 "fleet_pipeline_ok": (
                     None if pipeline is None else pipeline["ok"]
+                ),
+                "model_parallel_ok": (
+                    None if model_parallel is None
+                    else model_parallel["ok"]
                 ),
                 "adapt_smoke_ok": None if adapt is None else adapt["ok"],
                 "recovery_smoke_ok": (

@@ -83,10 +83,10 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
     # every lane must be present (ran or carried a skip/error marker)
     assert set(extra["lanes"]) == {
         "mlp", "cnn1d", "bilstm", "transformer", "saturation_transformer",
-        "fleet_serving", "fleet_pipeline_grid", "adaptive_serving",
-        "fleet_recovery", "cluster_failover", "wire_failover",
-        "journal_ship", "wire_ingest", "gateway_ha", "elastic_traffic",
-        "host_plane_scaling",
+        "fleet_serving", "fleet_pipeline_grid", "model_parallel_grid",
+        "adaptive_serving", "fleet_recovery", "cluster_failover",
+        "wire_failover", "journal_ship", "wire_ingest", "gateway_ha",
+        "elastic_traffic", "host_plane_scaling",
     }
     # r7 fleet-serving lane: ran (median/p99 + zero drops at nominal
     # load) or carried a deadline-skip marker — never silently absent
@@ -148,6 +148,48 @@ def test_bench_smoke_end_to_end(tmp_path, monkeypatch, capsys):
             == grid_lane["fused_speedup_vs_sync_single"]
         )
         assert "chip_state_probe" in grid_lane
+    # r20 model-parallel grid: the 2x4 (batch × model) mesh cells vs
+    # the equal-device 8x1 batch-sharded baseline plus the
+    # wide-transformer capability cell — per-cell zero drops and
+    # balanced accounting, the flat model_parallel_speedup /
+    # fits_one_device keys mirroring the lane — or a deadline-skip
+    # marker; never silently absent
+    mp_lane = extra["lanes"]["model_parallel_grid"]
+    if "skipped" not in mp_lane:
+        mp_grid = mp_lane["grid"]
+        assert "1x1" in mp_grid and "8x1" in mp_grid
+        assert "2x4" in mp_grid and "2x4_wide_transformer" in mp_grid
+        for cell in mp_grid.values():
+            if "error" in cell:  # mesh subprocess may fail; loudly
+                continue
+            assert cell["dropped_windows"] == 0
+            assert cell["accounting_balanced"] is True
+            assert cell["windows_per_sec_median"] > 0
+        if "error" not in mp_grid["2x4"]:
+            assert mp_grid["2x4"]["scorer"] == "ModelParallelScorer"
+            assert mp_grid["2x4"]["model_axis_shards"] == 4
+            assert (
+                mp_grid["2x4"]["params_bytes_per_device"]
+                < mp_grid["2x4"]["params_bytes_total"]
+            )
+        wide = mp_grid["2x4_wide_transformer"]
+        if "error" not in wide:
+            assert wide["single_device_equivalent"] is True
+            assert (
+                wide["params_bytes_total"]
+                > mp_lane["emulated_device_budget_bytes"]
+            )
+            assert mp_lane["fits_one_device"] is False
+            assert mp_lane["wide_served_within_budget"] is True
+            assert (
+                extra["fits_one_device"] == mp_lane["fits_one_device"]
+            )
+        assert (
+            extra["model_parallel_speedup"]
+            == mp_lane["model_parallel_speedup"]
+        )
+        assert mp_lane["baseline_cell"] == "8x1"
+        assert "chip_state_probe" in mp_lane
     # r8 adaptive-serving lane: the fleet numbers across a forced
     # mid-run hot-swap — zero drops and the swap contract, or a
     # deadline-skip marker; never silently absent

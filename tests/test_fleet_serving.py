@@ -593,6 +593,52 @@ def test_cli_serve_mesh_exceeding_devices_exits_with_hint():
         main(["serve", "--sessions", "2", "--mesh", "4096"])
 
 
+def test_cli_serve_mesh_shape_2d(capsys):
+    """`har serve --mesh-shape 2x4`: the 2D batch x model mesh from the
+    CLI — zero drops, every window scored, balanced accounting.  (The
+    analytic demo model is host-side, so the dispatch backend falls
+    back to host scoring — the flag must still be honored, not
+    crash, exactly as `--mesh` is.)"""
+    import json
+
+    import jax
+
+    from har_tpu.cli import main
+
+    if len(jax.devices()) < 8:
+        import pytest as _pytest
+
+        _pytest.skip("needs the 8-device dry-run mesh")
+    rc = main(["serve", "--sessions", "32", "--mesh-shape", "2x4"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["dropped"] == 0
+    assert out["scored"] == out["enqueued"]
+    assert out["stats"]["accounting"]["balanced"]
+
+
+def test_cli_serve_mesh_shape_exceeding_devices_exits_with_hint():
+    """B*M beyond the visible devices is refused with the same dry-run
+    hint `--mesh` gives, naming the exact device count needed."""
+    from har_tpu.cli import main
+
+    with pytest.raises(
+        SystemExit,
+        match=r"xla_force_host_platform_device_count=4096",
+    ):
+        main(["serve", "--sessions", "2", "--mesh-shape", "64x64"])
+
+
+def test_cli_serve_mesh_shape_rejects_malformed_and_mesh_combo():
+    from har_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="not BxM"):
+        main(["serve", "--sessions", "2", "--mesh-shape", "2x"])
+    with pytest.raises(SystemExit, match="pass one"):
+        main(["serve", "--sessions", "2", "--mesh", "4",
+              "--mesh-shape", "2x2"])
+
+
 def test_cli_serve_honors_checkpoint_geometry(tmp_path, capsys):
     """serve --checkpoint adopts the checkpoint's recorded input_shape
     (the from_checkpoint guard, fleet edition): a 128-sample-window

@@ -1538,47 +1538,103 @@ def reviewed(fn):
     assert not any(f.symbol == "reviewed" for f in findings)
 
 
-def test_hl007_acceptance_real_tensor_parallel_mutations():
-    """THE HL007 acceptance mutations against the REAL sources: (1) a
-    mesh-axis typo in dense_alternating_specs' default, (2) deleting
-    the kernel spec (everything falls to P() — implicit full
-    replication of every 2-D kernel) — each fails the gate; the
-    committed tree is clean."""
-    sources = {}
-    for rel in (
-        "har_tpu/parallel/tensor_parallel.py",
-        "har_tpu/parallel/mesh.py",
-        "har_tpu/parallel/data_parallel.py",
-        "har_tpu/parallel/sharding.py",
-    ):
-        sources[rel] = (REPO / rel).read_text()
+_RULES_SCOPE = (
+    "har_tpu/parallel/rules.py",
+    "har_tpu/parallel/tensor_parallel.py",
+    "har_tpu/parallel/mesh.py",
+    "har_tpu/parallel/expert_parallel.py",
+    "har_tpu/parallel/pipeline_parallel.py",
+    "har_tpu/parallel/data_parallel.py",
+    "har_tpu/parallel/sharding.py",
+)
+
+
+def _rules_sources():
+    return {rel: (REPO / rel).read_text() for rel in _RULES_SCOPE}
+
+
+def test_hl007_acceptance_real_rules_mutations():
+    """THE HL007 acceptance mutations against the REAL sources — the
+    sharding layer now lives in ``parallel/rules.py``, so the historic
+    tensor_parallel mutations apply there: (1) a mesh-axis typo in the
+    generated alternation's default, (2) deleting the kernel specs
+    (every kernel rule degrades to P() — implicit full replication) —
+    each fails the gate; the committed tree is clean."""
+    sources = _rules_sources()
     assert lint_sources(dict(sources), [PartitionSpecRule()]) == []
     # (1) axis typo: the default param silently names a ghost axis
     typo = dict(sources)
-    typo["har_tpu/parallel/tensor_parallel.py"] = sources[
-        "har_tpu/parallel/tensor_parallel.py"
+    typo["har_tpu/parallel/rules.py"] = sources[
+        "har_tpu/parallel/rules.py"
     ].replace("tp_axis: str = TP_AXIS", 'tp_axis: str = "tpz"')
     assert typo != sources
     findings = lint_sources(typo, [PartitionSpecRule()])
     msgs = " | ".join(f.message for f in findings)
     assert "`tpz` is not a declared mesh axis" in msgs
-    # (2) deleted kernel spec: dense_alternating_specs shards nothing
+    # (2) deleted kernel specs: every table's kernel rule replicates,
+    # and the audit sees each family's reference kernels fall flat
     flat = dict(sources)
-    flat["har_tpu/parallel/tensor_parallel.py"] = sources[
-        "har_tpu/parallel/tensor_parallel.py"
-    ].replace(
-        "spec = (\n                P(None, tp_axis) if kernel_index % 2 "
-        "== 0 else P(tp_axis, None)\n            )",
-        "spec = P()",
+    flat["har_tpu/parallel/rules.py"] = (
+        sources["har_tpu/parallel/rules.py"]
+        .replace("P(None, TP_AXIS))", "P())")
+        .replace("P(TP_AXIS, None))", "P())")
     )
     assert (
-        flat["har_tpu/parallel/tensor_parallel.py"]
-        != sources["har_tpu/parallel/tensor_parallel.py"]
-    ), "tensor_parallel.py kernel-spec anchor changed"
+        flat["har_tpu/parallel/rules.py"]
+        != sources["har_tpu/parallel/rules.py"]
+    ), "rules.py kernel-spec anchor changed"
     findings2 = lint_sources(flat, [PartitionSpecRule()])
     msgs2 = " | ".join(f.message for f in findings2)
-    assert "dense_alternating_specs" in msgs2
-    assert "implicitly FULLY REPLICATED" in msgs2
+    assert "FULLY REPLICATED" in msgs2
+    assert "`dense_mlp`" in msgs2 and "`transformer`" in msgs2
+
+
+def test_hl007_acceptance_table_audit_mutations():
+    """The rule-TABLE audit's acceptance mutations (ISSUE 20): (a)
+    deleting the transformer qkv kernel rule drops a sharded reference
+    leaf onto the catch-all — a finding; (b) hoisting the catch-all to
+    the front of a table starves every later rule (dead rules) AND
+    breaks the terminal-catch-all contract — findings for both."""
+    sources = _rules_sources()
+    rules_src = sources["har_tpu/parallel/rules.py"]
+    assert lint_sources(dict(sources), [PartitionSpecRule()]) == []
+
+    # (a) delete the transformer qkv kernel rule
+    qkv = dict(sources)
+    qkv["har_tpu/parallel/rules.py"] = rules_src.replace(
+        '    (r"qkv/kernel$", P(None, TP_AXIS)),\n', ""
+    )
+    assert qkv["har_tpu/parallel/rules.py"] != rules_src, (
+        "transformer qkv kernel rule anchor changed"
+    )
+    findings = lint_sources(qkv, [PartitionSpecRule()])
+    msgs = " | ".join(f.message for f in findings)
+    assert "EncoderBlock_0/qkv/kernel" in msgs
+    assert "FULLY REPLICATED" in msgs
+
+    # (b) catch-all reordered to the front of DENSE_MLP_RULES
+    hoist = dict(sources)
+    hoist["har_tpu/parallel/rules.py"] = rules_src.replace(
+        'DENSE_MLP_RULES = (\n'
+        '    (r"Dense_\\d*[02468]/kernel$", P(None, TP_AXIS)),',
+        'DENSE_MLP_RULES = (\n'
+        '    (r".*", P()),\n'
+        '    (r"Dense_\\d*[02468]/kernel$", P(None, TP_AXIS)),',
+    ).replace(
+        '    (r"Dense_\\d*[02468]/bias$", P(TP_AXIS)),\n'
+        '    (r".*", P()),\n'
+        ')',
+        '    (r"Dense_\\d*[02468]/bias$", P(TP_AXIS)),\n'
+        ')',
+        1,
+    )
+    assert hoist["har_tpu/parallel/rules.py"] != rules_src, (
+        "DENSE_MLP_RULES anchors changed"
+    )
+    findings2 = lint_sources(hoist, [PartitionSpecRule()])
+    msgs2 = " | ".join(f.message for f in findings2)
+    assert "dead rule" in msgs2
+    assert "does not end in the replicating" in msgs2
 
 
 # --------------------------------------------------------------- HL008

@@ -1,0 +1,558 @@
+"""Model-parallel serving on a 2D (batch × model) mesh (ISSUE 20).
+
+Pins the one-partition-rule sharding layer end to end:
+
+  1. rules — ``match_partition_rules`` is first-match-wins with a
+     scalar guard and a mandatory terminal catch-all; the generated
+     ``alternating_rules`` table reproduces the historical
+     ``dense_alternating_specs`` layout exactly; ``rules_for_params``
+     picks the right family table.
+  2. serving equivalence — a ``ModelParallelScorer`` on the 2×4
+     dry-run mesh emits label-equal decisions (probs to 1e-6, the
+     GSPMD re-tiling drift) vs the single-device scorer, at every
+     ticket-ring depth 1–4, under FakeClock + DispatchFaults.
+  3. the pad policy pads per BATCH-shard count (``dp``), not per
+     device: 3 due windows on a 2×4 mesh dispatch as a 4-row batch.
+  4. device-calibration honesty — ``calibrate_device`` measures the
+     placed model-parallel program at the emitted (dp × pow2) shapes.
+  5. placement is a runtime resource — the kill matrix and the
+     randomized kill property run green behind a 2D mesh (restore
+     re-places params through the SAME rule table), and a mid-run
+     ``resize`` onto/off the 2D mesh matches the never-resized run.
+  6. composition — the int8 tier serves model-parallel with
+     ``params_bytes per_device`` strictly below the single-device
+     footprint; the fused hot loop keeps its label-equality contract.
+"""
+
+import numpy as np
+import pytest
+
+from har_tpu.serve import (
+    DispatchFaults,
+    FakeClock,
+    FleetConfig,
+    FleetServer,
+    JitDemoModel,
+    drive_fleet,
+    make_scorer,
+    synthetic_sessions,
+)
+from har_tpu.serve.dispatch import (
+    DeviceScorer,
+    HostScorer,
+    ModelParallelScorer,
+    ShardedScorer,
+)
+
+
+def _mesh(dp, tp):
+    import jax
+
+    from har_tpu.parallel.mesh import create_mesh
+
+    if len(jax.devices()) < dp * tp:
+        pytest.skip(f"needs {dp * tp} devices (dry-run mesh)")
+    return create_mesh(dp=dp, tp=tp, devices=jax.devices()[: dp * tp])
+
+
+def _decisions(events):
+    out = {}
+    for fe in events:
+        ev = fe.event
+        out.setdefault(fe.session_id, []).append(
+            (ev.t_index, ev.label, ev.raw_label, ev.drift,
+             ev.probability.tobytes())
+        )
+    return out
+
+
+def _assert_label_equal_probs_close(d1, d2, atol=1e-6):
+    assert d1.keys() == d2.keys()
+    for sid in d1:
+        a, b = d1[sid], d2[sid]
+        assert [x[:4] for x in a] == [y[:4] for y in b]  # labels/drift
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(
+                np.frombuffer(x[4]), np.frombuffer(y[4]), atol=atol
+            )
+
+
+# ------------------------------------------------------------- rules
+
+
+def test_match_partition_rules_first_match_wins_and_scalar_guard():
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.parallel.rules import (
+        DENSE_MLP_RULES,
+        match_partition_rules,
+    )
+
+    params = {
+        "Dense_0": {
+            "kernel": np.ones((4, 8), np.float32),
+            "bias": np.ones((8,), np.float32),
+        },
+        "Dense_1": {
+            "kernel": np.ones((8, 4), np.float32),
+            "bias": np.ones((4,), np.float32),
+        },
+        # scalars and size-1 leaves replicate through the guard even
+        # when an earlier rule would claim their path
+        "Dense_2": {"kernel": np.float32(3.0)},
+        "step": np.zeros((), np.int32),
+    }
+    specs = match_partition_rules(DENSE_MLP_RULES, params)
+    assert specs["Dense_0"]["kernel"] == P(None, "tp")
+    assert specs["Dense_0"]["bias"] == P("tp")
+    assert specs["Dense_1"]["kernel"] == P("tp", None)
+    assert specs["Dense_1"]["bias"] == P()  # catch-all
+    assert specs["Dense_2"]["kernel"] == P()  # scalar guard
+    assert specs["step"] == P()
+
+
+def test_match_partition_rules_demands_terminal_catchall():
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.parallel.rules import match_partition_rules
+
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(
+            ((r"kernel$", P(None, "tp")),),
+            {"other": np.ones((2, 2), np.float32)},
+        )
+
+
+def test_alternating_rules_reproduce_dense_alternating_specs():
+    """The collapse is behavior-preserving: the generated table resolves
+    a Dense stack to the EXACT spec tree `dense_alternating_specs`
+    always produced — including the Dense_10-after-Dense_9 natural
+    order and the bias-follows-column-kernel policy."""
+    from har_tpu.parallel.rules import (
+        alternating_rules,
+        match_partition_rules,
+    )
+    from har_tpu.parallel.tensor_parallel import dense_alternating_specs
+
+    rng = np.random.default_rng(0)
+    params = {
+        f"Dense_{i}": {
+            "kernel": rng.normal(size=(8, 8)).astype(np.float32),
+            "bias": rng.normal(size=(8,)).astype(np.float32),
+        }
+        for i in range(11)
+    }
+    want = dense_alternating_specs(params)
+    got = match_partition_rules(
+        alternating_rules(params, kernels_only=True), params
+    )
+    assert want == got
+
+
+def test_rules_for_params_family_selection():
+    from har_tpu.parallel.rules import (
+        DENSE_MLP_RULES,
+        TRANSFORMER_RULES,
+        rules_for_params,
+    )
+
+    transformer_like = {
+        "EncoderBlock_0": {
+            "qkv": {"kernel": np.ones((8, 8), np.float32)},
+        },
+        "head": {"kernel": np.ones((8, 6), np.float32)},
+    }
+    assert rules_for_params(transformer_like) is TRANSFORMER_RULES
+    dense = {
+        "Dense_0": {"kernel": np.ones((8, 8), np.float32)},
+        "Dense_1": {"kernel": np.ones((8, 8), np.float32)},
+    }
+    assert rules_for_params(dense) is DENSE_MLP_RULES
+    # arbitrary trees (the JitDemoModel w1/b1/w2 shape) get a GENERATED
+    # exact-path alternation, terminal catch-all included
+    arbitrary = {
+        "w1": np.ones((6, 8), np.float32),
+        "b1": np.ones((8,), np.float32),
+        "w2": np.ones((8, 4), np.float32),
+    }
+    rules = rules_for_params(arbitrary)
+    assert rules[-1][0] == r".*"
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.parallel.rules import match_partition_rules
+
+    specs = match_partition_rules(rules, arbitrary)
+    assert specs["w1"] == P(None, "tp")
+    # `b1` is neither a Flax `bias` nor a positional (list) follower,
+    # so it replicates through the catch-all — correct, just unsharded
+    assert specs["b1"] == P()
+    assert specs["w2"] == P("tp", None)
+    # the positional LIST form (the int8 leaf layout) DOES shard the
+    # 1-D follower of a column-parallel kernel with it
+    flat = [np.ones((8,), np.float32), np.ones((6, 8), np.float32),
+            np.ones((8, 4), np.float32)]
+    flat_specs = match_partition_rules(rules_for_params(flat), flat)
+    assert flat_specs == [P(), P(None, "tp"), P("tp", None)]
+
+
+def test_respec_axis_and_spec_shard_count():
+    from jax.sharding import PartitionSpec as P
+
+    from har_tpu.parallel.rules import respec_axis, spec_shard_count
+
+    assert respec_axis(P("ep"), "ep", "experts") == P("experts")
+    assert respec_axis(P(None, "tp"), "tp", "model") == P(None, "model")
+    assert respec_axis(P("pp"), "pp", "pp") == P("pp")
+    mesh = _mesh(2, 4)
+    assert spec_shard_count(mesh, P()) == 1
+    assert spec_shard_count(mesh, P(None, "tp")) == 4
+    assert spec_shard_count(mesh, P("dp", "tp")) == 8
+
+
+# ------------------------------------------- serving equivalence pin
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4])
+def test_model_parallel_matches_single_device_at_ring_depths(depth):
+    """THE model-parallel pin: a 2×4 (batch × model) mesh serves
+    label-equal decisions (probs to 1e-6) vs the single-device run, at
+    every ticket-ring depth, under FakeClock + DispatchFaults."""
+    n = 12
+    model = JitDemoModel(window=100)
+    rng = np.random.default_rng(31)
+    recs = [
+        rng.normal(size=(500, 3)).astype(np.float32) for _ in range(n)
+    ]
+
+    def run(mesh, d):
+        clock = FakeClock()
+        server = FleetServer(
+            model, window=100, hop=50, smoothing="ema",
+            config=FleetConfig(
+                max_sessions=n, target_batch=16, max_delay_ms=0.0,
+                retries=1, pipeline_depth=d,
+            ),
+            fault_hook=DispatchFaults(
+                stall_every=3, stall_ms=1.0, fail_every=5,
+                fake_clock=clock,
+            ),
+            clock=clock,
+            mesh=mesh,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events = []
+        cursors = [0] * n
+        step_rng = np.random.default_rng(7)
+        while any(c < len(recs[i]) for i, c in enumerate(cursors)):
+            for i in range(n):
+                if cursors[i] >= len(recs[i]):
+                    continue
+                step = int(step_rng.integers(20, 120))
+                server.push(i, recs[i][cursors[i]: cursors[i] + step])
+                cursors[i] += step
+            events.extend(server.poll(force=True))
+            clock.advance(0.01)
+        events.extend(server.flush())
+        return server, events
+
+    s1, ev1 = run(None, 1)
+    s2, ev2 = run(_mesh(2, 4), depth)
+    assert isinstance(s2.scorer, ModelParallelScorer)
+    assert s2.scorer.model_axis_shards == 4
+    assert s2.scorer.devices == 2  # batch shards only
+    _assert_label_equal_probs_close(_decisions(ev1), _decisions(ev2))
+    for s in (s1, s2):
+        acct = s.stats.accounting()
+        assert acct["balanced"] and acct["pending"] == 0
+    assert s1.stats.scored == s2.stats.scored
+    if depth >= 2:
+        assert max(s2.stats.inflight_depth) >= 2
+    # the engine stamps the model-axis extent into its snapshot
+    assert s2.stats_snapshot()["model_axis_shards"] == 4
+    assert s1.stats_snapshot()["model_axis_shards"] == 1
+
+
+def test_pad_policy_pads_per_batch_shard_count():
+    """3 due windows on a 2×4 mesh pad to dp × pow2 = 4 rows — NOT to
+    the 8-row full-device batch a 1D mesh would emit."""
+    mesh = _mesh(2, 4)
+    model = JitDemoModel()
+    server = FleetServer(
+        model, window=200, hop=200, smoothing="none",
+        config=FleetConfig(max_sessions=4, target_batch=16),
+        mesh=mesh,
+    )
+    for i in range(3):
+        server.add_session(i)
+        server.push(i, np.zeros((200, 3), np.float32))
+    events = server.flush()
+    assert len(events) == 3
+    assert set(server.stats.batch_sizes) == {4}
+    # every batch-shard's share lands in the device-windows gauge
+    assert all(v > 0 for v in server.stats.device_windows.values())
+
+
+def test_calibrate_device_measures_model_parallel_emitted_shapes():
+    """Satellite bugfix pin: under a 2D mesh, calibrate_device times
+    the PLACED model-parallel program at the dp × pow2 shapes the
+    dispatcher actually emits, and device_ms stamps from it."""
+    mesh = _mesh(2, 4)
+    n = 20
+    model = JitDemoModel()
+    server = FleetServer(
+        model, window=200, hop=200, smoothing="none",
+        config=FleetConfig(max_sessions=n, target_batch=64),
+        mesh=mesh,
+    )
+    recordings, _ = synthetic_sessions(n, windows_per_session=1, seed=1)
+    for i in range(n):
+        server.add_session(i)
+    drive_fleet(server, recordings, seed=1)
+    # 20 windows → dp(2) × pow2(ceil(20/2)=10 → 16) = 32 rows
+    assert set(server.stats.batch_sizes) == {32}
+    cal = server.calibrate_device(iters=2)
+    assert 32 in cal and 2 in cal
+    assert all(b % 2 == 0 for b in cal)
+    for i in range(n):
+        server.push(i, recordings[i])
+    events = server.flush()
+    assert events and all(
+        e.event.device_ms is not None for e in events
+    )
+    assert events[0].event.device_ms == round(cal[32]["p50_ms"] / 20, 4)
+
+
+def test_scorer_selection_policy_2d():
+    """make_scorer routing: tp>1 → ModelParallelScorer; a host model
+    falls back to HostScorer; an indivisible hidden dim falls back to
+    the batch-only ShardedScorer (never crashes)."""
+    mesh = _mesh(2, 4)
+    assert isinstance(
+        make_scorer(JitDemoModel(), mesh), ModelParallelScorer
+    )
+    dp_only = _mesh(8, 1)
+    assert isinstance(make_scorer(JitDemoModel(), dp_only), ShardedScorer)
+    assert not isinstance(
+        make_scorer(JitDemoModel(), dp_only), ModelParallelScorer
+    )
+
+    class _HostOnly:
+        num_classes = 3
+
+        def transform(self, x):
+            from har_tpu.models.base import Predictions
+
+            x = np.asarray(x)
+            m = x.mean(axis=(1, 2))
+            raw = np.stack([-m, m, np.zeros_like(m)], axis=-1)
+            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+            return Predictions.from_raw(
+                raw, e / e.sum(axis=-1, keepdims=True)
+            )
+
+    assert isinstance(make_scorer(_HostOnly(), mesh), HostScorer)
+    # hidden=254 does not divide tp=4: the divisibility check refuses
+    # the placement and the policy degrades to batch-only sharding
+    odd = JitDemoModel(hidden=254)
+    scorer = make_scorer(odd, mesh)
+    assert isinstance(scorer, ShardedScorer)
+    assert not isinstance(scorer, ModelParallelScorer)
+
+
+def test_params_bytes_per_device_strictly_below_single_device():
+    mesh = _mesh(2, 4)
+    model = JitDemoModel()
+    single = make_scorer(model, None)
+    placed = make_scorer(model, mesh)
+    sb = single.params_bytes()
+    pb = placed.params_bytes()
+    assert sb["per_device"] == sb["total"]
+    assert pb["total"] == sb["total"]
+    assert pb["per_device"] < sb["per_device"]
+    # hidden-dim leaves split 4-way; only the tiny in/out remainder
+    # replicates, so the footprint lands well under half
+    assert pb["per_device"] < 0.6 * sb["total"]
+
+
+def test_int8_tier_composes_with_model_parallel():
+    """The int8 tier's flat leaf list shards positionally through
+    INT8_RULES: same labels as the single-device int8 fleet (probs to
+    1e-6), with the per-device footprint split."""
+    from har_tpu.quantize import quantize_serving
+
+    mesh = _mesh(2, 4)
+    n = 12
+    q = quantize_serving(JitDemoModel())
+    recordings, _ = synthetic_sessions(n, windows_per_session=2, seed=3)
+
+    def run(m):
+        server = FleetServer(
+            q, window=200, hop=200, smoothing="ema",
+            config=FleetConfig(max_sessions=n, target_batch=16),
+            mesh=m,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, _ = drive_fleet(server, recordings, seed=3)
+        return server, events
+
+    s1, ev1 = run(None)
+    s2, ev2 = run(mesh)
+    assert isinstance(s2.scorer, ModelParallelScorer)
+    pb = s2.scorer.params_bytes()
+    assert pb["per_device"] < pb["total"]
+    _assert_label_equal_probs_close(_decisions(ev1), _decisions(ev2))
+
+
+def test_fused_hot_loop_label_equal_on_2d_mesh():
+    """The fused program composes with model-parallel placement: label
+    equality with the unfused 2D-mesh run (the fused contract)."""
+    mesh = _mesh(2, 4)
+    n = 12
+    model = JitDemoModel()
+    recordings, _ = synthetic_sessions(n, windows_per_session=3, seed=8)
+
+    def run(fused):
+        server = FleetServer(
+            model, window=200, hop=200, smoothing="vote",
+            config=FleetConfig(
+                max_sessions=n, target_batch=16, fused=fused
+            ),
+            mesh=mesh,
+        )
+        for i in range(n):
+            server.add_session(i)
+        events, _ = drive_fleet(server, recordings, seed=8)
+        return server, events
+
+    s_plain, ev_plain = run(False)
+    s_fused, ev_fused = run(True)
+    assert isinstance(s_fused.scorer, ModelParallelScorer)
+    d_plain, d_fused = _decisions(ev_plain), _decisions(ev_fused)
+    assert d_plain.keys() == d_fused.keys()
+    for sid in d_plain:
+        assert [x[:2] for x in d_plain[sid]] == [
+            y[:2] for y in d_fused[sid]
+        ]
+
+
+# -------------------------------------------------- elastic + chaos
+
+
+def test_resize_onto_and_off_2d_mesh_matches_never_resized():
+    """Mid-run resize ONTO the 2×4 mesh and later OFF it again: the
+    event stream stays label-equal (probs to 1e-6) to the never-resized
+    single-device run — placement is a runtime resource the resize
+    boundary re-derives from the same rule table."""
+    mesh = _mesh(2, 4)
+    n = 12
+    model = JitDemoModel()
+    recordings, _ = synthetic_sessions(n, windows_per_session=6, seed=9)
+    thirds = [
+        (r[: len(r) // 3], r[len(r) // 3: 2 * len(r) // 3],
+         r[2 * len(r) // 3:])
+        for r in recordings
+    ]
+
+    def run(resize):
+        server = FleetServer(
+            model, window=200, hop=200, smoothing="ema",
+            config=FleetConfig(max_sessions=n, target_batch=16),
+        )
+        for i in range(n):
+            server.add_session(i)
+        ev = []
+        for k, seed in ((0, 9), (1, 10), (2, 11)):
+            if resize and k == 1:
+                server.resize(mesh=mesh)  # onto the 2D mesh
+            if resize and k == 2:
+                server.resize(mesh=None)  # and off again
+            got, _ = drive_fleet(
+                server, [t[k] for t in thirds], seed=seed
+            )
+            ev.extend(got)
+        return server, ev
+
+    s_flat, ev_flat = run(False)
+    s_resized, ev_resized = run(True)
+    assert s_resized.stats.resizes == 2
+    assert isinstance(s_resized.scorer, DeviceScorer)
+    assert not isinstance(s_resized.scorer, ShardedScorer)
+    assert s_flat.stats.dropped_total == s_resized.stats.dropped_total == 0
+    _assert_label_equal_probs_close(
+        _decisions(ev_flat), _decisions(ev_resized)
+    )
+    for s in (s_flat, s_resized):
+        acct = s.stats.accounting()
+        assert acct["balanced"] and acct["pending"] == 0
+
+
+def _kill_points():
+    from har_tpu.serve.chaos import ENGINE_KILL_POINTS, KILL_POINTS
+
+    return KILL_POINTS + ENGINE_KILL_POINTS
+
+
+@pytest.mark.parametrize("point", _kill_points())
+def test_kill_matrix_green_with_model_parallel_scorer(point):
+    """Every engine kill point recovers behind the 2D mesh: restore
+    re-places the checkpoint through the SAME rule table and the
+    recovered stream completes the reference run exactly."""
+    from har_tpu.serve.chaos import run_kill_point
+
+    mesh = _mesh(2, 2)
+    out = run_kill_point(point, sessions=4, seed=1, mesh=mesh)
+    assert out["ok"], out
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_randomized_kill_property_green_with_model_parallel(seed):
+    from har_tpu.serve.chaos import run_random_kill
+
+    mesh = _mesh(2, 2)
+    out = run_random_kill(seed, mesh=mesh)
+    assert out["ok"], out
+
+
+# ------------------------------------------------ committed artifact
+
+
+def test_committed_model_parallel_grid_artifact():
+    """The acceptance artifact stays committed and self-consistent: a
+    checkpoint past the emulated per-device budget served on the 2×4
+    mesh (per-device strictly under budget, single-device-equivalent),
+    and the small-model 2×4 cell at >= 0.8x the equal-device
+    batch-sharded windows/s — 1,000 sessions, n_runs >= 3 median+std."""
+    import json
+    from pathlib import Path
+
+    art = (
+        Path(__file__).resolve().parent.parent
+        / "artifacts"
+        / "model_parallel_grid.json"
+    )
+    assert art.exists(), (
+        "artifacts/model_parallel_grid.json missing — run "
+        "scripts/model_parallel_grid_bench.py"
+    )
+    d = json.loads(art.read_text())
+    assert d["n_sessions"] == 1000
+    assert d["n_runs"] >= 3
+    assert d["baseline_cell"] == "8x1"
+    assert d["model_parallel_speedup"] >= 0.8
+    assert d["fits_one_device"] is False
+    assert d["wide_served_within_budget"] is True
+    assert d["wide_single_device_equivalent"] is True
+    assert (
+        d["wide_params_bytes_per_device"]
+        < d["emulated_device_budget_bytes"]
+        < d["wide_params_bytes_total"]
+    )
+    for name in ("1x1", "4x1", "8x1", "2x4", "2x4_wide_transformer"):
+        cell = d["grid"][name]
+        assert cell["dropped_windows"] == 0
+        assert cell["accounting_balanced"] is True
+        assert "windows_per_sec_std" in cell
+    assert d["grid"]["2x4"]["scorer"] == "ModelParallelScorer"
+    assert d["grid"]["2x4"]["model_axis_shards"] == 4

@@ -95,6 +95,40 @@ def test_gate_log_carries_fleet_pipeline_verdict():
     assert pipe["fetch_bytes_per_window"] is not None
 
 
+def test_gate_log_carries_model_parallel_verdict():
+    """The model-parallel counterpart of the pipeline verdict (PR 20,
+    har_tpu.parallel.rules + ModelParallelScorer): the gate log must
+    carry a green 2D-mesh serving check with the {mesh,
+    model_axis_shards, params_bytes_per_device, p99_ms} stamp — the
+    same load on one device and on the 2×4 (batch × model) dry-run
+    mesh, label-identical with probability vectors to 1e-6, and the
+    per-device parameter footprint STRICTLY below the single-device
+    total (the property that makes a bigger-than-one-chip model
+    servable)."""
+    log = json.loads(
+        (REPO / "artifacts" / "test_gate.json").read_text()
+    )
+    mp = log.get("model_parallel")
+    assert mp, (
+        "artifacts/test_gate.json lacks the model_parallel verdict — "
+        "run scripts/release_gate.py"
+    )
+    for key in (
+        "mesh", "model_axis_shards", "params_bytes_per_device",
+        "p99_ms",
+    ):
+        assert key in mp
+    assert mp["ok"] is True
+    assert mp["equivalent"] is True
+    assert mp["dropped"] == 0
+    assert mp["mesh"] == "2x4"
+    assert mp["model_axis_shards"] == 4
+    assert mp["batch_shards"] == 2
+    assert (
+        mp["params_bytes_per_device"] < mp["params_bytes_single"]
+    )
+
+
 def test_gate_log_carries_adapt_smoke_verdict():
     """The adaptation counterpart of the fleet verdict: the gate log
     must carry a green drift→retrain→shadow→swap loop check with the
